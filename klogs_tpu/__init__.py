@@ -1,0 +1,29 @@
+"""klogs-tpu: a TPU-native log acquisition and filtering framework.
+
+A ground-up rebuild of the capabilities of rogosprojects/klogs
+(reference: /root/reference, a Go CLI that fans per-container Kubernetes
+log streams out to files; cmd/root.go:436-497) re-designed TPU-first:
+
+- the CLI / pod-discovery / fan-out / file-sink surface of klogs is kept
+  behaviorally identical (flags, naming, UX; see ``klogs_tpu.cli``),
+- a new ``--match <regex>`` line-filter stage is added whose hot path is
+  a bit-parallel batch-NFA evaluated on TPU via JAX/Pallas under
+  ``shard_map`` over a device mesh (see ``klogs_tpu.filters`` and
+  ``klogs_tpu.ops``).
+
+Layer map (mirrors SURVEY.md §1):
+  L1 CLI            klogs_tpu.cli
+  L2 terminal UI    klogs_tpu.ui
+  L3 cluster access klogs_tpu.cluster  (real REST client + hermetic fake)
+  L4 log streams    klogs_tpu.cluster.backend.LogStream
+  L4.5 filtering    klogs_tpu.filters (LineBatcher, LogFilter, NFA, TPU)
+  L5 concurrency    klogs_tpu.runtime (asyncio fan-out)
+  L6 sink           klogs_tpu.runtime.sink
+  mesh/collectives  klogs_tpu.parallel
+"""
+
+from klogs_tpu.version import BUILD_VERSION
+
+__version__ = BUILD_VERSION
+
+__all__ = ["BUILD_VERSION", "__version__"]

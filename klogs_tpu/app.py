@@ -1,0 +1,13 @@
+"""Top-level run orchestration (analog of rootCmd.Run, cmd/root.go:442-474).
+
+Placeholder until the fan-out runtime lands; fails cleanly instead of
+tracebacking.
+"""
+
+from klogs_tpu.cli import Options
+from klogs_tpu.ui import term
+
+
+def run(opts: Options) -> int:
+    term.fatal("log acquisition is not implemented yet in this build")
+    raise AssertionError("unreachable")  # fatal() always raises

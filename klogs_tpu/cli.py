@@ -1,0 +1,189 @@
+"""CLI flag surface.
+
+Reference parity: the ten klogs flags registered in ``init``
+(cmd/root.go:485-497) with identical names, shorthands, defaults, and
+semantics:
+
+  -n/--namespace    select namespace ("" -> kubeconfig current context)
+  -l/--label        repeatable; union of per-label results, no dedup
+                    (cmd/root.go:458-460)
+  -p/--logpath      default ``logs/<YYYY-MM-DDTHH-MM>`` (cmd/root.go:47)
+  --kubeconfig      default ``$HOME/.kube/config`` (cmd/root.go:71-73)
+  -a/--all          skip the interactive pod picker (cmd/root.go:151)
+  -s/--since        Go duration; server-side SinceSeconds (root.go:204-212)
+  -t/--tail         default -1 = unlimited (cmd/root.go:213-216,492)
+  -f/--follow       stream; q-to-quit (cmd/root.go:465-468)
+  -v/--version      print version, exit 0 (cmd/root.go:445-448)
+  -i/--init         include init containers (cmd/root.go:240-251)
+
+New (north-star) flags, absent from the reference:
+
+  --match           repeatable regex; only matching lines are written
+  --backend         filter engine: cpu (host regex) | tpu (batch NFA)
+  --stats           print lines/sec, matched %, batch-latency summary
+  --cluster         cluster backend: kube (real) | fake (hermetic demo)
+"""
+
+import argparse
+import sys
+from dataclasses import dataclass, field
+
+from klogs_tpu.ui import term
+from klogs_tpu.utils.naming import default_log_path
+from klogs_tpu.version import BUILD_VERSION
+
+
+@dataclass
+class Options:
+    namespace: str = ""
+    labels: list[str] = field(default_factory=list)
+    log_path: str = ""
+    kubeconfig: str = ""
+    all_pods: bool = False
+    since: str = ""
+    tail: int = -1
+    follow: bool = False
+    print_version: bool = False
+    init_containers: bool = False
+    # North-star extensions
+    match: list[str] = field(default_factory=list)
+    backend: str = "cpu"
+    stats: bool = False
+    cluster: str = "kube"
+
+
+USE = "klogs"
+SHORT = "Get logs from Pods, super fast! \U0001f680"
+LONG = (
+    "klogs is a CLI tool to get logs from Kubernetes Pods.\n"
+    "It is designed to be fast and efficient, and can get logs from "
+    "multiple Pods/Containers at once. Blazing fast. \U0001f525"
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog=USE, description=LONG, add_help=True)
+    p.add_argument("-n", "--namespace", default="", help="Select namespace")
+    p.add_argument(
+        "-l", "--label", action="append", default=[], dest="labels", help="Select label"
+    )
+    p.add_argument(
+        "-p", "--logpath", default=None, dest="log_path", help="Custom log path"
+    )
+    p.add_argument(
+        "--kubeconfig",
+        default="",
+        help="(optional) Absolute path to the kubeconfig file",
+    )
+    p.add_argument(
+        "-a",
+        "--all",
+        action="store_true",
+        dest="all_pods",
+        help="Get logs for all pods in the namespace",
+    )
+    p.add_argument(
+        "-s",
+        "--since",
+        default="",
+        help=(
+            "Only return logs newer than a relative duration like 5s, 2m, or 3h. "
+            "Defaults to all logs."
+        ),
+    )
+    p.add_argument(
+        "-t",
+        "--tail",
+        type=int,
+        default=-1,
+        help="Lines of the most recent log to save",
+    )
+    p.add_argument(
+        "-f",
+        "--follow",
+        action="store_true",
+        help="Specify if the logs should be streamed",
+    )
+    p.add_argument(
+        "-v",
+        "--version",
+        action="store_true",
+        dest="print_version",
+        help="Print the version of the tool",
+    )
+    p.add_argument(
+        "-i",
+        "--init",
+        action="store_true",
+        dest="init_containers",
+        help="Get logs for init containers",
+    )
+    # --- north-star extensions ---
+    p.add_argument(
+        "--match",
+        action="append",
+        default=[],
+        help="Only save log lines matching this regex (repeatable; a line "
+        "is kept if ANY pattern matches)",
+    )
+    p.add_argument(
+        "--backend",
+        choices=["cpu", "tpu"],
+        default="cpu",
+        help="Line-filter engine: host regex (cpu) or batch-NFA on TPU",
+    )
+    p.add_argument(
+        "--stats",
+        action="store_true",
+        help="Print lines/sec, matched %%, and batch-latency summary",
+    )
+    p.add_argument(
+        "--cluster",
+        choices=["kube", "fake"],
+        default="kube",
+        help="Cluster backend: real Kubernetes API or hermetic fake (demo/test)",
+    )
+    return p
+
+
+def parse_args(argv: list[str] | None = None) -> Options:
+    ns = build_parser().parse_args(argv)
+    return Options(
+        namespace=ns.namespace,
+        labels=list(ns.labels),
+        log_path=ns.log_path if ns.log_path is not None else default_log_path(),
+        kubeconfig=ns.kubeconfig,
+        all_pods=ns.all_pods,
+        since=ns.since,
+        tail=ns.tail,
+        follow=ns.follow,
+        print_version=ns.print_version,
+        init_containers=ns.init_containers,
+        match=list(ns.match),
+        backend=ns.backend,
+        stats=ns.stats,
+        cluster=ns.cluster,
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    """Process entry point (analog of main.go:8-10 + Execute, root.go:478-483)."""
+    opts = parse_args(argv)
+
+    # Version short-circuit before any other work (cmd/root.go:445-448).
+    if opts.print_version:
+        term.info("Version: %s", BUILD_VERSION)
+        return 0
+
+    from klogs_tpu.app import run
+
+    try:
+        return run(opts)
+    except term.FatalError:
+        return 1
+    except KeyboardInterrupt:
+        return 130
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,27 @@
+from klogs_tpu.ui.term import (
+    Printer,
+    blue,
+    colors_enabled,
+    error,
+    fatal,
+    gray,
+    green,
+    info,
+    red,
+    set_colors,
+    warning,
+)
+
+__all__ = [
+    "Printer",
+    "blue",
+    "colors_enabled",
+    "error",
+    "fatal",
+    "gray",
+    "green",
+    "info",
+    "red",
+    "set_colors",
+    "warning",
+]

@@ -1,0 +1,17 @@
+from klogs_tpu.utils.bytesize import convert_bytes
+from klogs_tpu.utils.duration import parse_duration
+from klogs_tpu.utils.naming import (
+    FILE_NAME_SEPARATOR,
+    default_log_path,
+    log_file_name,
+    split_log_file_name,
+)
+
+__all__ = [
+    "convert_bytes",
+    "parse_duration",
+    "FILE_NAME_SEPARATOR",
+    "default_log_path",
+    "log_file_name",
+    "split_log_file_name",
+]

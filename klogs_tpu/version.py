@@ -1,0 +1,11 @@
+"""Build version stamping.
+
+Reference parity: klogs stamps ``cmd.BuildVersion`` at link time via
+``-ldflags -X ...cmd.BuildVersion=<tag>`` (cmd/root.go:31-33,
+.github/workflows/release.yaml:65) and defaults to "development".
+The Python analog is an environment override at import time.
+"""
+
+import os
+
+BUILD_VERSION = os.environ.get("KLOGS_BUILD_VERSION", "development")

@@ -1,0 +1,27 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU platform *before* jax is first
+imported anywhere, so multi-chip sharding (mesh axes data x pattern) is
+exercised hermetically without TPU hardware, per SURVEY.md §4.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+from klogs_tpu.ui import term  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _no_colors():
+    """Deterministic plain output in tests unless a test opts in."""
+    term.set_colors(False)
+    yield
+    term.set_colors(None)

@@ -1,0 +1,54 @@
+"""CLI flag-surface parity tests (cmd/root.go:485-497)."""
+
+from klogs_tpu.cli import main, parse_args
+
+
+class TestFlagDefaults:
+    def test_defaults(self):
+        o = parse_args([])
+        assert o.namespace == ""
+        assert o.labels == []
+        assert o.kubeconfig == ""
+        assert o.all_pods is False
+        assert o.since == ""
+        assert o.tail == -1  # -1 sentinel = unlimited (cmd/root.go:492)
+        assert o.follow is False
+        assert o.print_version is False
+        assert o.init_containers is False
+        assert o.match == []
+        assert o.backend == "cpu"
+        assert o.cluster == "kube"
+
+    def test_default_logpath_timestamped(self):
+        o = parse_args([])
+        assert o.log_path.startswith("logs/")
+
+
+class TestFlagParsing:
+    def test_shorthands(self):
+        o = parse_args(
+            ["-n", "kube-system", "-l", "app=x", "-l", "tier=db", "-p", "/tmp/out",
+             "-a", "-s", "5m", "-t", "100", "-f", "-i"]
+        )
+        assert o.namespace == "kube-system"
+        # -l is repeatable; order preserved (union semantics, cmd/root.go:458-460)
+        assert o.labels == ["app=x", "tier=db"]
+        assert o.log_path == "/tmp/out"
+        assert o.all_pods and o.follow and o.init_containers
+        assert o.since == "5m"
+        assert o.tail == 100
+
+    def test_match_repeatable(self):
+        o = parse_args(["--match", "ERROR", "--match", r"timeout \d+ms"])
+        assert o.match == ["ERROR", r"timeout \d+ms"]
+
+    def test_backend_choices(self):
+        assert parse_args(["--backend", "tpu"]).backend == "tpu"
+
+
+class TestVersion:
+    def test_version_short_circuit(self, capsys):
+        # cmd/root.go:445-448: print version and exit 0 before any work
+        assert main(["-v"]) == 0
+        out = capsys.readouterr().out
+        assert "Version: development" in out
