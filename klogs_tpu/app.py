@@ -1,13 +1,270 @@
-"""Top-level run orchestration (analog of rootCmd.Run, cmd/root.go:442-474).
+"""Top-level run orchestration.
 
-Placeholder until the fan-out runtime lands; fails cleanly instead of
-tracebacking.
+Reference parity: rootCmd.Run (cmd/root.go:442-474) — splash, client +
+namespace config, pod selection (label union vs interactive/all), log
+fan-out, wait-or-keypress, final size table. Structured as testable
+functions over an injected ClusterBackend instead of the reference's
+package globals (cmd/root.go:36-49).
 """
 
+import asyncio
+import os
+import threading
+from typing import Iterable
+
 from klogs_tpu.cli import Options
-from klogs_tpu.ui import term
+from klogs_tpu.cluster.backend import ClusterBackend
+from klogs_tpu.cluster.types import LogOptions, PodInfo
+from klogs_tpu.runtime.fanout import FanoutRunner, StreamJob, plan_jobs
+from klogs_tpu.ui import interactive, term, widgets
+from klogs_tpu.utils import convert_bytes, parse_duration, split_log_file_name
+from klogs_tpu.utils.duration import DurationError
+
+
+def make_backend(opts: Options) -> ClusterBackend:
+    if opts.cluster == "fake":
+        from klogs_tpu.cluster.fake import FakeCluster
+
+        n_pods = int(os.environ.get("KLOGS_FAKE_PODS", "6"))
+        n_containers = int(os.environ.get("KLOGS_FAKE_CONTAINERS", "2"))
+        n_lines = int(os.environ.get("KLOGS_FAKE_LINES", "300"))
+        fc = FakeCluster.synthetic(
+            n_pods=n_pods, n_containers=n_containers, lines_per_container=n_lines
+        )
+        fc.add_namespace("kube-system")
+        return fc
+
+    from klogs_tpu.cluster.kube import KubeBackend
+
+    return KubeBackend.from_kubeconfig(opts.kubeconfig)
+
+
+async def resolve_namespace(
+    backend: ClusterBackend, opts: Options,
+    select_keys: Iterable[str] | None = None,
+) -> str:
+    """configNamespace analog (cmd/root.go:90-103): explicit -n, else the
+    kubeconfig current-context namespace; verify existence; on miss, warn
+    and fall into the interactive picker (selection not re-validated,
+    SURVEY.md §3.4)."""
+    namespace = opts.namespace
+    if not namespace:
+        context, namespace = backend.current_context()
+        term.info("Using Context %s", term.green(context))
+    if not await backend.namespace_exists(namespace):
+        term.warning("Namespace %s not found", namespace)
+        names = await backend.list_namespaces()
+        namespace = interactive.interactive_select(
+            names, "Select a Namespace", keys=select_keys
+        )
+    term.info("Using Namespace %s", term.green(namespace))
+    return namespace
+
+
+async def select_pods(
+    backend: ClusterBackend, namespace: str, opts: Options,
+    select_keys: Iterable[str] | None = None,
+) -> list[PodInfo]:
+    """Pod selection: label union (cmd/root.go:455-461) or
+    listAllPods with Ready filter + optional multiselect (cmd/root.go:126-164)."""
+    if opts.labels:
+        pods: list[PodInfo] = []
+        for label in opts.labels:
+            term.info("Getting Pods with label %s\n", term.green(label))
+            found = await backend.list_pods(namespace, label_selector=label)
+            if not found:
+                term.error(
+                    "No pods found in namespace %s with label %s\n", namespace, label
+                )
+            # Union semantics, no dedup across labels (cmd/root.go:458-460).
+            pods.extend(found)
+        return pods
+
+    all_pods = await backend.list_pods(namespace)
+    ready = [p for p in all_pods if p.ready]  # cmd/root.go:137-143
+    if not ready:
+        term.error("No pods found in namespace %s", namespace)
+        return []
+    if not opts.all_pods:
+        by_name = {p.name: p for p in ready}
+        chosen = interactive.interactive_multiselect(
+            [p.name for p in ready], "Select Pods to get logs", keys=select_keys
+        )
+        if not chosen:
+            term.error("No pods selected")
+            return []
+        return [by_name[n] for n in chosen]
+    return ready
+
+
+def build_log_options(opts: Options) -> LogOptions:
+    """getLopOpts analog (cmd/root.go:201-221)."""
+    lo = LogOptions(follow=opts.follow)
+    if opts.since:
+        try:
+            lo.since_seconds = int(parse_duration(opts.since))
+        except DurationError as e:
+            term.fatal("%s", e)
+    if opts.tail != -1:
+        lo.tail_lines = opts.tail
+    return lo
+
+
+def print_plan(pods: list[PodInfo], jobs: list[StreamJob]) -> None:
+    """The pod/container tree + counts (cmd/root.go:231-274)."""
+    term.info(
+        "Found %s Pod(s) %s Container(s)",
+        term.green(str(len(pods))), term.green(str(len(jobs))),
+    )
+    jobs_by_pod: dict[str, list[StreamJob]] = {}
+    for j in jobs:
+        jobs_by_pod.setdefault(j.pod, []).append(j)
+    for i, pod in enumerate(pods):
+        children = [
+            j.container + (term.gray(" [init]") if j.init else "")
+            for j in jobs_by_pod.get(pod.name, [])
+        ]
+        widgets.render_tree(f"{pod.name} {term.blue(f'[Pod #{i + 1}]')}", children)
+    term.info("Acquiring logs \U0001f680")
+
+
+def print_log_size(log_files: list[str], log_path: str) -> None:
+    """printLogSize analog (cmd/root.go:279-309)."""
+    if not log_files:
+        term.error("No logs saved")
+        return
+    term.info("Logs saved to %s", term.green(log_path))
+    table = [["Pod", "Container", "Size"]]
+    previous_pod = ""
+    for path in log_files:
+        try:
+            size = os.stat(path).st_size
+        except OSError:
+            continue  # soft-skip, cmd/root.go:292-293
+        pod, container = split_log_file_name(path)
+        label = term.gray(pod) if pod == previous_pod else pod
+        table.append([label, container, convert_bytes(size)])
+        previous_pod = pod
+    widgets.render_table(table)
+
+
+async def _watch_for_quit(
+    stop: asyncio.Event, log_path: str, done: "threading.Event"
+) -> None:
+    """pressKeyToExit analog (cmd/root.go:399-421): open the controlling
+    terminal (go-tty opens /dev/tty, not stdin), raw-mode key loop until
+    q/Q under a spinner, then trigger explicit shutdown.
+
+    Improvements over the reference: without a controlling terminal we
+    warn and stop streaming rather than panicking (root.go:402-403), and
+    the reader polls ``done`` so the thread exits (restoring the
+    terminal) when the streams finish on their own."""
+    loop = asyncio.get_running_loop()
+
+    def read_q() -> None:
+        import select
+        import termios
+        import tty
+
+        with open("/dev/tty", "rb", buffering=0) as t:
+            fd = t.fileno()
+            old = termios.tcgetattr(fd)
+            try:
+                tty.setcbreak(fd)
+                while not done.is_set():
+                    r, _, _ = select.select([fd], [], [], 0.2)
+                    if r and t.read(1) in (b"q", b"Q"):
+                        return
+            finally:
+                termios.tcsetattr(fd, termios.TCSADRAIN, old)
+
+    try:
+        async with widgets.Spinner(
+            f"Press {term.green('q')} to stop streaming logs in {term.green(log_path)}"
+        ):
+            await loop.run_in_executor(None, read_q)
+    except Exception as e:  # no controlling tty, termios failure
+        term.warning("No controlling terminal for q-to-quit (%s); stopping", e)
+    stop.set()
+
+
+def make_pipeline_for(opts: Options):
+    """The --match filter pipeline (None = unfiltered reference path)."""
+    if not opts.match:
+        return None
+    import re as _re
+
+    from klogs_tpu.filters.sink import make_pipeline
+
+    try:
+        return make_pipeline(opts.match, opts.backend)
+    except _re.error as e:
+        term.fatal("invalid --match pattern %r: %s", e.pattern, e)
+    except ImportError as e:
+        term.fatal("--backend %s is unavailable: %s", opts.backend, e)
+
+
+async def run_async(
+    opts: Options,
+    backend: ClusterBackend | None = None,
+    stop: asyncio.Event | None = None,
+    select_keys: Iterable[str] | None = None,
+) -> int:
+    widgets.splash_screen()
+    backend = backend or make_backend(opts)
+    try:
+        namespace = await resolve_namespace(backend, opts, select_keys)
+        pods = await select_pods(backend, namespace, opts, select_keys)
+        log_opts = build_log_options(opts)
+        jobs = plan_jobs(pods, opts.log_path, opts.init_containers)
+        log_files = [j.path for j in jobs]
+        if jobs:
+            print_plan(pods, jobs)
+
+        pipeline = make_pipeline_for(opts)
+        runner = FanoutRunner(
+            backend, namespace, log_opts,
+            sink_factory=pipeline.sink_factory if pipeline else None,
+        )
+        if opts.follow and jobs:
+            flusher = (
+                asyncio.create_task(pipeline.run_deadline_flusher())
+                if pipeline is not None else None
+            )
+            if stop is None:
+                stop = asyncio.Event()
+                watcher_done = threading.Event()
+                watcher = asyncio.create_task(
+                    _watch_for_quit(stop, opts.log_path, watcher_done)
+                )
+            else:
+                watcher = watcher_done = None
+            try:
+                await runner.run(jobs, stop=stop)
+            finally:
+                if watcher is not None:
+                    # Unblock the /dev/tty reader thread so the terminal
+                    # is restored and the process can exit.
+                    watcher_done.set()
+                    await watcher
+                if flusher is not None:
+                    flusher.cancel()
+                    try:
+                        await flusher
+                    except asyncio.CancelledError:
+                        pass
+        else:
+            await runner.run(jobs)
+
+        print_log_size(log_files, opts.log_path)
+        if pipeline is not None:
+            if opts.stats:
+                pipeline.print_summary()
+            pipeline.close()
+        return 0
+    finally:
+        await backend.close()
 
 
 def run(opts: Options) -> int:
-    term.fatal("log acquisition is not implemented yet in this build")
-    raise AssertionError("unreachable")  # fatal() always raises
+    return asyncio.run(run_async(opts))

@@ -176,10 +176,14 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     from klogs_tpu.app import run
+    from klogs_tpu.ui.interactive import NotInteractive
 
     try:
         return run(opts)
     except term.FatalError:
+        return 1
+    except NotInteractive as e:
+        term.error("%s", e)
         return 1
     except KeyboardInterrupt:
         return 130

@@ -1,0 +1,19 @@
+from klogs_tpu.cluster.backend import (
+    ClusterBackend,
+    ClusterError,
+    LogStream,
+    NamespaceNotFound,
+    StreamError,
+)
+from klogs_tpu.cluster.types import ContainerInfo, LogOptions, PodInfo
+
+__all__ = [
+    "ClusterBackend",
+    "ClusterError",
+    "LogStream",
+    "NamespaceNotFound",
+    "StreamError",
+    "ContainerInfo",
+    "LogOptions",
+    "PodInfo",
+]

@@ -1,0 +1,89 @@
+"""ClusterBackend: the seam between the CLI and any cluster.
+
+The reference talks to the Kubernetes apiserver directly through a
+package-global client-go Clientset (cmd/root.go:38,69-87) — untestable
+without a cluster (SURVEY.md §4). This interface is the dependency-
+injection point: the real REST-backed client and the hermetic
+FakeCluster both implement it, so everything above (pod selection,
+fan-out, filtering, sinks) is testable without any cluster.
+
+All methods are async: the fan-out runtime is an asyncio event loop
+(the goroutine analog, cmd/root.go:248-261).
+"""
+
+import abc
+from typing import AsyncIterator
+
+from klogs_tpu.cluster.types import LogOptions, PodInfo
+
+
+class ClusterError(Exception):
+    """A cluster-access failure (apiserver error analog)."""
+
+
+class NamespaceNotFound(ClusterError):
+    pass
+
+
+class StreamError(ClusterError):
+    """Opening or reading a log stream failed (cmd/root.go:326-329 analog)."""
+
+
+class LogStream(abc.ABC):
+    """One container's log stream: an async iterator of byte chunks.
+
+    The analog of the reference's io.ReadCloser from GetLogs(...).Stream
+    (cmd/root.go:322-325): raw chunked bytes, line boundaries not
+    guaranteed to align with chunk boundaries.
+    """
+
+    @abc.abstractmethod
+    def __aiter__(self) -> AsyncIterator[bytes]: ...
+
+    @abc.abstractmethod
+    async def close(self) -> None: ...
+
+    async def __aenter__(self) -> "LogStream":
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+
+class ClusterBackend(abc.ABC):
+    @abc.abstractmethod
+    def current_context(self) -> tuple[str, str]:
+        """Return (context_name, default_namespace) — getCurrentNamespace
+        analog (cmd/root.go:185-198); default_namespace falls back to
+        "default" when the context has none."""
+
+    @abc.abstractmethod
+    async def list_namespaces(self) -> list[str]:
+        """All namespace names (cmd/root.go:106-115)."""
+
+    @abc.abstractmethod
+    async def namespace_exists(self, namespace: str) -> bool:
+        """Namespaces().Get analog (cmd/root.go:96)."""
+
+    @abc.abstractmethod
+    async def list_pods(
+        self, namespace: str, label_selector: str | None = None
+    ) -> list[PodInfo]:
+        """Pods(ns).List, optionally with a label selector
+        (cmd/root.go:128,380-381). Returns all pods regardless of
+        readiness; the Ready filter is applied by the caller, matching
+        the reference's client-side filtering (cmd/root.go:137-143)."""
+
+    @abc.abstractmethod
+    async def open_log_stream(
+        self, namespace: str, pod: str, opts: LogOptions
+    ) -> LogStream:
+        """GetLogs(pod, opts).Stream analog (cmd/root.go:322-325).
+
+        ``opts.container`` must be set. since/tail/follow are applied
+        server-side (by the backend), mirroring kubelet semantics.
+        Raises StreamError on failure.
+        """
+
+    async def close(self) -> None:
+        """Release any transport resources."""

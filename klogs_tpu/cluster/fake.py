@@ -1,0 +1,293 @@
+"""FakeCluster: hermetic, deterministic in-memory cluster backend.
+
+The reference has no test double at all — every cluster-touching path is
+untested (SURVEY.md §4). This fake makes the whole pipeline testable and
+benchmarkable without a cluster: synthetic namespaces/pods/containers,
+deterministic log lines with timestamps, server-side since/tail/follow
+semantics mirroring kubelet behavior, controllable stream chunking, and
+fault injection (open failure, mid-stream cut, slow streams).
+"""
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import AsyncIterator, Callable
+
+from klogs_tpu.cluster.backend import ClusterBackend, LogStream, StreamError
+from klogs_tpu.cluster.types import (
+    ContainerInfo,
+    LogOptions,
+    PodInfo,
+    match_label_selector,
+)
+
+LEVELS = ("INFO", "DEBUG", "WARN", "ERROR")
+
+
+def synthetic_line(pod: str, container: str, seq: int, ts: float) -> bytes:
+    """One deterministic log line. Level cycles so a fixed fraction (1/4
+    each) matches typical test patterns; a few structured fields give
+    regexes something realistic to bite on."""
+    level = LEVELS[seq % len(LEVELS)]
+    tstr = time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(ts))
+    return (
+        f"{tstr} {level} pod={pod} container={container} seq={seq} "
+        f"latency={(seq * 7) % 500}ms code={200 + (seq % 5) * 100} "
+        f"msg=\"request {'failed' if level == 'ERROR' else 'handled'} "
+        f"path=/api/v{seq % 3}/items\"\n"
+    ).encode()
+
+
+@dataclass
+class Faults:
+    """Per-container fault injection for failure-path tests."""
+
+    fail_open: bool = False  # raise StreamError from open_log_stream
+    cut_after_lines: int | None = None  # clean EOF mid-history (premature end)
+    error_after_lines: int | None = None  # raise StreamError mid-stream
+    chunk_delay_s: float = 0.0  # slow stream
+
+
+@dataclass
+class FakeContainer:
+    name: str
+    init: bool = False
+    # Historical lines as (unix_ts, line_bytes); ts ascending.
+    lines: list[tuple[float, bytes]] = field(default_factory=list)
+    # Follow-mode generation: new line every interval_s until closed.
+    follow_interval_s: float = 0.01
+    faults: Faults = field(default_factory=Faults)
+    # Next sequence number for follow-mode generation.
+    next_seq: int = 0
+
+
+@dataclass
+class FakePod:
+    info: PodInfo
+    containers: dict[str, FakeContainer] = field(default_factory=dict)
+
+
+class FakeLogStream(LogStream):
+    """Chunked byte stream over selected + live-generated lines.
+
+    Chunk boundaries intentionally do NOT align with line boundaries
+    (chunk_size split), matching HTTP chunked transfer from the kubelet
+    (cmd/root.go:325) and exercising the line framer.
+    """
+
+    def __init__(
+        self,
+        container: FakeContainer,
+        pod_name: str,
+        opts: LogOptions,
+        clock: Callable[[], float],
+        chunk_size: int,
+    ):
+        self._c = container
+        self._pod = pod_name
+        self._opts = opts
+        self._clock = clock
+        self._chunk_size = chunk_size
+        self._closed = asyncio.Event()
+
+    async def close(self) -> None:
+        self._closed.set()
+
+    def _select_history(self) -> list[bytes]:
+        lines = self._c.lines
+        if self._opts.since_seconds is not None:
+            cutoff = self._clock() - self._opts.since_seconds
+            lines = [(ts, ln) for ts, ln in lines if ts >= cutoff]
+        if self._opts.tail_lines is not None and self._opts.tail_lines >= 0:
+            lines = lines[len(lines) - min(self._opts.tail_lines, len(lines)):]
+        return [ln for _, ln in lines]
+
+    async def _chunks(self) -> AsyncIterator[bytes]:
+        f = self._c.faults
+        emitted = 0
+        buf = bytearray()
+
+        async def flush_full():
+            nonlocal buf
+            while len(buf) >= self._chunk_size:
+                chunk = bytes(buf[: self._chunk_size])
+                del buf[: self._chunk_size]
+                if f.chunk_delay_s:
+                    await asyncio.sleep(f.chunk_delay_s)
+                yield chunk
+
+        for ln in self._select_history():
+            if f.cut_after_lines is not None and emitted >= f.cut_after_lines:
+                if buf:
+                    yield bytes(buf)
+                return  # clean EOF mid-stream (premature end)
+            if f.error_after_lines is not None and emitted >= f.error_after_lines:
+                if buf:
+                    yield bytes(buf)
+                raise StreamError(
+                    f"stream read error for {self._pod}/{self._c.name}"
+                )
+            buf += ln
+            emitted += 1
+            async for chunk in flush_full():
+                yield chunk
+                if self._closed.is_set():
+                    return
+
+        if buf:
+            yield bytes(buf)
+            buf.clear()
+
+        if not self._opts.follow:
+            return
+
+        # Follow mode: generate lines until the stream is closed.
+        while not self._closed.is_set():
+            try:
+                await asyncio.wait_for(
+                    self._closed.wait(), timeout=self._c.follow_interval_s
+                )
+                return
+            except asyncio.TimeoutError:
+                pass
+            if f.cut_after_lines is not None and emitted >= f.cut_after_lines:
+                return
+            seq = self._c.next_seq
+            self._c.next_seq += 1
+            line = synthetic_line(self._pod, self._c.name, seq, self._clock())
+            emitted += 1
+            yield line
+
+    def __aiter__(self) -> AsyncIterator[bytes]:
+        return self._chunks()
+
+
+class FakeCluster(ClusterBackend):
+    def __init__(
+        self,
+        context_name: str = "fake-context",
+        default_namespace: str = "default",
+        clock: Callable[[], float] = time.time,
+        chunk_size: int = 4096,
+    ):
+        self.context_name = context_name
+        self.default_namespace = default_namespace
+        self.clock = clock
+        self.chunk_size = chunk_size
+        # namespace -> pod name -> FakePod
+        self.namespaces: dict[str, dict[str, FakePod]] = {}
+
+    # ---- construction helpers -------------------------------------------
+
+    def add_namespace(self, name: str) -> None:
+        self.namespaces.setdefault(name, {})
+
+    def add_pod(
+        self,
+        namespace: str,
+        name: str,
+        containers: list[str] | None = None,
+        init_containers: list[str] | None = None,
+        labels: dict[str, str] | None = None,
+        ready: bool = True,
+        lines_per_container: int = 0,
+        follow_interval_s: float = 0.01,
+        line_spacing_s: float = 1.0,
+    ) -> FakePod:
+        self.add_namespace(namespace)
+        containers = containers if containers is not None else ["main"]
+        init_containers = init_containers or []
+        info = PodInfo(
+            name=name,
+            namespace=namespace,
+            labels=dict(labels or {}),
+            ready=ready,
+            containers=[ContainerInfo(c) for c in containers],
+            init_containers=[ContainerInfo(c, init=True) for c in init_containers],
+        )
+        pod = FakePod(info=info)
+        now = self.clock()
+        for cname in init_containers + containers:
+            fc = FakeContainer(
+                name=cname,
+                init=cname in init_containers,
+                follow_interval_s=follow_interval_s,
+            )
+            # Historical lines: spaced line_spacing_s apart, newest at ~now.
+            n = lines_per_container
+            for i in range(n):
+                ts = now - (n - 1 - i) * line_spacing_s
+                fc.lines.append((ts, synthetic_line(name, cname, i, ts)))
+            fc.next_seq = n
+            pod.containers[cname] = fc
+        self.namespaces[namespace][name] = pod
+        return pod
+
+    @classmethod
+    def synthetic(
+        cls,
+        n_pods: int,
+        n_containers: int = 1,
+        lines_per_container: int = 100,
+        namespace: str = "default",
+        n_not_ready: int = 0,
+        labels_for: Callable[[int], dict[str, str]] | None = None,
+        follow_interval_s: float = 0.01,
+        **kw,
+    ) -> "FakeCluster":
+        """Deterministic synthetic cluster: pod-0000..pod-NNNN."""
+        fc = cls(**kw)
+        fc.add_namespace(namespace)
+        for p in range(n_pods):
+            labels = labels_for(p) if labels_for else {"app": f"app-{p % 4}"}
+            fc.add_pod(
+                namespace,
+                f"pod-{p:04d}",
+                containers=[f"c{c}" for c in range(n_containers)],
+                labels=labels,
+                ready=p >= n_not_ready,
+                lines_per_container=lines_per_container,
+                follow_interval_s=follow_interval_s,
+            )
+        return fc
+
+    # ---- ClusterBackend -------------------------------------------------
+
+    def current_context(self) -> tuple[str, str]:
+        return self.context_name, self.default_namespace
+
+    async def list_namespaces(self) -> list[str]:
+        return sorted(self.namespaces)
+
+    async def namespace_exists(self, namespace: str) -> bool:
+        return namespace in self.namespaces
+
+    async def list_pods(
+        self, namespace: str, label_selector: str | None = None
+    ) -> list[PodInfo]:
+        pods = self.namespaces.get(namespace, {})
+        out = []
+        for pod in pods.values():
+            if label_selector and not match_label_selector(
+                pod.info.labels, label_selector
+            ):
+                continue
+            out.append(pod.info)
+        return out
+
+    async def open_log_stream(
+        self, namespace: str, pod: str, opts: LogOptions
+    ) -> LogStream:
+        try:
+            fp = self.namespaces[namespace][pod]
+            fc = fp.containers[opts.container]
+        except KeyError as e:
+            raise StreamError(
+                f"container {opts.container!r} of pod {pod!r} "
+                f"in namespace {namespace!r} not found"
+            ) from e
+        if fc.faults.fail_open:
+            raise StreamError(
+                f"error getting logs for container {opts.container}: injected"
+            )
+        return FakeLogStream(fc, pod, opts, self.clock, self.chunk_size)

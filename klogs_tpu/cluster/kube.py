@@ -1,0 +1,15 @@
+"""Real Kubernetes backend (stdlib REST client). Placeholder until the
+transport lands; --cluster fake is fully functional."""
+
+from klogs_tpu.cluster.backend import ClusterBackend
+from klogs_tpu.ui import term
+
+
+class KubeBackend(ClusterBackend):
+    @classmethod
+    def from_kubeconfig(cls, kubeconfig: str) -> "KubeBackend":
+        term.fatal(
+            "the real Kubernetes backend is not implemented yet in this build; "
+            "use --cluster fake"
+        )
+        raise AssertionError("unreachable")

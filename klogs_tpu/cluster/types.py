@@ -1,0 +1,67 @@
+"""Cluster data model.
+
+Minimal projections of the Kubernetes objects klogs touches:
+pods with ready-state + containers (cmd/root.go:126-164,240-262) and
+the server-side log options (v1.PodLogOptions subset used at
+cmd/root.go:201-221: SinceSeconds, TailLines, Follow, Container).
+"""
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class ContainerInfo:
+    name: str
+    init: bool = False  # init containers gated behind -i (cmd/root.go:240-251)
+
+
+@dataclass
+class PodInfo:
+    name: str
+    namespace: str
+    labels: dict[str, str] = field(default_factory=dict)
+    ready: bool = True  # PodReady==True condition (cmd/root.go:137-143)
+    containers: list[ContainerInfo] = field(default_factory=list)
+    init_containers: list[ContainerInfo] = field(default_factory=list)
+
+
+@dataclass
+class LogOptions:
+    """Server-side log options; the backend (kubelet analog) applies them."""
+
+    since_seconds: int | None = None
+    tail_lines: int | None = None
+    follow: bool = False
+    container: str = ""
+
+
+def match_label_selector(labels: dict[str, str], selector: str) -> bool:
+    """Kubernetes equality-based label selector: "k=v,k2=v2" (also k==v, k!=v).
+
+    The reference passes the -l value verbatim as ListOptions.LabelSelector
+    (cmd/root.go:380-381); the apiserver implements the matching. The fake
+    backend needs its own implementation of the equality subset.
+    """
+    for term_ in selector.split(","):
+        term_ = term_.strip()
+        if not term_:
+            continue
+        if "!=" in term_:
+            k, v = term_.split("!=", 1)
+            if labels.get(k.strip()) == v.strip():
+                return False
+        elif "==" in term_:
+            k, v = term_.split("==", 1)
+            if labels.get(k.strip()) != v.strip():
+                return False
+        elif "=" in term_:
+            k, v = term_.split("=", 1)
+            if labels.get(k.strip()) != v.strip():
+                return False
+        else:  # bare key: existence
+            if term_.startswith("!"):
+                if term_[1:].strip() in labels:
+                    return False
+            elif term_ not in labels:
+                return False
+    return True

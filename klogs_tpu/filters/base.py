@@ -1,0 +1,80 @@
+"""LogFilter interface and shared statistics.
+
+This is the new layer the north star inserts at the reference's write
+boundary (between the stream read at cmd/root.go:325 and the buffered
+file write at cmd/root.go:366): lines go in, a keep/drop verdict per
+line comes out, and only kept lines reach the sink.
+
+Implementations:
+- RegexFilter (klogs_tpu.filters.cpu): host-side ``re`` engine, the
+  CPU baseline (≙ the Go ``regexp`` path in the north star).
+- NFAEngineFilter (klogs_tpu.filters.tpu): bit-parallel batch NFA under
+  JAX, with jnp and Pallas execution paths.
+
+A line "matches" when ANY of the K patterns matches anywhere in the
+line (re.search semantics, unanchored).
+"""
+
+import abc
+import random
+import time
+from dataclasses import dataclass, field
+
+# Bounded reservoir so a long-lived follow session keeps constant memory
+# while p50/p99 stay statistically sound.
+_LATENCY_RESERVOIR = 8192
+
+
+@dataclass
+class FilterStats:
+    """Aggregate counters across all streams, for the --stats summary
+    and the north-star metrics (lines/sec, matched %, batch latency)."""
+
+    lines_in: int = 0
+    lines_matched: int = 0
+    bytes_in: int = 0
+    bytes_out: int = 0
+    batches: int = 0
+    batch_latencies_s: list[float] = field(default_factory=list)
+    started_at: float = field(default_factory=time.perf_counter)
+    _rng: random.Random = field(default_factory=lambda: random.Random(0))
+
+    def record_batch(self, n_lines: int, n_matched: int, n_bytes_in: int,
+                     n_bytes_out: int, latency_s: float) -> None:
+        self.lines_in += n_lines
+        self.lines_matched += n_matched
+        self.bytes_in += n_bytes_in
+        self.bytes_out += n_bytes_out
+        self.batches += 1
+        if len(self.batch_latencies_s) < _LATENCY_RESERVOIR:
+            self.batch_latencies_s.append(latency_s)
+        else:  # reservoir sampling: uniform over all batches so far
+            j = self._rng.randrange(self.batches)
+            if j < _LATENCY_RESERVOIR:
+                self.batch_latencies_s[j] = latency_s
+
+    def percentile_latency_s(self, q: float) -> float:
+        if not self.batch_latencies_s:
+            return 0.0
+        xs = sorted(self.batch_latencies_s)
+        idx = min(len(xs) - 1, max(0, round(q / 100 * (len(xs) - 1))))
+        return xs[idx]
+
+    def lines_per_sec(self) -> float:
+        elapsed = time.perf_counter() - self.started_at
+        return self.lines_in / elapsed if elapsed > 0 else 0.0
+
+    def matched_pct(self) -> float:
+        return 100.0 * self.lines_matched / self.lines_in if self.lines_in else 0.0
+
+
+class LogFilter(abc.ABC):
+    """K-pattern any-match line filter."""
+
+    @abc.abstractmethod
+    def match_lines(self, lines: list[bytes]) -> list[bool]:
+        """One verdict per line; True = keep. Lines include no trailing
+        newline requirement — implementations must tolerate either."""
+
+    def close(self) -> None:
+        """Release engine resources (device buffers, transports)."""

@@ -1,0 +1,26 @@
+"""Host-regex LogFilter — the CPU baseline.
+
+The north-star analog of klogs + Go ``regexp``: every line is tested
+against K compiled patterns with re.search; a line is kept if any
+pattern matches. This is both the default ``--backend=cpu`` engine and
+the correctness oracle / performance baseline for the TPU path.
+"""
+
+import re
+
+from klogs_tpu.filters.base import LogFilter
+
+
+class RegexFilter(LogFilter):
+    def __init__(self, patterns: list[str]):
+        if not patterns:
+            raise ValueError("RegexFilter needs at least one pattern")
+        self._compiled = [re.compile(p.encode()) for p in patterns]
+
+    def match_lines(self, lines: list[bytes]) -> list[bool]:
+        compiled = self._compiled
+        out = []
+        for line in lines:
+            body = line.rstrip(b"\n")
+            out.append(any(p.search(body) for p in compiled))
+        return out

@@ -1,0 +1,32 @@
+"""Incremental line framing.
+
+Upstream chunks come from HTTP chunked transfer (cmd/root.go:325 analog)
+so line boundaries never align with chunk boundaries. The framer turns a
+chunk sequence into complete lines (newline retained) plus a final
+unterminated remainder at flush.
+
+A pure-Python implementation; a C-extension fast path can slot in here
+for the host-side hot loop (the reference's one native aspect is being a
+compiled binary, SURVEY.md §2).
+"""
+
+
+class LineFramer:
+    def __init__(self) -> None:
+        self._rest = b""
+
+    def feed(self, chunk: bytes) -> list[bytes]:
+        """Returns the complete lines made available by this chunk, each
+        including its trailing newline."""
+        data = self._rest + chunk if self._rest else chunk
+        if b"\n" not in data:
+            self._rest = data
+            return []
+        body, _, rest = data.rpartition(b"\n")
+        self._rest = rest
+        return [ln + b"\n" for ln in body.split(b"\n")]
+
+    def flush(self) -> bytes | None:
+        """The final unterminated line, if any (stream ended mid-line)."""
+        rest, self._rest = self._rest, b""
+        return rest if rest else None

@@ -1,0 +1,176 @@
+"""FilteredSink: the write-gating stage.
+
+Sits exactly where the reference writes bytes to disk
+(writeLogToDisk, cmd/root.go:359-374), but frames chunks into lines,
+asks a LogFilter for a keep-mask, and writes only kept lines — in
+the original per-file order (matching is batched, writes are ordered).
+
+Batching policy: lines accumulate until ``batch_lines`` is reached, then
+one filter call covers them (amortizing engine overhead — essential for
+the TPU path). ``deadline_s`` bounds how long a pending line can wait in
+follow mode; the deadline is enforced on the next write and by the
+runner's periodic flush.
+"""
+
+import asyncio
+import time
+from dataclasses import dataclass
+from dataclasses import field as dataclasses_field
+from typing import Callable
+
+from klogs_tpu.filters.base import FilterStats, LogFilter
+from klogs_tpu.filters.framer import LineFramer
+from klogs_tpu.runtime.fanout import StreamJob
+from klogs_tpu.runtime.sink import FileSink, Sink
+from klogs_tpu.ui import term
+
+
+class FilteredSink(Sink):
+    def __init__(
+        self,
+        inner: Sink,
+        log_filter: LogFilter,
+        stats: FilterStats,
+        batch_lines: int = 1024,
+        deadline_s: float = 0.05,
+        on_close: "Callable[[FilteredSink], None] | None" = None,
+    ):
+        self._inner = inner
+        self._filter = log_filter
+        self._stats = stats
+        self._framer = LineFramer()
+        self._pending: list[bytes] = []
+        self._pending_since: float | None = None
+        self._batch_lines = batch_lines
+        self._deadline_s = deadline_s
+        self._on_close = on_close
+        self._closed = False
+
+    async def write(self, chunk: bytes) -> None:
+        lines = self._framer.feed(chunk)
+        if lines:
+            if not self._pending:
+                self._pending_since = time.perf_counter()
+            self._pending.extend(lines)
+        if len(self._pending) >= self._batch_lines or (
+            self._pending
+            and self._pending_since is not None
+            and time.perf_counter() - self._pending_since >= self._deadline_s
+        ):
+            await self._flush_pending()
+
+    async def _flush_pending(self) -> None:
+        pending, self._pending = self._pending, []
+        self._pending_since = None
+        if not pending:
+            return
+        t0 = time.perf_counter()
+        mask = self._filter.match_lines(pending)
+        kept = [ln for ln, keep in zip(pending, mask) if keep]
+        latency = time.perf_counter() - t0
+        bytes_out = 0
+        for ln in kept:
+            await self._inner.write(ln)
+            bytes_out += len(ln)
+        self._stats.record_batch(
+            n_lines=len(pending),
+            n_matched=len(kept),
+            n_bytes_in=sum(len(ln) for ln in pending),
+            n_bytes_out=bytes_out,
+            latency_s=latency,
+        )
+
+    async def flush_if_stale(self) -> None:
+        """Flush pending lines whose deadline has passed (called by the
+        pipeline's periodic follow-mode flusher)."""
+        if (
+            self._pending
+            and self._pending_since is not None
+            and time.perf_counter() - self._pending_since >= self._deadline_s
+        ):
+            await self._flush_pending()
+            # Live tailing: matched lines must reach the file, not sit in
+            # the inner sink's write buffer.
+            await self._inner.flush()
+
+    async def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._on_close is not None:
+            self._on_close(self)
+        rest = self._framer.flush()
+        if rest is not None:
+            self._pending.append(rest)
+        await self._flush_pending()
+        await self._inner.close()
+
+    @property
+    def bytes_written(self) -> int:
+        return self._inner.bytes_written
+
+
+@dataclass
+class FilterPipeline:
+    """Shared engine + stats across all per-container sinks."""
+
+    log_filter: LogFilter
+    stats: FilterStats
+    batch_lines: int = 1024
+    deadline_s: float = 0.05
+    _live_sinks: "set[FilteredSink]" = dataclasses_field(default_factory=set)
+
+    def sink_factory(self, job: StreamJob) -> Sink:
+        sink = FilteredSink(
+            FileSink(job.path),
+            self.log_filter,
+            self.stats,
+            batch_lines=self.batch_lines,
+            deadline_s=self.deadline_s,
+            on_close=self._live_sinks.discard,
+        )
+        self._live_sinks.add(sink)
+        return sink
+
+    async def run_deadline_flusher(self) -> None:
+        """Follow-mode latency bound: periodically force pending lines in
+        every live sink through the filter, so a matching line from a
+        quiet container appears within ~deadline_s even if no further
+        chunks arrive. Run as a background task; cancel to stop."""
+        while True:
+            await asyncio.sleep(self.deadline_s / 2)
+            for sink in list(self._live_sinks):
+                await sink.flush_if_stale()
+
+    def close(self) -> None:
+        self.log_filter.close()
+
+    def print_summary(self) -> None:
+        s = self.stats
+        term.info(
+            "Filter stats: %d lines in, %d matched (%.1f%%), %.0f lines/sec, "
+            "batch latency p50=%.2fms p99=%.2fms (%d batches)",
+            s.lines_in, s.lines_matched, s.matched_pct(), s.lines_per_sec(),
+            s.percentile_latency_s(50) * 1e3, s.percentile_latency_s(99) * 1e3,
+            s.batches,
+        )
+
+
+def make_pipeline(patterns: list[str], backend: str,
+                  batch_lines: int = 1024, deadline_s: float = 0.05) -> FilterPipeline:
+    if backend == "cpu":
+        from klogs_tpu.filters.cpu import RegexFilter
+
+        log_filter: LogFilter = RegexFilter(patterns)
+    elif backend == "tpu":
+        from klogs_tpu.filters.tpu import NFAEngineFilter
+
+        log_filter = NFAEngineFilter(patterns)
+    else:
+        raise ValueError(f"unknown filter backend {backend!r}")
+    return FilterPipeline(
+        log_filter=log_filter,
+        stats=FilterStats(),
+        batch_lines=batch_lines,
+        deadline_s=deadline_s,
+    )

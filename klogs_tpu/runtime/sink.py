@@ -1,0 +1,57 @@
+"""Sinks: where stream bytes land.
+
+Reference parity: writeLogToDisk (cmd/root.go:359-374) — buffered
+chunked copy, NOT line-by-line (the v1.1.12 perf change,
+CHANGELOG.md:60-62), flushed at stream end. FileSink preserves exactly
+that: chunks go to a buffered file object untouched.
+
+The filter stage (north star) slots in as a different Sink
+implementation at this same boundary (see klogs_tpu.filters.sink),
+leaving the unfiltered path byte-identical to the reference.
+"""
+
+import abc
+
+
+class Sink(abc.ABC):
+    @abc.abstractmethod
+    async def write(self, chunk: bytes) -> None: ...
+
+    @abc.abstractmethod
+    async def close(self) -> None:
+        """Flush and release. Must be idempotent."""
+
+    async def flush(self) -> None:
+        """Push buffered bytes through (for live tailing); default no-op."""
+
+    @property
+    @abc.abstractmethod
+    def bytes_written(self) -> int: ...
+
+
+class FileSink(Sink):
+    """Buffered whole-stream copy to one log file (bufio analog)."""
+
+    def __init__(self, path: str, buffer_size: int = 1 << 16):
+        # os.Create semantics: truncate on open (cmd/root.go:349)
+        self._f = open(path, "wb", buffering=buffer_size)
+        self._bytes = 0
+        self._closed = False
+
+    async def write(self, chunk: bytes) -> None:
+        self._f.write(chunk)
+        self._bytes += len(chunk)
+
+    async def flush(self) -> None:
+        if not self._closed:
+            self._f.flush()
+
+    async def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._f.flush()
+            self._f.close()
+
+    @property
+    def bytes_written(self) -> int:
+        return self._bytes

@@ -1,0 +1,170 @@
+"""End-to-end runs of the app orchestration against FakeCluster:
+the minimum slice of SURVEY.md §7 step 3."""
+
+import asyncio
+import os
+
+import pytest
+
+from klogs_tpu import app
+from klogs_tpu.cli import parse_args
+from klogs_tpu.cluster.fake import FakeCluster
+
+
+def run_app(argv, backend, stop=None, select_keys=None):
+    opts = parse_args(argv)
+    return opts, asyncio.run(
+        app.run_async(opts, backend=backend, stop=stop, select_keys=select_keys)
+    )
+
+
+def make_cluster():
+    fc = FakeCluster.synthetic(n_pods=4, n_containers=2, lines_per_container=50)
+    fc.add_namespace("kube-system")
+    return fc
+
+
+class TestBatchMode:
+    def test_all_pods_tail(self, tmp_path, capsys):
+        out_dir = str(tmp_path / "logs")
+        _, rc = run_app(["-n", "default", "-a", "-t", "10", "-p", out_dir],
+                        make_cluster())
+        assert rc == 0
+        files = sorted(os.listdir(out_dir))
+        assert len(files) == 8
+        assert files[0] == "pod-0000__c0.log"
+        for f in files:
+            with open(os.path.join(out_dir, f), "rb") as fh:
+                assert len(fh.read().splitlines()) == 10
+        out = capsys.readouterr().out
+        assert "Found 4 Pod(s) 8 Container(s)" in out
+        assert "Using Namespace default" in out
+        assert "Logs saved to" in out
+        assert "│" in out  # boxed size table rendered
+
+    def test_label_selection_union(self, tmp_path, capsys):
+        out_dir = str(tmp_path / "logs")
+        # app-0 matches pod-0000, app-1 matches pod-0001 (4 pods, app-p%4)
+        _, rc = run_app(
+            ["-n", "default", "-l", "app=app-0", "-l", "app=app-1",
+             "-t", "5", "-p", out_dir],
+            make_cluster(),
+        )
+        assert rc == 0
+        assert sorted(os.listdir(out_dir)) == [
+            "pod-0000__c0.log", "pod-0000__c1.log",
+            "pod-0001__c0.log", "pod-0001__c1.log",
+        ]
+
+    def test_label_no_match_prints_error_continues(self, tmp_path, capsys):
+        out_dir = str(tmp_path / "logs")
+        _, rc = run_app(["-n", "default", "-l", "app=zzz", "-p", out_dir],
+                        make_cluster())
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "No pods found in namespace default with label app=zzz" in out
+        assert "No logs saved" in out
+
+    def test_namespace_miss_falls_to_picker(self, tmp_path, capsys):
+        out_dir = str(tmp_path / "logs")
+        # picker: down, enter -> selects second namespace ("kube-system"
+        # after "default" in sorted order)
+        _, rc = run_app(
+            ["-n", "missing-ns", "-a", "-p", out_dir],
+            make_cluster(),
+            select_keys=["down", "enter"],
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "Namespace missing-ns not found" in out
+        assert "Using Namespace kube-system" in out
+        assert "No pods found in namespace kube-system" in out
+
+    def test_interactive_pod_multiselect(self, tmp_path, capsys):
+        out_dir = str(tmp_path / "logs")
+        # select pod under cursor, move down, select, confirm -> 2 pods
+        _, rc = run_app(
+            ["-n", "default", "-t", "3", "-p", out_dir],
+            make_cluster(),
+            select_keys=["space", "down", "space", "enter"],
+        )
+        assert rc == 0
+        assert sorted(os.listdir(out_dir)) == [
+            "pod-0000__c0.log", "pod-0000__c1.log",
+            "pod-0001__c0.log", "pod-0001__c1.log",
+        ]
+
+    def test_interactive_none_selected(self, tmp_path, capsys):
+        out_dir = str(tmp_path / "logs")
+        _, rc = run_app(
+            ["-n", "default", "-p", out_dir],
+            make_cluster(),
+            select_keys=["enter"],
+        )
+        assert rc == 0
+        assert "No pods selected" in capsys.readouterr().out
+
+    def test_not_ready_pods_excluded(self, tmp_path):
+        out_dir = str(tmp_path / "logs")
+        fc = FakeCluster.synthetic(n_pods=3, n_not_ready=1, lines_per_container=5)
+        _, rc = run_app(["-n", "default", "-a", "-p", out_dir], fc)
+        assert rc == 0
+        assert not any("pod-0000" in f for f in os.listdir(out_dir))
+
+    def test_init_containers_flag(self, tmp_path):
+        out_dir = str(tmp_path / "logs")
+        fc = FakeCluster()
+        fc.add_pod("default", "web", containers=["app"],
+                   init_containers=["setup"], lines_per_container=5)
+        _, rc = run_app(["-n", "default", "-a", "-i", "-p", out_dir], fc)
+        assert rc == 0
+        assert sorted(os.listdir(out_dir)) == ["web__app.log", "web__setup.log"]
+        # without -i, init containers are skipped
+        out_dir2 = str(tmp_path / "logs2")
+        fc2 = FakeCluster()
+        fc2.add_pod("default", "web", containers=["app"],
+                    init_containers=["setup"], lines_per_container=5)
+        run_app(["-n", "default", "-a", "-p", out_dir2], fc2)
+        assert sorted(os.listdir(out_dir2)) == ["web__app.log"]
+
+    def test_bad_since_is_fatal(self, tmp_path):
+        with pytest.raises(SystemExit):
+            run_app(["-n", "default", "-a", "-s", "bogus",
+                     "-p", str(tmp_path)], make_cluster())
+
+    def test_since_filters(self, tmp_path):
+        out_dir = str(tmp_path / "logs")
+        fc = FakeCluster(clock=lambda: 1_000_000.0)
+        fc.add_pod("default", "web", containers=["c"], lines_per_container=30)
+        _, rc = run_app(["-n", "default", "-a", "-s", "10s", "-p", out_dir], fc)
+        assert rc == 0
+        with open(os.path.join(out_dir, "web__c.log"), "rb") as f:
+            assert len(f.read().splitlines()) == 11  # ts >= now-10, spaced 1s
+
+
+class TestFollowMode:
+    def test_follow_with_stop_event(self, tmp_path, capsys):
+        out_dir = str(tmp_path / "logs")
+        fc = FakeCluster.synthetic(
+            n_pods=2, n_containers=1, lines_per_container=5,
+            follow_interval_s=0.001)
+        opts = parse_args(["-n", "default", "-a", "-f", "-p", out_dir])
+
+        async def scenario():
+            stop = asyncio.Event()
+
+            async def trigger():
+                await asyncio.sleep(0.1)
+                stop.set()
+
+            t = asyncio.create_task(trigger())
+            rc = await app.run_async(opts, backend=fc, stop=stop)
+            await t
+            return rc
+
+        rc = asyncio.run(asyncio.wait_for(scenario(), timeout=10))
+        assert rc == 0
+        for f in os.listdir(out_dir):
+            with open(os.path.join(out_dir, f), "rb") as fh:
+                assert len(fh.read().splitlines()) > 5  # live lines landed
+        assert "Logs saved to" in capsys.readouterr().out

@@ -1,0 +1,197 @@
+"""FakeCluster behavior: listing, selectors, and server-side log options
+(since/tail/follow semantics of cmd/root.go:201-221), plus fault injection."""
+
+import asyncio
+
+import pytest
+
+from klogs_tpu.cluster.backend import StreamError
+from klogs_tpu.cluster.fake import FakeCluster, Faults
+from klogs_tpu.cluster.types import LogOptions, match_label_selector
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def read_all(stream) -> bytes:
+    out = bytearray()
+    async with stream:
+        async for chunk in stream:
+            out += chunk
+    return bytes(out)
+
+
+class TestListing:
+    def test_namespaces_and_pods(self):
+        fc = FakeCluster.synthetic(n_pods=5, lines_per_container=3)
+        fc.add_namespace("kube-system")
+        assert run(fc.list_namespaces()) == ["default", "kube-system"]
+        assert run(fc.namespace_exists("default"))
+        assert not run(fc.namespace_exists("nope"))
+        pods = run(fc.list_pods("default"))
+        assert [p.name for p in pods] == [f"pod-{i:04d}" for i in range(5)]
+
+    def test_label_selector(self):
+        fc = FakeCluster.synthetic(n_pods=8)  # app-0..app-3 cycling
+        pods = run(fc.list_pods("default", label_selector="app=app-1"))
+        assert [p.name for p in pods] == ["pod-0001", "pod-0005"]
+
+    def test_ready_flag(self):
+        fc = FakeCluster.synthetic(n_pods=4, n_not_ready=2)
+        pods = run(fc.list_pods("default"))
+        assert [p.ready for p in pods] == [False, False, True, True]
+
+    def test_current_context(self):
+        fc = FakeCluster()
+        assert fc.current_context() == ("fake-context", "default")
+
+
+class TestLabelSelectorMatching:
+    @pytest.mark.parametrize(
+        "labels,selector,expected",
+        [
+            ({"app": "x"}, "app=x", True),
+            ({"app": "x"}, "app==x", True),
+            ({"app": "x"}, "app=y", False),
+            ({"app": "x"}, "app!=y", True),
+            ({"app": "x"}, "app!=x", False),
+            ({"app": "x", "tier": "db"}, "app=x,tier=db", True),
+            ({"app": "x"}, "app=x,tier=db", False),
+            ({"app": "x"}, "app", True),
+            ({"app": "x"}, "tier", False),
+            ({"app": "x"}, "!tier", True),
+            ({"app": "x"}, "!app", False),
+        ],
+    )
+    def test_matching(self, labels, selector, expected):
+        assert match_label_selector(labels, selector) is expected
+
+
+class TestLogOptions:
+    def make(self, n_lines=10):
+        fc = FakeCluster(clock=lambda: 1_000_000.0, chunk_size=7)
+        fc.add_pod("default", "web", containers=["nginx"], lines_per_container=n_lines)
+        return fc
+
+    def test_full_history(self):
+        fc = self.make()
+        data = run(read_all(run(fc.open_log_stream(
+            "default", "web", LogOptions(container="nginx")))))
+        lines = data.splitlines()
+        assert len(lines) == 10
+        assert b"seq=0" in lines[0] and b"seq=9" in lines[-1]
+
+    def test_tail(self):
+        fc = self.make()
+        data = run(read_all(run(fc.open_log_stream(
+            "default", "web", LogOptions(container="nginx", tail_lines=3)))))
+        lines = data.splitlines()
+        assert len(lines) == 3
+        assert b"seq=7" in lines[0]
+
+    def test_tail_zero(self):
+        fc = self.make()
+        data = run(read_all(run(fc.open_log_stream(
+            "default", "web", LogOptions(container="nginx", tail_lines=0)))))
+        assert data == b""
+
+    def test_since(self):
+        # Lines spaced 1s apart ending at clock(); since=4s keeps ts >= now-4,
+        # i.e. the last 5 lines (seq 5..9).
+        fc = self.make()
+        data = run(read_all(run(fc.open_log_stream(
+            "default", "web", LogOptions(container="nginx", since_seconds=4)))))
+        lines = data.splitlines()
+        assert len(lines) == 5
+        assert b"seq=5" in lines[0]
+
+    def test_since_and_tail_compose(self):
+        fc = self.make()
+        data = run(read_all(run(fc.open_log_stream(
+            "default", "web",
+            LogOptions(container="nginx", since_seconds=4, tail_lines=2)))))
+        lines = data.splitlines()
+        assert len(lines) == 2
+        assert b"seq=8" in lines[0]
+
+    def test_chunk_boundaries_split_lines(self):
+        fc = self.make()
+        chunks = []
+
+        async def collect():
+            stream = await fc.open_log_stream(
+                "default", "web", LogOptions(container="nginx"))
+            async with stream:
+                async for c in stream:
+                    chunks.append(c)
+
+        run(collect())
+        assert len(chunks) > 10  # chunk_size=7 splits every line
+        assert all(len(c) <= 7 for c in chunks)
+
+    def test_missing_container_raises(self):
+        fc = self.make()
+        with pytest.raises(StreamError):
+            run(fc.open_log_stream("default", "web", LogOptions(container="zzz")))
+
+
+class TestFollow:
+    def test_follow_generates_until_closed(self):
+        fc = FakeCluster(clock=lambda: 1_000_000.0)
+        pod = fc.add_pod(
+            "default", "web", containers=["c"],
+            lines_per_container=2, follow_interval_s=0.001,
+        )
+        assert pod.containers["c"].next_seq == 2
+
+        async def scenario():
+            stream = await fc.open_log_stream(
+                "default", "web", LogOptions(container="c", follow=True))
+            got = bytearray()
+            async for chunk in stream:
+                got += chunk
+                if got.count(b"\n") >= 6:
+                    await stream.close()
+                    break
+            return bytes(got)
+
+        data = run(asyncio.wait_for(scenario(), timeout=5))
+        lines = data.splitlines()
+        assert len(lines) >= 6
+        assert b"seq=0" in lines[0]
+        assert b"seq=5" in lines[5]  # live lines continue the sequence
+
+
+class TestFaults:
+    def test_fail_open(self):
+        fc = FakeCluster()
+        pod = fc.add_pod("default", "web", containers=["c"], lines_per_container=1)
+        pod.containers["c"].faults = Faults(fail_open=True)
+        with pytest.raises(StreamError):
+            run(fc.open_log_stream("default", "web", LogOptions(container="c")))
+
+    def test_cut_mid_stream_is_clean_eof(self):
+        fc = FakeCluster()
+        pod = fc.add_pod("default", "web", containers=["c"], lines_per_container=10)
+        pod.containers["c"].faults = Faults(cut_after_lines=4)
+        data = run(read_all(run(fc.open_log_stream(
+            "default", "web", LogOptions(container="c")))))
+        assert len(data.splitlines()) == 4
+
+    def test_error_mid_stream(self):
+        fc = FakeCluster()
+        pod = fc.add_pod("default", "web", containers=["c"], lines_per_container=10)
+        pod.containers["c"].faults = Faults(error_after_lines=2)
+
+        async def scenario():
+            stream = await fc.open_log_stream(
+                "default", "web", LogOptions(container="c"))
+            got = bytearray()
+            with pytest.raises(StreamError):
+                async for chunk in stream:
+                    got += chunk
+            return bytes(got)
+
+        data = run(scenario())
+        assert len(data.splitlines()) == 2

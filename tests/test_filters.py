@@ -1,0 +1,165 @@
+"""LineFramer, RegexFilter, FilteredSink, and e2e --match runs."""
+
+import asyncio
+import os
+
+import pytest
+
+from klogs_tpu import app
+from klogs_tpu.cli import parse_args
+from klogs_tpu.cluster.fake import FakeCluster
+from klogs_tpu.filters.base import FilterStats
+from klogs_tpu.filters.cpu import RegexFilter
+from klogs_tpu.filters.framer import LineFramer
+from klogs_tpu.filters.sink import FilteredSink
+from klogs_tpu.runtime.sink import Sink
+
+
+class TestLineFramer:
+    def test_split_across_chunks(self):
+        f = LineFramer()
+        assert f.feed(b"hel") == []
+        assert f.feed(b"lo\nwor") == [b"hello\n"]
+        assert f.feed(b"ld\nrest") == [b"world\n"]
+        assert f.flush() == b"rest"
+        assert f.flush() is None
+
+    def test_multiple_lines_one_chunk(self):
+        f = LineFramer()
+        assert f.feed(b"a\nb\nc\n") == [b"a\n", b"b\n", b"c\n"]
+        assert f.flush() is None
+
+    def test_empty_lines_preserved(self):
+        f = LineFramer()
+        assert f.feed(b"a\n\nb\n") == [b"a\n", b"\n", b"b\n"]
+
+
+class TestRegexFilter:
+    def test_any_pattern_matches(self):
+        f = RegexFilter(["ERROR", r"latency=\d{3,}ms"])
+        lines = [b"ok INFO latency=5ms\n", b"bad ERROR x\n",
+                 b"slow INFO latency=450ms\n", b"nothing\n"]
+        assert f.match_lines(lines) == [False, True, True, False]
+
+    def test_empty_patterns_rejected(self):
+        with pytest.raises(ValueError):
+            RegexFilter([])
+
+
+class _MemSink(Sink):
+    def __init__(self):
+        self.data = bytearray()
+        self.closed = False
+
+    async def write(self, chunk):
+        self.data += chunk
+
+    async def close(self):
+        self.closed = True
+
+    @property
+    def bytes_written(self):
+        return len(self.data)
+
+
+class TestFilteredSink:
+    def test_gates_and_orders(self):
+        inner = _MemSink()
+        stats = FilterStats()
+        sink = FilteredSink(inner, RegexFilter(["keep"]), stats, batch_lines=4)
+
+        async def scenario():
+            await sink.write(b"keep 1\ndrop 1\nkee")
+            await sink.write(b"p 2\ndrop 2\nkeep 3\n")
+            await sink.close()
+
+        asyncio.run(scenario())
+        assert bytes(inner.data) == b"keep 1\nkeep 2\nkeep 3\n"
+        assert inner.closed
+        assert stats.lines_in == 5
+        assert stats.lines_matched == 3
+
+    def test_unterminated_final_line_filtered(self):
+        inner = _MemSink()
+        sink = FilteredSink(inner, RegexFilter(["keep"]), FilterStats())
+
+        async def scenario():
+            await sink.write(b"drop\nkeep tail-no-newline")
+            await sink.close()
+
+        asyncio.run(scenario())
+        assert bytes(inner.data) == b"keep tail-no-newline"
+
+
+class TestDeadlineFlusher:
+    def test_quiet_stream_flushes_within_deadline(self, tmp_path):
+        """A matching line from a container that then goes quiet must hit
+        the file within ~deadline_s, without waiting for batch_lines."""
+        from klogs_tpu.filters.sink import make_pipeline
+        from klogs_tpu.runtime.fanout import StreamJob
+
+        path = str(tmp_path / "web__c.log")
+        pipeline = make_pipeline(["ERROR"], "cpu", batch_lines=1024,
+                                 deadline_s=0.02)
+        job = StreamJob("web", "c", False, path)
+
+        async def scenario():
+            flusher = asyncio.create_task(pipeline.run_deadline_flusher())
+            sink = pipeline.sink_factory(job)
+            await sink.write(b"x ERROR y\n")  # far below batch_lines
+            await asyncio.sleep(0.1)  # no further chunks arrive
+            with open(path, "rb") as f:
+                on_disk_before_close = f.read()
+            await sink.close()
+            flusher.cancel()
+            return on_disk_before_close
+
+        data = asyncio.run(scenario())
+        assert data == b"x ERROR y\n"
+
+
+class TestMatchEndToEnd:
+    def run_app(self, argv, backend):
+        opts = parse_args(argv)
+        return asyncio.run(app.run_async(opts, backend=backend))
+
+    def test_match_gates_writes(self, tmp_path, capsys):
+        out_dir = str(tmp_path / "logs")
+        fc = FakeCluster.synthetic(n_pods=2, n_containers=1,
+                                   lines_per_container=40)
+        rc = self.run_app(
+            ["-n", "default", "-a", "--match", "ERROR", "-p", out_dir,
+             "--stats"], fc)
+        assert rc == 0
+        for f in os.listdir(out_dir):
+            with open(os.path.join(out_dir, f), "rb") as fh:
+                lines = fh.read().splitlines()
+            assert len(lines) == 10  # every 4th synthetic line is ERROR
+            assert all(b"ERROR" in ln for ln in lines)
+        assert "Filter stats:" in capsys.readouterr().out
+
+    def test_multiple_patterns_union(self, tmp_path):
+        out_dir = str(tmp_path / "logs")
+        fc = FakeCluster.synthetic(n_pods=1, n_containers=1,
+                                   lines_per_container=40)
+        rc = self.run_app(
+            ["-n", "default", "-a", "--match", "ERROR", "--match", "WARN",
+             "-p", out_dir], fc)
+        assert rc == 0
+        path = os.path.join(out_dir, "pod-0000__c0.log")
+        with open(path, "rb") as fh:
+            lines = fh.read().splitlines()
+        assert len(lines) == 20
+        assert all(b"ERROR" in ln or b"WARN" in ln for ln in lines)
+
+    def test_no_match_flag_is_byte_identical(self, tmp_path):
+        # Without --match the write path must remain a raw chunked copy.
+        out1 = str(tmp_path / "a")
+        fc1 = FakeCluster.synthetic(n_pods=1, lines_per_container=10)
+        self.run_app(["-n", "default", "-a", "-p", out1], fc1)
+        out2 = str(tmp_path / "b")
+        fc2 = FakeCluster.synthetic(n_pods=1, lines_per_container=10)
+        self.run_app(["-n", "default", "-a", "--match", ".", "-p", out2], fc2)
+        f1 = open(os.path.join(out1, "pod-0000__c0.log"), "rb").read()
+        f2 = open(os.path.join(out2, "pod-0000__c0.log"), "rb").read()
+        assert f1 == f2  # match-everything filter keeps every byte
