@@ -1,0 +1,18 @@
+"""--match pattern compiler: regex subset → Glushkov bit-parallel NFA
+arrays for the JAX/Pallas batch engine (SURVEY.md §2 'Pattern
+compiler' / §7 step 5)."""
+
+from klogs_tpu.filters.compiler.glushkov import (
+    NFAProgram,
+    compile_patterns,
+    reference_match,
+)
+from klogs_tpu.filters.compiler.parser import RegexSyntaxError, parse
+
+__all__ = [
+    "NFAProgram",
+    "RegexSyntaxError",
+    "compile_patterns",
+    "parse",
+    "reference_match",
+]

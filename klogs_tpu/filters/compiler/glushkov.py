@@ -1,0 +1,219 @@
+"""Glushkov position automaton → dense arrays for the TPU engine.
+
+Why Glushkov (and not Thompson/DFA): the position automaton has no
+epsilon transitions and the *defining* property that every state is
+entered only on its own symbol class. The whole per-character update
+therefore factors into a character-independent reachability step and a
+character-dependent mask:
+
+    v' = (reachable-from(v) | inject) & B[class(c)]
+
+With states packed along the 128-lane axis, ``reachable-from`` is a
+0/1 matmul ``v @ F`` on the MXU and ``B[class(c)]`` a tiny gather (or
+one-hot matmul) — exactly the shape TPUs like. A DFA would need
+data-dependent table walks (serial, gather-bound); Thompson NFAs need
+epsilon closure. See SURVEY.md §2 "Pattern compiler" row.
+
+Anchors arrive from the parser as BEGIN/END sentinel symbols; the
+engine feeds a virtual BEGIN before byte 0 and END after the last
+byte, so ^/$ need no special-casing here and nullability of the
+symbol-regex is exactly "matches every line" (match_all).
+
+Byte-class compression: bytes with identical membership across all
+position symbol-sets collapse to one class, so the character-mask
+table is [n_classes, S] with n_classes typically ≪ 256.
+"""
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from klogs_tpu.filters.compiler.parser import (
+    BEGIN,
+    END,
+    Alt,
+    Cat,
+    Epsilon,
+    RegexSyntaxError,
+    Star,
+    Sym,
+    parse,
+)
+
+MAX_UNION_POSITIONS = 4096
+
+
+@dataclass
+class NFAProgram:
+    """Dense automaton arrays, ready to pad + ship to the engine.
+
+    Class-id layout: 0..n_byte_classes-1 are byte classes (byte_class
+    maps each of the 256 byte values to one), then begin_class,
+    end_class, pad_class. pad_class has an all-zero row in char_mask so
+    padded tail positions kill all states while sticky `matched` holds.
+    """
+
+    n_states: int
+    n_classes: int
+    byte_class: np.ndarray  # [256] int32
+    begin_class: int
+    end_class: int
+    pad_class: int
+    char_mask: np.ndarray  # [n_classes, n_states] bool — B table
+    follow: np.ndarray  # [n_states, n_states] bool — F[i,j]: j in follow(i)
+    inject: np.ndarray  # [n_states] bool — firstpos(root), injected each step
+    accept: np.ndarray  # [n_states] bool — lastpos(root)
+    match_all: bool  # symbol-regex nullable → empty match everywhere
+    patterns: tuple  # the source pattern strings, for repr/debug
+
+
+class _Builder:
+    def __init__(self) -> None:
+        self.symbols: list[object] = []  # per position: frozenset | BEGIN | END
+        self.follow: list[set[int]] = []
+
+    def new_pos(self, symbol: object) -> int:
+        if len(self.symbols) >= MAX_UNION_POSITIONS:
+            raise RegexSyntaxError(
+                f"pattern set too large: more than {MAX_UNION_POSITIONS} total positions"
+            )
+        self.symbols.append(symbol)
+        self.follow.append(set())
+        return len(self.symbols) - 1
+
+    def visit(self, node: object) -> tuple[bool, list[int], list[int]]:
+        """Returns (nullable, firstpos, lastpos). Fresh positions are
+        allocated per *visit*, so subtrees shared by counted-repeat
+        expansion linearize correctly."""
+        if isinstance(node, Epsilon):
+            return True, [], []
+        if isinstance(node, Sym):
+            p = self.new_pos(node.sentinel if node.sentinel else node.bytes_)
+            return False, [p], [p]
+        if isinstance(node, Star):
+            nullable, first, last = self.visit(node.inner)
+            for i in last:
+                self.follow[i].update(first)
+            return True, first, last
+        if isinstance(node, Alt):
+            nullable, first, last = False, [], []
+            for part in node.parts:
+                n, f, l = self.visit(part)
+                nullable |= n
+                first += f
+                last += l
+            return nullable, first, last
+        if isinstance(node, Cat):
+            nullable, first, last = True, [], []
+            for part in node.parts:
+                n, f, l = self.visit(part)
+                for i in last:
+                    self.follow[i].update(f)
+                if nullable:
+                    first += f
+                if n:
+                    last += l
+                else:
+                    last = l
+                nullable &= n
+            return nullable, first, last
+        raise TypeError(f"unknown AST node {node!r}")
+
+
+def compile_patterns(patterns: list[str], ignore_case: bool = False) -> NFAProgram:
+    """Compile K patterns into one union automaton (any-match
+    semantics, ≙ RegexFilter's any(p.search(line)))."""
+    if not patterns:
+        raise ValueError("compile_patterns needs at least one pattern")
+    b = _Builder()
+    inject: set[int] = set()
+    accept: set[int] = set()
+    match_all = False
+    for pat in patterns:
+        nullable, first, last = b.visit(parse(pat, ignore_case=ignore_case))
+        match_all |= nullable
+        inject.update(first)
+        accept.update(last)
+
+    n = len(b.symbols)
+    if n == 0:
+        # Every pattern was pure-epsilon (e.g. "" or "()"): match-all
+        # with a single dead state so array shapes stay non-degenerate.
+        n = 1
+        b.symbols.append(frozenset())
+        b.follow.append(set())
+
+    # --- byte-class compression -------------------------------------
+    byte_sets = [s for s in b.symbols if isinstance(s, frozenset)]
+    sig = np.zeros((256, len(byte_sets)), dtype=bool)
+    for j, s in enumerate(byte_sets):
+        for byte in s:
+            sig[byte, j] = True
+    _, byte_class = np.unique(sig, axis=0, return_inverse=True)
+    byte_class = byte_class.astype(np.int32)
+    n_byte_classes = int(byte_class.max()) + 1 if len(byte_sets) else 1
+    begin_class = n_byte_classes
+    end_class = n_byte_classes + 1
+    pad_class = n_byte_classes + 2
+    n_classes = n_byte_classes + 3
+
+    char_mask = np.zeros((n_classes, n), dtype=bool)
+    # One representative byte per class is enough: membership is
+    # constant within a class by construction.
+    rep_byte = np.zeros(n_byte_classes, dtype=np.int32)
+    rep_byte[byte_class] = np.arange(256, dtype=np.int32)
+    for s_idx, sym in enumerate(b.symbols):
+        if sym == BEGIN:
+            char_mask[begin_class, s_idx] = True
+        elif sym == END:
+            char_mask[end_class, s_idx] = True
+        else:
+            for c in range(n_byte_classes):
+                if int(rep_byte[c]) in sym:
+                    char_mask[c, s_idx] = True
+
+    follow = np.zeros((n, n), dtype=bool)
+    for i, js in enumerate(b.follow):
+        for j in js:
+            follow[i, j] = True
+
+    inject_v = np.zeros(n, dtype=bool)
+    inject_v[list(inject)] = True
+    accept_v = np.zeros(n, dtype=bool)
+    accept_v[list(accept)] = True
+
+    return NFAProgram(
+        n_states=n,
+        n_classes=n_classes,
+        byte_class=byte_class,
+        begin_class=begin_class,
+        end_class=end_class,
+        pad_class=pad_class,
+        char_mask=char_mask,
+        follow=follow,
+        inject=inject_v,
+        accept=accept_v,
+        match_all=match_all,
+        patterns=tuple(patterns),
+    )
+
+
+def reference_match(prog: NFAProgram, line: bytes) -> bool:
+    """Pure-numpy oracle-shaped simulation of the exact update the
+    TPU engine runs — used by property tests to separate 'automaton is
+    wrong' from 'engine is wrong'."""
+    if prog.match_all:
+        return True
+    classes = (
+        [prog.begin_class]
+        + [int(prog.byte_class[c]) for c in line]
+        + [prog.end_class]
+    )
+    v = np.zeros(prog.n_states, dtype=bool)
+    follow_u8 = prog.follow.astype(np.uint8)
+    for c in classes:
+        reach = (v.astype(np.uint8) @ follow_u8) > 0
+        v = (reach | prog.inject) & prog.char_mask[c]
+        if (v & prog.accept).any():
+            return True
+    return False

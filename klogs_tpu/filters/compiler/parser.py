@@ -1,0 +1,353 @@
+"""Regex parser for the --match pattern compiler.
+
+Parses the RE2-style subset (no backreferences, no lookaround, no \\b)
+into a small AST over *byte sets* and *sentinel symbols*. Anchors are
+not assertions here: ``^`` and ``$`` parse to ordinary symbols matching
+virtual BEGIN/END sentinels that the engine feeds around each line, so
+Glushkov construction needs no special cases and patterns like ``a^b``
+(never matches) or ``^a*$`` fall out correct by construction.
+
+Supported syntax: literals, ``.``, escapes (\\d \\D \\w \\W \\s \\S
+\\t \\n \\r \\f \\v \\0 \\xHH and escaped punctuation), character
+classes ``[...]`` with ranges and negation, grouping ``(...)`` /
+``(?:...)``, alternation ``|``, quantifiers ``* + ? {m} {m,} {m,n}``
+(lazy variants accepted — laziness is irrelevant for boolean matching),
+anchors ``^ $``, and a whole-pattern ``(?i)`` prefix.
+
+The reference has no counterpart (filtering is new per the north star);
+the CPU baseline is Python ``re`` (≙ Go ``regexp`` in klogs' world,
+/root/reference/cmd/root.go:366 being the unfiltered write).
+"""
+
+from dataclasses import dataclass, field
+
+
+class RegexSyntaxError(ValueError):
+    pass
+
+
+# Sentinel symbol kinds (distinct from any byte value).
+BEGIN = "BEGIN"
+END = "END"
+
+
+@dataclass(frozen=True)
+class Sym:
+    """Leaf: matches one input symbol — either any byte in ``bytes_``
+    (a frozenset of ints) or the BEGIN/END sentinel."""
+
+    bytes_: frozenset = frozenset()
+    sentinel: str | None = None
+
+
+@dataclass(frozen=True)
+class Epsilon:
+    pass
+
+
+@dataclass(frozen=True)
+class Cat:
+    parts: tuple
+
+
+@dataclass(frozen=True)
+class Alt:
+    parts: tuple
+
+
+@dataclass(frozen=True)
+class Star:
+    inner: object
+
+
+_CLASS_D = frozenset(range(0x30, 0x3A))
+_CLASS_W = _CLASS_D | frozenset(range(0x41, 0x5B)) | frozenset(range(0x61, 0x7B)) | {0x5F}
+_CLASS_S = frozenset(b" \t\n\r\f\v")
+_ALL_BYTES = frozenset(range(256))
+_DOT = _ALL_BYTES - {0x0A}  # '.' excludes \n (re default, no DOTALL)
+
+# Hard cap on AST leaf count after {m,n} expansion; the automaton state
+# count equals the leaf count, and VMEM sizing assumes it stays modest.
+MAX_POSITIONS = 4096
+
+
+def _casefold(s: frozenset) -> frozenset:
+    out = set(s)
+    for b in s:
+        if 0x41 <= b <= 0x5A:
+            out.add(b + 0x20)
+        elif 0x61 <= b <= 0x7A:
+            out.add(b - 0x20)
+    return frozenset(out)
+
+
+class _Parser:
+    def __init__(self, pattern: str, ignore_case: bool = False):
+        # Patterns arrive as str from the CLI; we match raw bytes, so
+        # encode (latin-1 keeps a 1:1 byte mapping for 0-255).
+        try:
+            self.src = pattern.encode("latin-1")
+        except UnicodeEncodeError as e:
+            raise RegexSyntaxError(
+                f"pattern {pattern!r}: only byte-valued (latin-1) patterns supported"
+            ) from e
+        self.pos = 0
+        self.ignore_case = ignore_case
+        self.n_leaves = 0
+
+    # -- low-level cursor ------------------------------------------------
+    def _peek(self) -> int | None:
+        return self.src[self.pos] if self.pos < len(self.src) else None
+
+    def _next(self) -> int:
+        if self.pos >= len(self.src):
+            raise RegexSyntaxError("unexpected end of pattern")
+        b = self.src[self.pos]
+        self.pos += 1
+        return b
+
+    def _expect(self, ch: int) -> None:
+        if self._peek() != ch:
+            raise RegexSyntaxError(
+                f"expected {chr(ch)!r} at position {self.pos} in {self.src!r}"
+            )
+        self.pos += 1
+
+    def _leaf(self, **kw) -> Sym:
+        self.n_leaves += 1
+        if self.n_leaves > MAX_POSITIONS:
+            raise RegexSyntaxError(
+                f"pattern too large: more than {MAX_POSITIONS} positions"
+            )
+        return Sym(**kw)
+
+    def _sym(self, byte_set: frozenset) -> Sym:
+        if self.ignore_case:
+            byte_set = _casefold(byte_set)
+        return self._leaf(bytes_=byte_set)
+
+    # -- grammar ---------------------------------------------------------
+    def parse(self) -> object:
+        # Whole-pattern (?i) prefix only (inline scoped flags unsupported).
+        if self.src.startswith(b"(?i)"):
+            self.ignore_case = True
+            self.pos = 4
+        node = self._alt()
+        if self.pos != len(self.src):
+            raise RegexSyntaxError(
+                f"unbalanced ')' at position {self.pos} in {self.src!r}"
+            )
+        return node
+
+    def _alt(self) -> object:
+        parts = [self._concat()]
+        while self._peek() == 0x7C:  # '|'
+            self.pos += 1
+            parts.append(self._concat())
+        return parts[0] if len(parts) == 1 else Alt(tuple(parts))
+
+    def _concat(self) -> object:
+        parts = []
+        while True:
+            c = self._peek()
+            if c is None or c in (0x7C, 0x29):  # '|' ')'
+                break
+            parts.append(self._repeat())
+        if not parts:
+            return Epsilon()
+        return parts[0] if len(parts) == 1 else Cat(tuple(parts))
+
+    def _repeat(self) -> object:
+        node = self._atom()
+        while True:
+            c = self._peek()
+            if c == 0x2A:  # '*'
+                self.pos += 1
+                node = Star(node)
+            elif c == 0x2B:  # '+'
+                node = Cat((node, Star(node)))
+                self.pos += 1
+            elif c == 0x3F:  # '?'
+                self.pos += 1
+                node = Alt((node, Epsilon()))
+            elif c == 0x7B:  # '{'
+                saved = self.pos
+                rep = self._try_counted()
+                if rep is None:
+                    self.pos = saved
+                    break
+                lo, hi = rep
+                node = self._expand_counted(node, lo, hi)
+            else:
+                break
+            # Lazy quantifier suffix: same language, ignore.
+            if self._peek() == 0x3F:
+                self.pos += 1
+        return node
+
+    def _try_counted(self) -> tuple[int, int | None] | None:
+        """Parse {m} {m,} {m,n} after the '{'; None if not a counted
+        repeat (then '{' is a literal, matching re's behavior)."""
+        self._expect(0x7B)
+        digits = b""
+        while self._peek() is not None and 0x30 <= self._peek() <= 0x39:
+            digits += bytes([self._next()])
+        if not digits:
+            return None
+        lo = int(digits)
+        hi: int | None = lo
+        if self._peek() == 0x2C:  # ','
+            self.pos += 1
+            digits = b""
+            while self._peek() is not None and 0x30 <= self._peek() <= 0x39:
+                digits += bytes([self._next()])
+            hi = int(digits) if digits else None
+        if self._peek() != 0x7D:  # '}'
+            return None
+        self.pos += 1
+        if hi is not None and hi < lo:
+            raise RegexSyntaxError(f"bad repeat range {{{lo},{hi}}}")
+        return lo, hi
+
+    def _expand_counted(self, node: object, lo: int, hi: int | None) -> object:
+        """e{m,n} → e^m (e?)^(n-m); e{m,} → e^m e*. Leaf-count safety:
+        expansion revisits the same subtree, and Glushkov assigns fresh
+        positions per visit, so count leaves here too."""
+        n_inner = _count_leaves(node)
+        total = n_inner * (hi if hi is not None else lo + 1)
+        self.n_leaves += total - n_inner  # node's own leaves already counted
+        if self.n_leaves > MAX_POSITIONS:
+            raise RegexSyntaxError(
+                f"pattern too large: counted repeat expands past {MAX_POSITIONS} positions"
+            )
+        parts: list = [node] * lo
+        if hi is None:
+            parts.append(Star(node))
+        else:
+            parts.extend([Alt((node, Epsilon()))] * (hi - lo))
+        if not parts:
+            return Epsilon()
+        return parts[0] if len(parts) == 1 else Cat(tuple(parts))
+
+    def _atom(self) -> object:
+        c = self._next()
+        if c == 0x28:  # '('
+            if self._peek() == 0x3F:  # '(?'
+                self.pos += 1
+                n = self._peek()
+                if n == 0x3A:  # non-capturing
+                    self.pos += 1
+                else:
+                    raise RegexSyntaxError(
+                        "only (?:...) groups supported (no lookaround/named groups)"
+                    )
+            node = self._alt()
+            self._expect(0x29)
+            return node
+        if c == 0x5B:  # '['
+            return self._char_class()
+        if c == 0x2E:  # '.'
+            return self._leaf(bytes_=_DOT)
+        if c == 0x5E:  # '^'
+            return self._leaf(sentinel=BEGIN)
+        if c == 0x24:  # '$'
+            return self._leaf(sentinel=END)
+        if c == 0x5C:  # '\'
+            return self._sym(self._escape(in_class=False))
+        if c in (0x2A, 0x2B, 0x3F):  # quantifier with nothing to repeat
+            raise RegexSyntaxError(f"nothing to repeat before {chr(c)!r}")
+        return self._sym(frozenset({c}))
+
+    def _escape(self, in_class: bool) -> frozenset:
+        c = self._next()
+        simple = {
+            0x74: 0x09, 0x6E: 0x0A, 0x72: 0x0D,  # t n r
+            0x66: 0x0C, 0x76: 0x0B, 0x30: 0x00,  # f v 0
+            0x61: 0x07, 0x65: 0x1B,              # a e
+        }
+        if c in simple:
+            return frozenset({simple[c]})
+        if c == 0x78:  # \xHH
+            h = bytes([self._next(), self._next()])
+            try:
+                return frozenset({int(h, 16)})
+            except ValueError:
+                raise RegexSyntaxError(f"bad hex escape \\x{h.decode('latin-1')}")
+        classes = {
+            0x64: _CLASS_D, 0x44: _ALL_BYTES - _CLASS_D,  # d D
+            0x77: _CLASS_W, 0x57: _ALL_BYTES - _CLASS_W,  # w W
+            0x73: _CLASS_S, 0x53: _ALL_BYTES - _CLASS_S,  # s S
+        }
+        if c in classes:
+            return classes[c]
+        if c == 0x62:  # \b
+            raise RegexSyntaxError("\\b word-boundary assertions are not supported")
+        if chr(c).isalnum():
+            raise RegexSyntaxError(f"unsupported escape \\{chr(c)}")
+        return frozenset({c})  # escaped punctuation
+
+    def _char_class(self) -> Sym:
+        negate = False
+        if self._peek() == 0x5E:  # '^'
+            negate = True
+            self.pos += 1
+        members: set[int] = set()
+        first = True
+        while True:
+            c = self._peek()
+            if c is None:
+                raise RegexSyntaxError("unterminated character class")
+            if c == 0x5D and not first:  # ']'
+                self.pos += 1
+                break
+            first = False
+            self.pos += 1
+            if c == 0x5C:
+                lo_set = self._escape(in_class=True)
+                if len(lo_set) != 1:
+                    members |= lo_set  # \d etc. inside class: no range
+                    continue
+                (lo,) = lo_set
+            else:
+                lo = c
+            if self._peek() == 0x2D and self.pos + 1 < len(self.src) and self.src[self.pos + 1] != 0x5D:
+                self.pos += 1  # '-'
+                hc = self._next()
+                if hc == 0x5C:
+                    hi_set = self._escape(in_class=True)
+                    if len(hi_set) != 1:
+                        raise RegexSyntaxError("bad character range endpoint")
+                    (hi,) = hi_set
+                else:
+                    hi = hc
+                if hi < lo:
+                    raise RegexSyntaxError(f"bad character range {chr(lo)}-{chr(hi)}")
+                members |= set(range(lo, hi + 1))
+            else:
+                members.add(lo)
+        result = frozenset(members)
+        if negate:
+            result = _ALL_BYTES - result
+        if self.ignore_case:
+            result = _casefold(result)
+        if not result:
+            raise RegexSyntaxError("empty character class matches nothing")
+        return self._leaf(bytes_=result)
+
+
+def _count_leaves(node: object) -> int:
+    if isinstance(node, Sym):
+        return 1
+    if isinstance(node, Epsilon):
+        return 0
+    if isinstance(node, (Cat, Alt)):
+        return sum(_count_leaves(p) for p in node.parts)
+    if isinstance(node, Star):
+        return _count_leaves(node.inner)
+    raise TypeError(node)
+
+
+def parse(pattern: str, ignore_case: bool = False) -> object:
+    """Parse one pattern into the AST. Raises RegexSyntaxError on
+    unsupported or malformed syntax."""
+    return _Parser(pattern, ignore_case=ignore_case).parse()

@@ -52,16 +52,29 @@ def plan_jobs(
     pods: list[PodInfo], log_path: str, include_init: bool
 ) -> list[StreamJob]:
     """File creation order matches the reference: per pod, init
-    containers first (if -i), then regular (cmd/root.go:240-262)."""
+    containers first (if -i), then regular (cmd/root.go:240-262).
+
+    A pod matched by several -l selectors appears in ``pods`` more than
+    once (label union keeps reference semantics, cmd/root.go:458-460)
+    but must stream only once — two workers on one path would truncate
+    and interleave the same file, so duplicate (pod, container) pairs
+    are dropped here."""
     jobs = []
+    seen: set[tuple[str, str, bool]] = set()
     for pod in pods:
         if include_init:
             for c in pod.init_containers:
-                jobs.append(StreamJob(pod.name, c.name, True,
-                                      os.path.join(log_path, log_file_name(pod.name, c.name))))
+                key = (pod.name, c.name, True)
+                if key not in seen:
+                    seen.add(key)
+                    jobs.append(StreamJob(pod.name, c.name, True,
+                                          os.path.join(log_path, log_file_name(pod.name, c.name))))
         for c in pod.containers:
-            jobs.append(StreamJob(pod.name, c.name, False,
-                                  os.path.join(log_path, log_file_name(pod.name, c.name))))
+            key = (pod.name, c.name, False)
+            if key not in seen:
+                seen.add(key)
+                jobs.append(StreamJob(pod.name, c.name, False,
+                                      os.path.join(log_path, log_file_name(pod.name, c.name))))
     return jobs
 
 

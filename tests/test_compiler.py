@@ -1,0 +1,196 @@
+"""Pattern-compiler correctness: Glushkov automaton ≡ host re.search.
+
+SURVEY.md §4: "unit-test the pattern compiler against a host regex
+oracle (property tests: NFA(batch) ≡ re.match per line)". The oracle is
+Python `re` over bytes with lines stripped of their trailing newline —
+the same semantics RegexFilter (filters/cpu.py) implements.
+"""
+
+import random
+import re
+
+import pytest
+
+from klogs_tpu.filters.compiler import (
+    RegexSyntaxError,
+    compile_patterns,
+    reference_match,
+)
+
+
+def oracle(patterns: list[str], line: bytes, flags: int = 0) -> bool:
+    return any(re.search(p.encode("latin-1"), line, flags) for p in patterns)
+
+
+CASES = [
+    # (patterns, line, expected) — hand-picked semantic corners
+    (["foo"], b"a foo b", True),
+    (["foo"], b"a fo b", False),
+    (["foo"], b"", False),
+    (["^foo"], b"foobar", True),
+    (["^foo"], b"xfoobar", False),
+    (["foo$"], b"barfoo", True),
+    (["foo$"], b"foox", False),
+    (["^foo$"], b"foo", True),
+    (["^foo$"], b"foo ", False),
+    (["^$"], b"", True),
+    (["^$"], b"x", False),
+    (["a*"], b"zzz", True),  # empty match anywhere
+    (["a*"], b"", True),
+    (["^a*$"], b"aaa", True),
+    (["^a*$"], b"aab", False),
+    (["a^b"], b"ab", False),  # ^ mid-pattern can never hold
+    (["a$b"], b"ab", False),  # $ mid-pattern can never hold
+    (["a|"], b"zzz", True),  # nullable alternative → match-all
+    (["ab|cd"], b"xcdy", True),
+    (["ab|cd"], b"xacy", False),
+    (["a+b"], b"aaab", True),
+    (["a+b"], b"b", False),
+    (["a?b"], b"b", True),
+    (["colou?r"], b"color", True),
+    (["colou?r"], b"colouur", False),
+    (["a{3}"], b"aa", False),
+    (["a{3}"], b"aaa", True),
+    (["a{2,}"], b"xaay", True),
+    (["a{2,}"], b"xay", False),
+    (["a{1,3}b"], b"aab", True),
+    (["(ab)+"], b"abab", True),
+    (["(ab)+"], b"ba", False),
+    (["(?:er|war)ror"], b"kernel warror", True),
+    ([r"\d+"], b"abc123", True),
+    ([r"\d+"], b"abc", False),
+    ([r"\w+@\w+"], b"mail me: a@b now", True),
+    ([r"\s"], b"no-spaces", False),
+    ([r"\S+"], b"   x   ", True),
+    ([r"[a-f]+[0-9]"], b"deadbeef9", True),
+    ([r"[^a-z]"], b"abc", False),
+    ([r"[^a-z]"], b"abcX", True),
+    ([r"[]x]"], b"]", True),  # ] first in class is a literal
+    ([r"[a-]"], b"-", True),  # trailing - is a literal
+    ([r"\."], b"a.b", True),
+    ([r"\."], b"ab", False),
+    (["."], b"x", True),
+    ([r"a.c"], b"abc", True),
+    ([r"a.c"], b"a\nc", False),  # . excludes newline
+    ([r"\x41"], b"A", True),
+    ([r"\t"], b"a\tb", True),
+    (["err", "warn", "crit"], b"a warning", True),  # K-pattern union
+    (["err", "warn", "crit"], b"all good", False),
+    (["ERROR:.*timeout"], b"ERROR: request timeout after 30s", True),
+    (["ERROR:.*timeout"], b"WARN: request timeout", False),
+    ([r"GET /\w+ 5\d{2}"], b'10.0.0.1 "GET /api 502" 120ms', True),
+    (["x{"], b"ax{b", True),  # lone { is a literal, matching re
+    (["(a|b)*c"], b"ababc", True),
+    (["(a|b)*c"], b"abab", False),
+    ([r"[\d]+ms"], b"took 42ms", True),
+]
+
+
+@pytest.mark.parametrize("patterns,line,expected", CASES)
+def test_hand_cases(patterns, line, expected):
+    assert oracle(patterns, line) == expected, "oracle disagrees with test table"
+    prog = compile_patterns(patterns)
+    assert reference_match(prog, line) == expected
+
+
+def test_ignore_case():
+    prog = compile_patterns(["(?i)error"])
+    assert reference_match(prog, b"An ERROR occurred")
+    assert reference_match(prog, b"an Error occurred")
+    assert not reference_match(prog, b"all fine")
+
+
+def test_explicit_ignore_case_flag():
+    prog = compile_patterns(["WARN[a-z]*"], ignore_case=True)
+    assert reference_match(prog, b"warning: disk full")
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [r"a\b", r"(?P<x>a)", r"(?=a)", "(a", "a)", "[a", r"a{2,1}", "*a", "[]"],
+)
+def test_rejects_unsupported(bad):
+    with pytest.raises((RegexSyntaxError, ValueError)):
+        compile_patterns([bad])
+
+
+def test_position_cap():
+    with pytest.raises(RegexSyntaxError):
+        compile_patterns(["a{5000}"])
+    with pytest.raises(RegexSyntaxError):
+        compile_patterns(["(ab){40}"] * 200)
+
+
+# ---------------------------------------------------------------------
+# Property test: random patterns × random lines vs the re oracle.
+# ---------------------------------------------------------------------
+
+ALPHABET = b"ab0 .-"
+
+
+def _rand_pattern(rng: random.Random, depth: int = 0) -> str:
+    """Random pattern inside the supported subset, biased small."""
+    choices = ["lit", "lit", "class", "dot", "escape"]
+    if depth < 3:
+        choices += ["cat", "cat", "alt", "star", "plus", "opt", "count", "group"]
+    kind = rng.choice(choices)
+    if kind == "lit":
+        return chr(rng.choice(b"ab01"))
+    if kind == "dot":
+        return "."
+    if kind == "escape":
+        return rng.choice([r"\d", r"\w", r"\s", r"\.", r"\-"])
+    if kind == "class":
+        body = rng.choice(["ab", "a-c", "0-9a", "^ab", "^0-9", "b-", "]a"])
+        return f"[{body}]"
+    if kind == "cat":
+        return _rand_pattern(rng, depth + 1) + _rand_pattern(rng, depth + 1)
+    if kind == "alt":
+        return f"(?:{_rand_pattern(rng, depth + 1)}|{_rand_pattern(rng, depth + 1)})"
+    if kind == "group":
+        return f"({_rand_pattern(rng, depth + 1)})"
+    inner = _rand_pattern(rng, depth + 1)
+    if not inner or inner[-1] in "*+?":
+        inner = f"(?:{inner})"
+    if kind == "star":
+        return inner + "*"
+    if kind == "plus":
+        return inner + "+"
+    if kind == "opt":
+        return inner + "?"
+    lo = rng.randrange(0, 3)
+    hi = rng.randrange(lo, lo + 2)
+    return f"{inner}{{{lo},{hi}}}"
+
+
+def _rand_line(rng: random.Random) -> bytes:
+    n = rng.randrange(0, 12)
+    return bytes(rng.choice(ALPHABET) for _ in range(n))
+
+
+def test_property_vs_re_oracle():
+    rng = random.Random(20260729)
+    tested = 0
+    for trial in range(300):
+        k = rng.randrange(1, 4)
+        pats = [_rand_pattern(rng) for _ in range(k)]
+        # Optional anchors at pattern boundaries
+        pats = [
+            ("^" if rng.random() < 0.2 else "") + p + ("$" if rng.random() < 0.2 else "")
+            for p in pats
+        ]
+        try:
+            for p in pats:
+                re.compile(p.encode("latin-1"))
+            prog = compile_patterns(pats)
+        except (RegexSyntaxError, re.error):
+            continue
+        for _ in range(8):
+            line = _rand_line(rng)
+            expect = oracle(pats, line)
+            got = reference_match(prog, line)
+            assert got == expect, (
+                f"patterns={pats!r} line={line!r}: NFA={got} re={expect}"
+            )
+            tested += 1
+    assert tested > 1000, f"only {tested} property checks ran — generator too lossy"

@@ -154,11 +154,15 @@ class TestMatchEndToEnd:
 
     def test_no_match_flag_is_byte_identical(self, tmp_path):
         # Without --match the write path must remain a raw chunked copy.
+        # Fixed clock: synthetic timestamps must not drift between the
+        # two cluster constructions.
         out1 = str(tmp_path / "a")
-        fc1 = FakeCluster.synthetic(n_pods=1, lines_per_container=10)
+        fc1 = FakeCluster.synthetic(n_pods=1, lines_per_container=10,
+                                    clock=lambda: 1_000_000.0)
         self.run_app(["-n", "default", "-a", "-p", out1], fc1)
         out2 = str(tmp_path / "b")
-        fc2 = FakeCluster.synthetic(n_pods=1, lines_per_container=10)
+        fc2 = FakeCluster.synthetic(n_pods=1, lines_per_container=10,
+                                    clock=lambda: 1_000_000.0)
         self.run_app(["-n", "default", "-a", "--match", ".", "-p", out2], fc2)
         f1 = open(os.path.join(out1, "pod-0000__c0.log"), "rb").read()
         f2 = open(os.path.join(out2, "pod-0000__c0.log"), "rb").read()
