@@ -84,13 +84,11 @@ def _casefold(s: frozenset) -> frozenset:
 class _Parser:
     def __init__(self, pattern: str, ignore_case: bool = False):
         # Patterns arrive as str from the CLI; we match raw bytes, so
-        # encode (latin-1 keeps a 1:1 byte mapping for 0-255).
-        try:
-            self.src = pattern.encode("latin-1")
-        except UnicodeEncodeError as e:
-            raise RegexSyntaxError(
-                f"pattern {pattern!r}: only byte-valued (latin-1) patterns supported"
-            ) from e
+        # encode utf-8 — the same bytes RegexFilter's re.compile(p.encode())
+        # sees, making byte-wise parsing here exactly equivalent to the
+        # CPU baseline (a non-ASCII literal becomes its utf-8 byte
+        # sequence; quantifiers bind to the final byte, as in re).
+        self.src = pattern.encode("utf-8")
         self.pos = 0
         self.ignore_case = ignore_case
         self.n_leaves = 0
@@ -326,10 +324,12 @@ class _Parser:
             else:
                 members.add(lo)
         result = frozenset(members)
-        if negate:
-            result = _ALL_BYTES - result
+        # Casefold BEFORE negation: (?i)[^a] must exclude both 'a' and
+        # 'A' (re semantics); folding after negation would re-add them.
         if self.ignore_case:
             result = _casefold(result)
+        if negate:
+            result = _ALL_BYTES - result
         if not result:
             raise RegexSyntaxError("empty character class matches nothing")
         return self._leaf(bytes_=result)

@@ -1,0 +1,133 @@
+"""NFAEngineFilter — the ``--backend=tpu`` LogFilter.
+
+Host-side half of the TPU path: frames incoming lines into fixed-width
+``[batch, max_line_bytes]`` uint8 tensors (the LineBatcher role from
+SURVEY.md §2), ships them to the JAX engine (klogs_tpu.ops.nfa), and
+returns the per-line keep-mask that gates file writes — the stage the
+north star inserts at the reference's write boundary
+(/root/reference/cmd/root.go:359-374).
+
+Static-shape discipline (XLA traces once per shape): lines are padded
+into power-of-two length buckets so the jit cache stays tiny; lines
+longer than ``chunk_bytes`` run through the carried-state chunk path
+(klogs_tpu.ops.nfa.match_chunk) instead of forcing a giant pad width —
+the long-context design from SURVEY.md §5.
+
+Trailing-newline handling matches RegexFilter: trailing "\\n" bytes are
+stripped before matching, so ``$`` sees the logical end of line.
+"""
+
+import numpy as np
+
+from klogs_tpu.filters.base import LogFilter
+from klogs_tpu.filters.compiler.glushkov import compile_patterns
+
+# Smallest pad width; also the bucket floor. 128 matches the TPU lane.
+MIN_BUCKET = 128
+# Smallest batch-dimension bucket. Both axes are padded to power-of-two
+# buckets so XLA traces O(log) distinct shapes, not one per flush size.
+MIN_BATCH_BUCKET = 8
+
+
+def _bucket_len(n: int, chunk_bytes: int) -> int:
+    b = MIN_BUCKET
+    while b < n:
+        b *= 2
+    return min(b, chunk_bytes)
+
+
+def _bucket_batch(n: int) -> int:
+    b = MIN_BATCH_BUCKET
+    while b < n:
+        b *= 2
+    return b
+
+
+def pack_lines(lines: list[bytes], width: int) -> tuple[np.ndarray, np.ndarray]:
+    """[B] bytes -> ([B', width] u8 zero-padded, [B'] i32 lengths) with
+    B' = B rounded up to a batch bucket; pad rows are empty lines whose
+    verdicts the caller slices off.
+
+    Zero-padding bytes are ignored by the engine (positions >= length
+    classify as pad_class), so the fill value is arbitrary.
+    """
+    B = len(lines)
+    rows = _bucket_batch(B)
+    batch = np.zeros((rows, width), dtype=np.uint8)
+    lengths = np.zeros((rows,), dtype=np.int32)  # pad rows: empty lines
+    for i, ln in enumerate(lines):
+        lengths[i] = len(ln)
+        batch[i, : len(ln)] = np.frombuffer(ln, dtype=np.uint8)
+    return batch, lengths
+
+
+class NFAEngineFilter(LogFilter):
+    """Batch-NFA filter on the JAX engine (TPU when available, else the
+    same code path on CPU — semantics are identical, per conftest's
+    hermetic setup)."""
+
+    def __init__(self, patterns: list[str], ignore_case: bool = False,
+                 chunk_bytes: int = 4096, engine=None):
+        from klogs_tpu.ops import nfa  # deferred: --backend=cpu must not need jax
+
+        self._nfa = nfa
+        self._prog = compile_patterns(patterns, ignore_case=ignore_case)
+        self._dp = nfa.pack_program(self._prog)
+        self._chunk_bytes = chunk_bytes
+        self._engine = engine  # optional parallel engine (klogs_tpu.parallel)
+
+    def match_lines(self, lines: list[bytes]) -> list[bool]:
+        if not lines:
+            return []
+        if self._prog.match_all:
+            return [True] * len(lines)
+        bodies = [ln.rstrip(b"\n") for ln in lines]  # parity with RegexFilter
+        out = np.zeros(len(bodies), dtype=bool)
+
+        short_idx = [i for i, b in enumerate(bodies) if len(b) <= self._chunk_bytes]
+        long_idx = [i for i, b in enumerate(bodies) if len(b) > self._chunk_bytes]
+
+        # Bucket short lines by padded width to bound jit-cache churn.
+        buckets: dict[int, list[int]] = {}
+        for i in short_idx:
+            buckets.setdefault(
+                _bucket_len(len(bodies[i]), self._chunk_bytes), []
+            ).append(i)
+        for width, idxs in buckets.items():
+            batch, lengths = pack_lines([bodies[i] for i in idxs], width)
+            mask = np.asarray(self._match_full(batch, lengths))
+            out[idxs] = mask[: len(idxs)]
+
+        if long_idx:
+            out[long_idx] = self._match_long([bodies[i] for i in long_idx])
+        return out.tolist()
+
+    def _match_full(self, batch: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+        if self._engine is not None:
+            return self._engine.match_batch(batch, lengths)
+        return self._nfa.match_batch(self._dp, batch, lengths)
+
+    def _match_long(self, bodies: list[bytes]) -> np.ndarray:
+        """Carried-state chunked matching: all long lines advance in
+        lockstep, the NFA state vector threaded across chunks."""
+        L = self._chunk_bytes
+        B = _bucket_batch(len(bodies))
+        total = np.zeros(B, dtype=np.int32)
+        total[: len(bodies)] = [len(b) for b in bodies]
+        pad_rows = B - len(bodies)
+        n_chunks = int(np.ceil(total.max() / L))
+        v, matched = self._nfa.initial_state(self._dp, B)
+        for k in range(n_chunks):
+            seg = [b[k * L : (k + 1) * L].ljust(L, b"\0") for b in bodies]
+            seg += [b"\0" * L] * pad_rows
+            chunk = np.frombuffer(b"".join(seg), dtype=np.uint8).reshape(B, L)
+            rem = total - k * L
+            v, matched = self._nfa.match_chunk(
+                self._dp, chunk, rem, v, matched,
+                first=(k == 0), final=(k == n_chunks - 1),
+            )
+        return np.asarray(matched)[: len(bodies)]
+
+    def close(self) -> None:
+        if self._engine is not None:
+            self._engine.close()

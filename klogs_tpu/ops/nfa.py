@@ -1,0 +1,206 @@
+"""Batch NFA matching in JAX — the TPU compute path.
+
+This is the engine behind ``--backend=tpu`` (north star; the reference
+has no counterpart — its write path is an unfiltered io.Copy,
+/root/reference/cmd/root.go:359-374). The automaton comes from the
+Glushkov compiler (klogs_tpu.filters.compiler.glushkov), whose defining
+property makes the per-character update TPU-shaped:
+
+    v' = ((v @ F) | inject) & B[class(c)]
+
+- ``v @ F`` — state reachability as a 0/1 matmul on the MXU. States are
+  padded to a multiple of 128 so the [B,S] x [S,S] product tiles cleanly
+  onto the 128x128 systolic array.
+- ``B[class(c)]`` — realized as a one-hot matmul ``onehot(c) @ B`` so the
+  gather also rides the MXU instead of a scatter/gather unit.
+- The scan over character positions is a ``lax.scan`` with static trip
+  count — no data-dependent Python control flow under jit, per the XLA
+  compilation model.
+
+Everything here is pure and functional: a ``DeviceProgram`` (pytree of
+arrays) plus jitted functions over it. Sharding/multi-chip lives in
+klogs_tpu.parallel; this module is single-logical-device semantics.
+
+Long lines (sequence-parallel analog, SURVEY.md §5 "long-context"): the
+scan carries the state vector, so ``match_chunk`` exposes a carry-in /
+carry-out API — a line longer than one tile is processed as consecutive
+chunks with the NFA state vector carried across, the bit-parallel analog
+of blockwise scanning.
+"""
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from klogs_tpu.filters.compiler.glushkov import NFAProgram
+
+# TPU lane width: pad the state axis to a multiple of this so matmuls
+# tile onto the MXU without remainder handling.
+LANE = 128
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class DeviceProgram:
+    """NFAProgram padded + packed as device arrays (a pytree).
+
+    All float arrays hold exact 0.0/1.0 values; matmuls accumulate in
+    f32 so counts up to S <= 4096 are exact.
+    """
+
+    char_mask: jax.Array  # [C, S] f32 — B table (one-hot matmul target)
+    follow: jax.Array  # [S, S] f32 — F
+    inject: jax.Array  # [S] f32
+    accept: jax.Array  # [S] f32
+    byte_class: jax.Array  # [256] i32
+    begin_class: int
+    end_class: int
+    pad_class: int
+    n_classes: int  # padded C
+    n_states: int  # padded S
+    match_all: bool
+
+    def tree_flatten(self):
+        leaves = (self.char_mask, self.follow, self.inject, self.accept,
+                  self.byte_class)
+        aux = (self.begin_class, self.end_class, self.pad_class,
+               self.n_classes, self.n_states, self.match_all)
+        return leaves, aux
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves, *aux)
+
+
+def _pad_to(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def pack_program(prog: NFAProgram, dtype=jnp.float32) -> DeviceProgram:
+    """Pad the compiler's dense arrays to MXU-friendly shapes.
+
+    Padded states have all-zero rows/cols everywhere, so they can never
+    activate; padded classes get all-zero char_mask rows (same kill
+    semantics as the compiler's pad_class).
+    """
+    S = max(LANE, _pad_to(prog.n_states, LANE))
+    C = _pad_to(prog.n_classes, 8)
+
+    char_mask = np.zeros((C, S), dtype=np.float32)
+    char_mask[: prog.n_classes, : prog.n_states] = prog.char_mask
+    follow = np.zeros((S, S), dtype=np.float32)
+    follow[: prog.n_states, : prog.n_states] = prog.follow
+    inject = np.zeros(S, dtype=np.float32)
+    inject[: prog.n_states] = prog.inject
+    accept = np.zeros(S, dtype=np.float32)
+    accept[: prog.n_states] = prog.accept
+
+    return DeviceProgram(
+        char_mask=jnp.asarray(char_mask, dtype=dtype),
+        follow=jnp.asarray(follow, dtype=dtype),
+        inject=jnp.asarray(inject, dtype=dtype),
+        accept=jnp.asarray(accept, dtype=dtype),
+        byte_class=jnp.asarray(prog.byte_class, dtype=jnp.int32),
+        begin_class=prog.begin_class,
+        end_class=prog.end_class,
+        pad_class=prog.pad_class,
+        n_classes=C,
+        n_states=S,
+        match_all=prog.match_all,
+    )
+
+
+def classify_chunk(dp: DeviceProgram, chunk: jax.Array, rem: jax.Array,
+                   first: bool, final: bool) -> jax.Array:
+    """bytes [B, L] u8 + remaining-lengths [B] -> class ids [B, T] i32.
+
+    ``rem`` is each line's remaining byte count measured from this
+    chunk's start: negative once a line has already ended (all pad),
+    ``> L`` while it continues past this chunk. The END sentinel is
+    emitted at chunk-local position ``rem`` when it falls inside this
+    chunk — and when ``rem == L`` on a non-final chunk, END is deferred
+    to the next chunk (where rem' == 0) so it is fed exactly once.
+    Positions past END are pad_class, whose all-zero mask row kills
+    every state while the sticky `matched` accumulator holds.
+    ``first`` prepends the virtual BEGIN column.
+    """
+    B, L = chunk.shape
+    body = dp.byte_class[chunk.astype(jnp.int32)]  # [B, L]
+    if final:
+        # Extra column so END can land at position L (rem == L).
+        body = jnp.concatenate(
+            [body, jnp.full((B, 1), dp.pad_class, dtype=jnp.int32)], axis=1
+        )  # [B, L+1]
+    # Non-final chunks get NO extra column: a trailing pad step would
+    # kill the carried state mid-line, and rem == L defers END to the
+    # next chunk (rem' == 0) anyway.
+    pos = jnp.arange(body.shape[1], dtype=jnp.int32)[None, :]
+    rem = rem.astype(jnp.int32)[:, None]
+    body = jnp.where(pos < rem, body,
+                     jnp.where(pos == rem, dp.end_class, dp.pad_class))
+    if first:
+        begin = jnp.full((B, 1), dp.begin_class, dtype=jnp.int32)
+        body = jnp.concatenate([begin, body], axis=1)
+    return body
+
+
+def _scan_classes(dp: DeviceProgram, cls: jax.Array,
+                  v0: jax.Array, matched0: jax.Array):
+    """Core scan: cls [B, T] -> (v_final [B,S] f32, matched [B] bool)."""
+    dtype = dp.follow.dtype
+
+    def step(carry, c_t):
+        v, matched = carry  # v: [B, S] dtype, matched: [B] bool
+        reach = (jnp.dot(v, dp.follow, preferred_element_type=jnp.float32)
+                 > 0.5).astype(dtype)
+        active = jnp.maximum(reach, dp.inject[None, :])
+        onehot = jax.nn.one_hot(c_t, dp.n_classes, dtype=dtype)  # [B, C]
+        mask = jnp.dot(onehot, dp.char_mask,
+                       preferred_element_type=jnp.float32)  # [B, S]
+        v2 = (active * mask).astype(dtype)
+        hit = jnp.dot(v2, dp.accept, preferred_element_type=jnp.float32) > 0.5
+        return (v2, matched | hit), None
+
+    (v, matched), _ = jax.lax.scan(step, (v0, matched0), cls.T)
+    return v, matched
+
+
+@jax.jit
+def match_batch(dp: DeviceProgram, batch: jax.Array, lengths: jax.Array) -> jax.Array:
+    """Full-line match: [B, L] u8 bytes + [B] lengths -> [B] bool keep-mask.
+
+    Equivalent to `any(p.search(line) for p in patterns)` for the
+    compiled pattern union (property-tested against the re oracle).
+    """
+    B = batch.shape[0]
+    cls = classify_chunk(dp, batch, lengths, first=True, final=True)
+    v0, matched0 = initial_state(dp, B)
+    _, matched = _scan_classes(dp, cls, v0, matched0)
+    return matched | jnp.asarray(dp.match_all)
+
+
+@partial(jax.jit, static_argnames=("first", "final"))
+def match_chunk(dp: DeviceProgram, chunk: jax.Array, rem: jax.Array,
+                v0: jax.Array, matched0: jax.Array,
+                first: bool, final: bool):
+    """Carried-state matching for lines longer than one tile.
+
+    ``chunk`` [B, L] holds bytes [k*L, (k+1)*L) of each line and ``rem``
+    the line length minus k*L (see classify_chunk). Returns (v, matched)
+    to thread into the next chunk; after the ``final`` chunk, ``matched``
+    is the keep-mask (modulo the match_all shortcut).
+    """
+    cls = classify_chunk(dp, chunk, rem, first=first, final=final)
+    v, matched = _scan_classes(dp, cls, v0, matched0)
+    if final:
+        matched = matched | jnp.asarray(dp.match_all)
+    return v, matched
+
+
+def initial_state(dp: DeviceProgram, batch_size: int):
+    v0 = jnp.zeros((batch_size, dp.n_states), dtype=dp.follow.dtype)
+    matched0 = jnp.zeros((batch_size,), dtype=bool)
+    return v0, matched0

@@ -19,7 +19,8 @@ from klogs_tpu.filters.compiler import (
 
 
 def oracle(patterns: list[str], line: bytes, flags: int = 0) -> bool:
-    return any(re.search(p.encode("latin-1"), line, flags) for p in patterns)
+    # utf-8, same as RegexFilter's re.compile(p.encode())
+    return any(re.search(p.encode("utf-8"), line, flags) for p in patterns)
 
 
 CASES = [
@@ -98,6 +99,27 @@ def test_ignore_case():
     assert reference_match(prog, b"An ERROR occurred")
     assert reference_match(prog, b"an Error occurred")
     assert not reference_match(prog, b"all fine")
+
+
+def test_ignore_case_negated_class():
+    # Casefold must happen BEFORE negation: (?i)[^a] excludes 'a' AND 'A'.
+    prog = compile_patterns(["(?i)[^a]"])
+    assert not reference_match(prog, b"a")
+    assert not reference_match(prog, b"A")
+    assert not reference_match(prog, b"aA")
+    assert reference_match(prog, b"ab")
+    prog2 = compile_patterns(["[^a-z]+X"], ignore_case=True)
+    assert not reference_match(prog2, b"abcX")  # re.I agrees: no match
+
+
+def test_utf8_patterns_match_cpu_baseline():
+    # Non-ASCII patterns compile to their utf-8 byte sequence — the same
+    # bytes RegexFilter's re.compile(p.encode()) matches against.
+    line = "error: café down".encode("utf-8")
+    prog = compile_patterns(["café"])
+    assert reference_match(prog, line)
+    assert not reference_match(prog, b"error: cafe down")
+    assert oracle(["café"], line)
 
 
 def test_explicit_ignore_case_flag():

@@ -1,0 +1,156 @@
+"""JAX batch-NFA engine ≡ host regex, including the chunked long-line
+path (SURVEY.md §4: Pallas/engine tested hermetically on CPU; §5
+long-context: carried NFA state across chunks of a line)."""
+
+import random
+import re
+
+import numpy as np
+import pytest
+
+from klogs_tpu.filters.cpu import RegexFilter
+from klogs_tpu.filters.tpu import NFAEngineFilter, pack_lines
+from tests.test_compiler import CASES, _rand_line, _rand_pattern, oracle
+
+
+def group_cases():
+    """CASES grouped by pattern set so each group is one batched call."""
+    groups: dict[tuple, list] = {}
+    for patterns, line, expected in CASES:
+        groups.setdefault(tuple(patterns), []).append((line, expected))
+    return groups.items()
+
+
+@pytest.mark.parametrize("patterns,pairs", list(group_cases()),
+                         ids=lambda v: repr(v)[:40])
+def test_hand_cases_batched(patterns, pairs):
+    f = NFAEngineFilter(list(patterns))
+    lines = [line for line, _ in pairs]
+    expected = [e for _, e in pairs]
+    assert f.match_lines(lines) == expected
+
+
+def test_trailing_newline_stripped():
+    f = NFAEngineFilter(["foo$"])
+    assert f.match_lines([b"a foo\n", b"a foo", b"foo bar\n"]) == [True, True, False]
+
+
+def test_mixed_length_bucketing():
+    """Lines spanning several pad buckets in one call keep their order."""
+    f = NFAEngineFilter(["needle"])
+    lines = [
+        b"x" * n + (b"needle" if n % 3 == 0 else b"nope") + b"y" * (n % 7)
+        for n in [0, 1, 50, 120, 130, 200, 300, 511, 513, 1000]
+    ]
+    expect = RegexFilter(["needle"]).match_lines(lines)
+    assert f.match_lines(lines) == expect
+
+
+def test_match_all_shortcut():
+    f = NFAEngineFilter(["a|"])  # nullable alternative → match-all
+    assert f.match_lines([b"", b"zzz", b"x" * 5000]) == [True, True, True]
+
+
+class TestLongLines:
+    """chunk_bytes=16 so chunk boundaries are cheap to hit."""
+
+    def mk(self, patterns):
+        return NFAEngineFilter(patterns, chunk_bytes=16)
+
+    def test_match_spans_chunk_boundary(self):
+        f = self.mk(["needle"])
+        line = b"x" * 13 + b"needle" + b"y" * 30  # straddles bytes 13..19
+        assert f.match_lines([line]) == [True]
+        assert f.match_lines([b"x" * 13 + b"needl" + b"y" * 30]) == [False]
+
+    def test_anchors_on_long_lines(self):
+        f = self.mk(["^start", "end$"])
+        assert f.match_lines([b"start" + b"x" * 40]) == [True]
+        assert f.match_lines([b"x" * 40 + b"end"]) == [True]
+        assert f.match_lines([b"x" + b"start" + b"x" * 40]) == [False]
+        assert f.match_lines([b"x" * 40 + b"end" + b"x"]) == [False]
+
+    def test_length_exactly_at_chunk_boundary(self):
+        # END sentinel lands exactly on a chunk seam (rem == L deferral).
+        f = self.mk(["end$"])
+        for total in (16, 32, 48, 17, 31):
+            line = b"x" * (total - 3) + b"end"
+            assert f.match_lines([line]) == [True], total
+            assert f.match_lines([line + b"z"]) == [False], total
+
+    def test_mixed_long_lengths_lockstep(self):
+        f = self.mk([r"ab{3}c"])
+        ok = b"z" * 20 + b"abbbc" + b"z" * 100
+        no = b"z" * 20 + b"abbc" + b"z" * 200
+        short_ok = b"abbbc"
+        assert f.match_lines([ok, no, short_ok]) == [True, False, True]
+
+    def test_star_across_many_chunks(self):
+        f = self.mk(["a[0-9]*b"])
+        line = b"a" + b"7" * 100 + b"b"
+        assert f.match_lines([line]) == [True]
+        assert f.match_lines([b"a" + b"7" * 100 + b"x" + b"b"]) == [False]
+
+
+def test_pack_lines():
+    batch, lengths = pack_lines([b"ab", b"", b"xyz"], 4)
+    assert batch.shape == (8, 4)  # batch axis padded to the 8-row bucket
+    assert lengths.tolist()[:3] == [2, 0, 3]
+    assert lengths.tolist()[3:] == [0] * 5
+    assert batch[0, :2].tobytes() == b"ab"
+    assert batch[2, :3].tobytes() == b"xyz"
+
+
+def test_batch_bucketing_slices_pad_rows():
+    # "^$" matches the empty pad rows — verdicts must be sliced off.
+    f = NFAEngineFilter(["^$"])
+    assert f.match_lines([b"x", b"", b"yy"]) == [False, True, False]
+
+
+def test_trailing_newlines_all_stripped():
+    # rstrip parity with RegexFilter on multi-\n endings.
+    f = NFAEngineFilter(["foo$"])
+    r = RegexFilter(["foo$"])
+    lines = [b"foo\n\n", b"foo\n", b"foo", b"foo\nx"]
+    assert f.match_lines(lines) == r.match_lines(lines)
+
+
+def test_utf8_pattern_agrees_with_cpu():
+    lines = ["error: café down\n".encode("utf-8"), b"error: cafe down\n"]
+    assert NFAEngineFilter(["café"]).match_lines(lines) == \
+        RegexFilter(["café"]).match_lines(lines) == [True, False]
+
+
+def test_property_vs_regex_filter():
+    """Random patterns × random mixed-length batches vs RegexFilter —
+    the end-to-end analog of test_compiler's oracle property test."""
+    rng = random.Random(99)
+    tested = 0
+    for _ in range(40):
+        k = rng.randrange(1, 4)
+        pats = [_rand_pattern(rng) for _ in range(k)]
+        pats = [
+            ("^" if rng.random() < 0.2 else "") + p + ("$" if rng.random() < 0.2 else "")
+            for p in pats
+        ]
+        try:
+            for p in pats:
+                re.compile(p.encode("latin-1"))
+            f = NFAEngineFilter(pats, chunk_bytes=32)
+        except (ValueError, re.error):
+            continue
+        lines = [_rand_line(rng) for _ in range(12)]
+        # A few long lines to force the chunk path alongside short ones.
+        lines += [
+            bytes(rng.choice(b"ab0 .-") for _ in range(rng.randrange(33, 90)))
+            for _ in range(3)
+        ]
+        expect = [oracle(pats, ln) for ln in lines]
+        got = f.match_lines(lines)
+        assert got == expect, f"patterns={pats!r}"
+        tested += len(lines)
+    assert tested > 200
+
+
+def test_empty_batch():
+    assert NFAEngineFilter(["x"]).match_lines([]) == []
