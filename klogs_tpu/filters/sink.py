@@ -163,9 +163,18 @@ def make_pipeline(patterns: list[str], backend: str,
 
         log_filter: LogFilter = RegexFilter(patterns)
     elif backend == "tpu":
+        import jax
+
         from klogs_tpu.filters.tpu import NFAEngineFilter
 
-        log_filter = NFAEngineFilter(patterns)
+        # Multi-chip: shard lines (data) x pattern groups over the mesh;
+        # single chip: plain on-device batches, no collective overhead.
+        engine = None
+        if jax.device_count() > 1:
+            from klogs_tpu.parallel.mesh import MeshEngine
+
+            engine = MeshEngine(patterns)
+        log_filter = NFAEngineFilter(patterns, engine=engine)
     else:
         raise ValueError(f"unknown filter backend {backend!r}")
     return FilterPipeline(

@@ -204,3 +204,73 @@ def initial_state(dp: DeviceProgram, batch_size: int):
     v0 = jnp.zeros((batch_size, dp.n_states), dtype=dp.follow.dtype)
     matched0 = jnp.zeros((batch_size,), dtype=bool)
     return v0, matched0
+
+
+# ---------------------------------------------------------------------
+# Pattern-sharded stacking (the TP analog, SURVEY.md §2 "Mesh/sharding
+# layer": shard K patterns over mesh axis `pattern`, lines over `data`).
+# ---------------------------------------------------------------------
+
+
+def stack_programs(progs: list[NFAProgram], dtype=jnp.float32) -> DeviceProgram:
+    """Stack G per-group automata into one DeviceProgram with a leading
+    group axis on every array leaf, suitable for vmap / sharding over a
+    `pattern` mesh axis.
+
+    The static class layout must be uniform across groups for the vmapped
+    classify to be well-defined, so classes are re-laid out: byte classes
+    keep their per-group ids in 0..n_byte-1, and BEGIN/END/PAD move to
+    common slots at the top of the padded class range. char_mask rows are
+    permuted to match; padded byte-class rows stay all-zero (their class
+    ids never occur in any byte_class table).
+    """
+    max_byte = max(p.begin_class for p in progs)  # begin_class == n_byte_classes
+    begin_c, end_c, pad_c = max_byte, max_byte + 1, max_byte + 2
+    C = _pad_to(max_byte + 3, 8)
+    S = max(LANE, _pad_to(max(p.n_states for p in progs), LANE))
+    G = len(progs)
+
+    char_mask = np.zeros((G, C, S), dtype=np.float32)
+    follow = np.zeros((G, S, S), dtype=np.float32)
+    inject = np.zeros((G, S), dtype=np.float32)
+    accept = np.zeros((G, S), dtype=np.float32)
+    byte_class = np.zeros((G, 256), dtype=np.int32)
+    for g, p in enumerate(progs):
+        n, nb = p.n_states, p.begin_class
+        char_mask[g, :nb, :n] = p.char_mask[:nb]
+        char_mask[g, begin_c, :n] = p.char_mask[p.begin_class]
+        char_mask[g, end_c, :n] = p.char_mask[p.end_class]
+        # pad_c row stays zero (kill-all), as in pack_program.
+        follow[g, :n, :n] = p.follow
+        inject[g, :n] = p.inject
+        accept[g, :n] = p.accept
+        byte_class[g] = p.byte_class
+
+    return DeviceProgram(
+        char_mask=jnp.asarray(char_mask, dtype=dtype),
+        follow=jnp.asarray(follow, dtype=dtype),
+        inject=jnp.asarray(inject, dtype=dtype),
+        accept=jnp.asarray(accept, dtype=dtype),
+        byte_class=jnp.asarray(byte_class, dtype=jnp.int32),
+        begin_class=begin_c,
+        end_class=end_c,
+        pad_class=pad_c,
+        n_classes=C,
+        n_states=S,
+        match_all=any(p.match_all for p in progs),
+    )
+
+
+@jax.jit
+def match_batch_grouped(dp: DeviceProgram, batch: jax.Array,
+                        lengths: jax.Array) -> jax.Array:
+    """Any-match across G stacked pattern groups: [G,...] program leaves,
+    [B, L] bytes -> [B] bool.
+
+    Written as a vmap over the group axis + an any-reduce; under
+    sharding (program leaves on the `pattern` axis, batch on `data`)
+    XLA lowers the reduce to an ICI all-reduce across pattern shards —
+    collectives by annotation, not by hand (scaling-book recipe).
+    """
+    per_group = jax.vmap(match_batch, in_axes=(0, None, None))(dp, batch, lengths)
+    return jnp.any(per_group, axis=0)

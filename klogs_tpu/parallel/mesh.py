@@ -1,0 +1,119 @@
+"""Device-mesh execution of the batch-NFA filter.
+
+SPMD layout (SURVEY.md §2 "Mesh/sharding layer", §5 "Distributed
+communication backend"): a 2-D ``Mesh`` with axes
+
+- ``data``    — lines (DP): the [B, L] byte batch is row-sharded.
+- ``pattern`` — pattern groups (the TP analog): the K patterns are
+  split into G groups, each compiled to its own automaton; the stacked
+  [G, ...] program arrays are sharded one group per mesh column.
+
+The per-line any-match reduce across pattern shards is expressed as a
+plain ``jnp.any`` over the group axis; GSPMD lowers it to an all-reduce
+over ICI. No hand-written collectives — shardings are annotated and XLA
+inserts the comms (the reference's only comm stack is REST to the
+apiserver, cmd/root.go:322-325; this is its on-mesh equivalent).
+
+Multi-host: under ``jax.distributed`` the same Mesh spans hosts over
+DCN transparently; nothing here is host-count-aware.
+"""
+
+import math
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from klogs_tpu.filters.compiler.glushkov import compile_patterns
+from klogs_tpu.ops import nfa
+
+
+def choose_grid(n_devices: int, n_patterns: int) -> tuple[int, int]:
+    """(data, pattern) mesh shape: give the pattern axis at most as many
+    shards as there are patterns, keep it a divisor of the device count,
+    and spend the rest on data parallelism. Batch is the throughput axis,
+    so data gets the benefit of the doubt on ties."""
+    g = 1
+    for cand in range(min(n_devices, n_patterns), 0, -1):
+        if n_devices % cand == 0:
+            g = cand
+            break
+    d = n_devices // g
+    # Prefer data-major splits: if the pattern axis ended up bigger than
+    # data for a small pattern count, rebalance toward data.
+    while g >= 2 * d and g % 2 == 0:
+        g //= 2
+        d *= 2
+    return d, g
+
+
+def split_patterns(patterns: list[str], g: int) -> list[list[str]]:
+    """Round-robin so group automaton sizes stay balanced."""
+    groups = [patterns[i::g] for i in range(g)]
+    return [grp for grp in groups if grp]
+
+
+class MeshEngine:
+    """Pattern-sharded, data-parallel match engine over a jax Mesh.
+
+    Drop-in ``engine`` for NFAEngineFilter: exposes match_batch over
+    numpy arrays, returning a host bool mask.
+    """
+
+    def __init__(self, patterns: list[str], ignore_case: bool = False,
+                 devices=None, grid: tuple[int, int] | None = None):
+        devices = devices if devices is not None else jax.devices()
+        if grid is None:
+            grid = choose_grid(len(devices), len(patterns))
+        d, g = grid
+        if d * g != len(devices):
+            raise ValueError(f"grid {grid} != device count {len(devices)}")
+        groups = split_patterns(patterns, g)
+        g = len(groups)  # may shrink if fewer patterns than shards
+        progs = [compile_patterns(grp, ignore_case=ignore_case) for grp in groups]
+        # If g shrank, replicate the last group to fill the axis: a
+        # duplicate group changes nothing under any-match.
+        while len(progs) < grid[1]:
+            progs.append(progs[-1])
+        self.grid = (d, grid[1])
+        self.mesh = Mesh(np.asarray(devices).reshape(self.grid), ("data", "pattern"))
+        self.dp = nfa.stack_programs(progs)
+        self.match_all = self.dp.match_all
+
+        prog_sharding = jax.tree_util.tree_map(
+            lambda _: NamedSharding(self.mesh, P("pattern")), self.dp
+        )
+        self.dp = jax.device_put(self.dp, prog_sharding)
+        self._fn = jax.jit(
+            nfa.match_batch_grouped,
+            in_shardings=(
+                prog_sharding,
+                NamedSharding(self.mesh, P("data", None)),
+                NamedSharding(self.mesh, P("data")),
+            ),
+            out_shardings=NamedSharding(self.mesh, P("data")),
+        )
+
+    @property
+    def data_parallelism(self) -> int:
+        return self.grid[0]
+
+    def match_batch(self, batch: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+        """[B, L] u8 + [B] i32 -> [B] bool. B is padded up to a multiple
+        of the data axis so every shard gets equal rows."""
+        B = batch.shape[0]
+        d = self.grid[0]
+        Bp = math.ceil(B / d) * d
+        if Bp != B:
+            batch = np.concatenate(
+                [batch, np.zeros((Bp - B, batch.shape[1]), dtype=batch.dtype)]
+            )
+            lengths = np.concatenate(
+                [lengths, np.zeros((Bp - B,), dtype=lengths.dtype)]
+            )
+        out = np.asarray(self._fn(self.dp, batch, lengths))
+        return out[:B]
+
+    def close(self) -> None:
+        pass

@@ -7,12 +7,21 @@ exercised hermetically without TPU hardware, per SURVEY.md §4.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the ambient environment may pin a real TPU
+# platform (e.g. JAX_PLATFORMS=axon, registered by a sitecustomize hook
+# before this file runs), and tests must stay hermetic.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+# The env var alone loses to an eagerly-registered PJRT plugin; the
+# config knob wins (verified: devices() -> 8 CpuDevice).
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,85 @@
+"""Mesh-sharded engine semantics on the hermetic 8-device CPU mesh
+(conftest forces xla_force_host_platform_device_count=8): data x pattern
+sharding must be invisible in results (≡ RegexFilter)."""
+
+import random
+import re
+
+import numpy as np
+import pytest
+
+import jax
+
+from klogs_tpu.filters.cpu import RegexFilter
+from klogs_tpu.filters.tpu import NFAEngineFilter
+from klogs_tpu.parallel.mesh import MeshEngine, choose_grid, split_patterns
+from tests.test_compiler import _rand_line, _rand_pattern, oracle
+
+
+def test_eight_virtual_devices():
+    assert jax.device_count() == 8, "conftest must force an 8-device CPU mesh"
+
+
+@pytest.mark.parametrize("n_dev,n_pat,expect", [
+    (8, 32, (4, 2)),
+    (8, 2, (4, 2)),
+    (8, 1, (8, 1)),
+    (1, 5, (1, 1)),
+    (8, 3, (4, 2)),
+    (4, 4, (2, 2)),
+])
+def test_choose_grid(n_dev, n_pat, expect):
+    d, g = choose_grid(n_dev, n_pat)
+    assert d * g == n_dev
+    assert (d, g) == expect
+
+
+def test_split_patterns_balanced():
+    groups = split_patterns([f"p{i}" for i in range(7)], 3)
+    assert sorted(len(g) for g in groups) == [2, 2, 3]
+    assert sorted(sum(groups, [])) == sorted(f"p{i}" for i in range(7))
+
+
+@pytest.mark.parametrize("grid", [(8, 1), (4, 2), (2, 4), (1, 8)])
+def test_mesh_grids_agree_with_cpu(grid):
+    pats = ["ERROR", r"WARN.*\d", "^2026", "timeout$", "a+b", "x{3}"]
+    eng = MeshEngine(pats, grid=grid)
+    f = NFAEngineFilter(pats, engine=eng)
+    lines = [
+        b"2026 ERROR x", b"all good", b"WARN 42", b"request timeout",
+        b"aab", b"ab" * 40, b"", b"xxx", b"xx",
+        b"2026-07-29 WARN latency=9",
+    ]
+    assert f.match_lines(lines) == RegexFilter(pats).match_lines(lines)
+
+
+def test_uneven_batch_padding():
+    eng = MeshEngine(["foo"], grid=(8, 1))
+    f = NFAEngineFilter(["foo"], engine=eng)
+    # 3 lines over an 8-wide data axis: padded rows must be sliced off.
+    assert f.match_lines([b"foo", b"bar", b"xfoo"]) == [True, False, True]
+
+
+def test_more_shards_than_patterns_replicates():
+    eng = MeshEngine(["only"], grid=(2, 4))
+    f = NFAEngineFilter(["only"], engine=eng)
+    assert f.match_lines([b"the only one", b"nope"]) == [True, False]
+
+
+def test_property_mesh_vs_oracle():
+    rng = random.Random(7)
+    tested = 0
+    for _ in range(15):
+        k = rng.randrange(1, 6)
+        pats = [_rand_pattern(rng) for _ in range(k)]
+        try:
+            for p in pats:
+                re.compile(p.encode())
+            eng = MeshEngine(pats, grid=(4, 2))
+            f = NFAEngineFilter(pats, engine=eng)
+        except (ValueError, re.error):
+            continue
+        lines = [_rand_line(rng) for _ in range(21)]  # uneven on purpose
+        assert f.match_lines(lines) == [oracle(pats, ln) for ln in lines]
+        tested += 1
+    assert tested >= 8
