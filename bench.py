@@ -1,0 +1,86 @@
+"""North-star benchmark: log lines/sec filtered, K patterns x N-pod-scale
+batches, TPU batch-NFA vs the host-regex CPU baseline (BASELINE.json:
+"Target: >=10x lines/sec vs Go regexp ... 32 patterns").
+
+Prints ONE JSON line:
+  {"metric": ..., "value": <tpu lines/sec>, "unit": "lines/sec",
+   "vs_baseline": <tpu / cpu-regex>}
+
+Run on whatever jax platform is ambient (the driver provides the real
+TPU chip). Sizes are env-tunable for smoke runs:
+  KLOGS_BENCH_LINES (default 200000), KLOGS_BENCH_REPEATS (default 3),
+  KLOGS_BENCH_CPU_LINES (default 20000).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from klogs_tpu.cluster.fake import synthetic_line  # noqa: E402
+from klogs_tpu.filters.cpu import RegexFilter  # noqa: E402
+from klogs_tpu.filters.tpu import NFAEngineFilter  # noqa: E402
+
+PATTERNS = [
+    "ERROR", r"WARN.*\d", "^2026-", r"timeout|timed out", r"code=5\d{2}",
+    r"latency=\d{3,}ms", "panic:", "oom-killer", "connection refused",
+    r"retry \d+/\d+", r"GET /api/v\d+ 404", r"disk .*full",
+    r"\d+ms code=400", "failed path=/api/v1", "seq=99", r"c[0-9]+ seq=1\d\d",
+    "TRACE", "FATAL", r"^\d{4}-\d{2}-\d{2}T", "kernel:", "segfault",
+    r"uid=\d+", "unauthorized", "forbidden", r"5\d\d [A-Z]+",
+    "deadline exceeded", r"x-request-id: [0-9a-f]+", "EOF",
+    r"(?:ERROR|FATAL).*code=\d+", "watchdog", "backoff", r"\[\d+\]",
+]  # 32 patterns, per the north-star config
+
+
+def make_lines(n: int) -> list[bytes]:
+    # Deterministic synthetic pod logs, ~128B each — the FakeCluster line
+    # shape at 256-pod scale (SURVEY.md §6 config 3).
+    out = []
+    per_pod = max(1, n // 256)
+    i = 0
+    for p in range(256):
+        pod = f"pod-{p:04d}"
+        for s in range(per_pod):
+            out.append(synthetic_line(pod, "c0", s, 1_753_800_000 + s))
+            i += 1
+            if i >= n:
+                return out
+    return out
+
+
+def timed_lps(filt, lines, repeats: int, chunk: int = 8192) -> float:
+    # One warmup pass over a prefix to absorb jit compilation.
+    filt.match_lines(lines[: min(len(lines), chunk)])
+    best = 0.0
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        n = 0
+        for i in range(0, len(lines), chunk):
+            n += len(filt.match_lines(lines[i : i + chunk]))
+        dt = time.perf_counter() - t0
+        best = max(best, n / dt)
+    return best
+
+
+def main() -> None:
+    n_lines = int(os.environ.get("KLOGS_BENCH_LINES", "200000"))
+    n_cpu = int(os.environ.get("KLOGS_BENCH_CPU_LINES", "20000"))
+    repeats = int(os.environ.get("KLOGS_BENCH_REPEATS", "3"))
+
+    lines = make_lines(n_lines)
+    cpu_lps = timed_lps(RegexFilter(PATTERNS), lines[:n_cpu], repeats)
+    tpu_lps = timed_lps(NFAEngineFilter(PATTERNS), lines, repeats)
+
+    print(json.dumps({
+        "metric": "log-lines/sec filtered, 32 patterns x 256-pod batch (batch-NFA)",
+        "value": round(tpu_lps, 1),
+        "unit": "lines/sec",
+        "vs_baseline": round(tpu_lps / cpu_lps, 3) if cpu_lps else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -17,6 +17,8 @@ Trailing-newline handling matches RegexFilter: trailing "\\n" bytes are
 stripped before matching, so ``$`` sees the logical end of line.
 """
 
+import os
+
 import numpy as np
 
 from klogs_tpu.filters.base import LogFilter
@@ -67,7 +69,9 @@ class NFAEngineFilter(LogFilter):
     hermetic setup)."""
 
     def __init__(self, patterns: list[str], ignore_case: bool = False,
-                 chunk_bytes: int = 4096, engine=None):
+                 chunk_bytes: int = 4096, engine=None, kernel: str | None = None):
+        import jax
+
         from klogs_tpu.ops import nfa  # deferred: --backend=cpu must not need jax
 
         self._nfa = nfa
@@ -75,6 +79,25 @@ class NFAEngineFilter(LogFilter):
         self._dp = nfa.pack_program(self._prog)
         self._chunk_bytes = chunk_bytes
         self._engine = engine  # optional parallel engine (klogs_tpu.parallel)
+
+        # Execution path for the hot op: the Pallas kernel on real TPU,
+        # the jnp/lax.scan path elsewhere (identical semantics; the
+        # kernel's Mosaic lowering needs TPU hardware). "interpret"
+        # exercises the kernel code hermetically (tests).
+        kernel = kernel or os.environ.get("KLOGS_TPU_KERNEL", "auto")
+        if kernel == "auto":
+            kernel = "pallas" if jax.default_backend() not in ("cpu",) else "jnp"
+        self._kernel = kernel
+        if kernel in ("pallas", "interpret"):
+            import jax.numpy as jnp
+
+            from klogs_tpu.ops import pallas_nfa
+
+            self._pallas = pallas_nfa
+            aug = nfa.augment(self._prog)
+            self._dp_aug = nfa.pack_program(aug, dtype=jnp.int8)
+            self._live = self._prog.n_states
+            self._acc = self._prog.n_states + 1
 
     def match_lines(self, lines: list[bytes]) -> list[bool]:
         if not lines:
@@ -105,6 +128,11 @@ class NFAEngineFilter(LogFilter):
     def _match_full(self, batch: np.ndarray, lengths: np.ndarray) -> np.ndarray:
         if self._engine is not None:
             return self._engine.match_batch(batch, lengths)
+        if self._kernel in ("pallas", "interpret"):
+            return self._pallas.match_batch_pallas(
+                self._dp_aug, self._acc, self._live, batch, lengths,
+                interpret=(self._kernel == "interpret"),
+            )
         return self._nfa.match_batch(self._dp, batch, lengths)
 
     def _match_long(self, bodies: list[bytes]) -> np.ndarray:
@@ -116,16 +144,28 @@ class NFAEngineFilter(LogFilter):
         total[: len(bodies)] = [len(b) for b in bodies]
         pad_rows = B - len(bodies)
         n_chunks = int(np.ceil(total.max() / L))
-        v, matched = self._nfa.initial_state(self._dp, B)
+        use_pallas = self._kernel in ("pallas", "interpret")
+        if use_pallas:
+            v = self._pallas.initial_state_kernel(self._dp_aug, self._live, B)
+        else:
+            v, matched = self._nfa.initial_state(self._dp, B)
         for k in range(n_chunks):
             seg = [b[k * L : (k + 1) * L].ljust(L, b"\0") for b in bodies]
             seg += [b"\0" * L] * pad_rows
             chunk = np.frombuffer(b"".join(seg), dtype=np.uint8).reshape(B, L)
             rem = total - k * L
-            v, matched = self._nfa.match_chunk(
-                self._dp, chunk, rem, v, matched,
-                first=(k == 0), final=(k == n_chunks - 1),
-            )
+            first, final = (k == 0), (k == n_chunks - 1)
+            if use_pallas:
+                v, matched = self._pallas.match_chunk_pallas(
+                    self._dp_aug, self._acc, chunk, rem, v,
+                    first=first, final=final,
+                    interpret=(self._kernel == "interpret"),
+                )
+            else:
+                v, matched = self._nfa.match_chunk(
+                    self._dp, chunk, rem, v, matched,
+                    first=first, final=final,
+                )
         return np.asarray(matched)[: len(bodies)]
 
     def close(self) -> None:

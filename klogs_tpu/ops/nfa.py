@@ -206,6 +206,55 @@ def initial_state(dp: DeviceProgram, batch_size: int):
     return v0, matched0
 
 
+def augment(prog: NFAProgram) -> NFAProgram:
+    """Fold inject+accept into the automaton via two extra states, so a
+    matcher needs NO per-step inject/accept work — just v' = (v@F) & B[c]:
+
+    - ``live`` (index n): always alive (member of every class, including
+      pad); follow(live) = inject ∪ {live}. Starting from v0 = {live},
+      the unanchored-search re-injection happens inside the matmul.
+    - ``acc`` (index n+1): absorbing sink; follow(a) ∋ acc for every
+      accepting a, follow(acc) = {acc}, member of every class including
+      pad — once a match is seen it survives to the end of the scan, so
+      "matched" is simply v_final[acc]. Requires one scan step AFTER the
+      END sentinel to latch the last transition (matchers append one pad
+      column).
+
+    The result is still a valid NFAProgram (inject' = {live},
+    accept' = {acc}), usable by any execution path.
+    """
+    n = prog.n_states
+    live, acc = n, n + 1
+    char_mask = np.zeros((prog.n_classes, n + 2), dtype=bool)
+    char_mask[:, :n] = prog.char_mask
+    char_mask[:, live] = True  # every class, including pad_class
+    char_mask[:, acc] = True
+    follow = np.zeros((n + 2, n + 2), dtype=bool)
+    follow[:n, :n] = prog.follow
+    follow[live, :n] = prog.inject
+    follow[live, live] = True
+    follow[:n, acc] = prog.accept
+    follow[acc, acc] = True
+    inject = np.zeros(n + 2, dtype=bool)
+    inject[live] = True
+    accept = np.zeros(n + 2, dtype=bool)
+    accept[acc] = True
+    return NFAProgram(
+        n_states=n + 2,
+        n_classes=prog.n_classes,
+        byte_class=prog.byte_class,
+        begin_class=prog.begin_class,
+        end_class=prog.end_class,
+        pad_class=prog.pad_class,
+        char_mask=char_mask,
+        follow=follow,
+        inject=inject,
+        accept=accept,
+        match_all=prog.match_all,
+        patterns=prog.patterns,
+    )
+
+
 # ---------------------------------------------------------------------
 # Pattern-sharded stacking (the TP analog, SURVEY.md §2 "Mesh/sharding
 # layer": shard K patterns over mesh axis `pattern`, lines over `data`).

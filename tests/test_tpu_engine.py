@@ -13,6 +13,9 @@ from klogs_tpu.filters.tpu import NFAEngineFilter, pack_lines
 from tests.test_compiler import CASES, _rand_line, _rand_pattern, oracle
 
 
+KERNELS = ["jnp", "interpret"]  # interpret = the Pallas kernel, interpreted
+
+
 def group_cases():
     """CASES grouped by pattern set so each group is one batched call."""
     groups: dict[tuple, list] = {}
@@ -21,10 +24,11 @@ def group_cases():
     return groups.items()
 
 
+@pytest.mark.parametrize("kernel", KERNELS)
 @pytest.mark.parametrize("patterns,pairs", list(group_cases()),
                          ids=lambda v: repr(v)[:40])
-def test_hand_cases_batched(patterns, pairs):
-    f = NFAEngineFilter(list(patterns))
+def test_hand_cases_batched(patterns, pairs, kernel):
+    f = NFAEngineFilter(list(patterns), kernel=kernel)
     lines = [line for line, _ in pairs]
     expected = [e for _, e in pairs]
     assert f.match_lines(lines) == expected
@@ -51,11 +55,21 @@ def test_match_all_shortcut():
     assert f.match_lines([b"", b"zzz", b"x" * 5000]) == [True, True, True]
 
 
+@pytest.fixture(params=KERNELS)
+def kernel(request):
+    return request.param
+
+
 class TestLongLines:
-    """chunk_bytes=16 so chunk boundaries are cheap to hit."""
+    """chunk_bytes=16 so chunk boundaries are cheap to hit; runs on both
+    the jnp path and the Pallas kernel (interpret)."""
+
+    @pytest.fixture(autouse=True)
+    def _kernel(self, kernel):
+        self.kernel = kernel
 
     def mk(self, patterns):
-        return NFAEngineFilter(patterns, chunk_bytes=16)
+        return NFAEngineFilter(patterns, chunk_bytes=16, kernel=self.kernel)
 
     def test_match_spans_chunk_boundary(self):
         f = self.mk(["needle"])
@@ -121,7 +135,8 @@ def test_utf8_pattern_agrees_with_cpu():
         RegexFilter(["café"]).match_lines(lines) == [True, False]
 
 
-def test_property_vs_regex_filter():
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_property_vs_regex_filter(kernel):
     """Random patterns × random mixed-length batches vs RegexFilter —
     the end-to-end analog of test_compiler's oracle property test."""
     rng = random.Random(99)
@@ -136,7 +151,7 @@ def test_property_vs_regex_filter():
         try:
             for p in pats:
                 re.compile(p.encode("latin-1"))
-            f = NFAEngineFilter(pats, chunk_bytes=32)
+            f = NFAEngineFilter(pats, chunk_bytes=32, kernel=kernel)
         except (ValueError, re.error):
             continue
         lines = [_rand_line(rng) for _ in range(12)]
