@@ -94,6 +94,14 @@ class NFAEngineFilter(LogFilter):
             from klogs_tpu.ops import pallas_nfa
 
             self._pallas = pallas_nfa
+            # Full-line batches run the grouped kernel (patterns binned
+            # into 128-state automata: MXU cost linear, not quadratic,
+            # in total positions); the long-line chunk path uses the
+            # single augmented union automaton (state carry across
+            # chunks needs one uniform state space).
+            self._dp_grouped, self._g_live, self._g_acc = nfa.compile_grouped(
+                patterns, ignore_case=ignore_case
+            )
             aug = nfa.augment(self._prog)
             self._dp_aug = nfa.pack_program(aug, dtype=jnp.int8)
             self._live = self._prog.n_states
@@ -129,8 +137,8 @@ class NFAEngineFilter(LogFilter):
         if self._engine is not None:
             return self._engine.match_batch(batch, lengths)
         if self._kernel in ("pallas", "interpret"):
-            return self._pallas.match_batch_pallas(
-                self._dp_aug, self._acc, self._live, batch, lengths,
+            return self._pallas.match_batch_grouped_pallas(
+                self._dp_grouped, self._g_live, self._g_acc, batch, lengths,
                 interpret=(self._kernel == "interpret"),
             )
         return self._nfa.match_batch(self._dp, batch, lengths)

@@ -310,6 +310,84 @@ def stack_programs(progs: list[NFAProgram], dtype=jnp.float32) -> DeviceProgram:
     )
 
 
+def compile_grouped(patterns: list[str], ignore_case: bool = False,
+                    max_positions: int = 126, dtype=jnp.int8):
+    """Compile K patterns into G small AUGMENTED automata with a SHARED
+    byte classifier, stacked as [G, ...] arrays — the single-chip perf
+    lever: MXU cost of the reachability matmul is quadratic in the state
+    count, so G groups of <=126 positions (one 128x128 MXU tile each,
+    live/acc included) beat one union automaton of G*126 states by ~G x.
+
+    Returns (DeviceProgram with [G, ...] leaves and a shared [256]
+    byte_class, live_index, acc_index). live/acc sit at S-2/S-1 in every
+    group. Any-match over groups == any-match over patterns.
+    """
+    from klogs_tpu.filters.compiler.glushkov import compile_patterns
+
+    if not patterns:
+        raise ValueError("compile_grouped needs at least one pattern")
+    # Greedy first-fit-decreasing bin packing by position count.
+    sized = [(compile_patterns([p], ignore_case=ignore_case).n_states, p)
+             for p in patterns]
+    sized.sort(key=lambda t: -t[0])
+    bins: list[tuple[int, list[str]]] = []
+    for n, p in sized:
+        for i, (load, ps) in enumerate(bins):
+            if load + n <= max_positions:
+                bins[i] = (load + n, ps + [p])
+                break
+        else:
+            bins.append((n, [p]))
+    progs = [compile_patterns(ps, ignore_case=ignore_case) for _, ps in bins]
+    G = len(progs)
+
+    # Shared byte classifier: bytes equivalent in EVERY group collapse.
+    sig = np.stack([p.byte_class for p in progs], axis=1)  # [256, G]
+    uniq, byte_class = np.unique(sig, axis=0, return_inverse=True)
+    byte_class = byte_class.astype(np.int32)
+    n_glob = uniq.shape[0]
+    begin_c, end_c, pad_c = n_glob, n_glob + 1, n_glob + 2
+    C = _pad_to(n_glob + 3, 8)
+    S = max(LANE, _pad_to(max(p.n_states for p in progs) + 2, LANE))
+    live, acc = S - 2, S - 1
+
+    char_mask = np.zeros((G, C, S), dtype=np.float32)
+    follow = np.zeros((G, S, S), dtype=np.float32)
+    inject = np.zeros((G, S), dtype=np.float32)
+    accept = np.zeros((G, S), dtype=np.float32)
+    for g, p in enumerate(progs):
+        n = p.n_states
+        # Byte classes: global class c has per-group local id uniq[c][g].
+        char_mask[g, :n_glob, :n] = p.char_mask[uniq[:, g], :n]
+        char_mask[g, begin_c, :n] = p.char_mask[p.begin_class, :n]
+        char_mask[g, end_c, :n] = p.char_mask[p.end_class, :n]
+        # live/acc are members of every class, including pad.
+        char_mask[g, :, live] = 1.0
+        char_mask[g, :, acc] = 1.0
+        follow[g, :n, :n] = p.follow
+        follow[g, live, :n] = p.inject  # live re-injects firstpos
+        follow[g, live, live] = 1.0
+        follow[g, :n, acc] = p.accept  # accepting -> absorbing acc
+        follow[g, acc, acc] = 1.0
+        inject[g, live] = 1.0
+        accept[g, acc] = 1.0
+
+    dp = DeviceProgram(
+        char_mask=jnp.asarray(char_mask, dtype=dtype),
+        follow=jnp.asarray(follow, dtype=dtype),
+        inject=jnp.asarray(inject, dtype=dtype),
+        accept=jnp.asarray(accept, dtype=dtype),
+        byte_class=jnp.asarray(byte_class, dtype=jnp.int32),
+        begin_class=begin_c,
+        end_class=end_c,
+        pad_class=pad_c,
+        n_classes=C,
+        n_states=S,
+        match_all=any(p.match_all for p in progs),
+    )
+    return dp, live, acc
+
+
 @jax.jit
 def match_batch_grouped(dp: DeviceProgram, batch: jax.Array,
                         lengths: jax.Array) -> jax.Array:

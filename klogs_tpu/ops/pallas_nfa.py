@@ -122,6 +122,88 @@ def match_chunk_pallas(dp: DeviceProgram, acc: int,
     return vout.T, matched
 
 
+DEFAULT_TILE_B_GROUPED = 4096
+
+
+def _grouped_kernel(cls_ref, char_mask_t_ref, follow_t_ref, out_ref,
+                    *, T: int, C: int, live: int, acc: int, unroll: int = 1):
+    """One (batch-tile, group) grid cell. The grid iterates groups
+    innermost, so out_ref (indexed by tile only) stays VMEM-resident and
+    accumulates the OR across groups."""
+    TILE_B = cls_ref.shape[1]
+    S = follow_t_ref.shape[1]
+    g = pl.program_id(1)
+    iota_c = jax.lax.broadcasted_iota(jnp.int32, (C, TILE_B), 0)
+    v0 = (jax.lax.broadcasted_iota(jnp.int32, (S, TILE_B), 0) == live
+          ).astype(jnp.int8)
+
+    def step(t, v):
+        c = cls_ref[pl.ds(t, 1), :]
+        onehot = (iota_c == c).astype(jnp.int8)
+        mask = jnp.dot(char_mask_t_ref[0], onehot,
+                       preferred_element_type=jnp.int32)
+        reach = jnp.dot(follow_t_ref[0], v,
+                        preferred_element_type=jnp.int32)
+        return ((reach > 0) & (mask > 0)).astype(jnp.int8)
+
+    v = jax.lax.fori_loop(0, T, step, v0, unroll=unroll)
+    matched = v[acc : acc + 1, :]
+
+    @pl.when(g == 0)
+    def _():
+        out_ref[:] = matched
+
+    @pl.when(g > 0)
+    def _():
+        out_ref[:] = out_ref[:] | matched
+
+
+@functools.partial(jax.jit, static_argnames=("live", "acc", "tile_b",
+                                             "interpret", "unroll"))
+def match_batch_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
+                               batch: jax.Array, lengths: jax.Array,
+                               tile_b: int = DEFAULT_TILE_B_GROUPED,
+                               interpret: bool = False,
+                               unroll: int = 1) -> jax.Array:
+    """Full-line match over a compile_grouped program ([G, ...] leaves,
+    shared byte classifier): [B, L] u8 + [B] -> [B] bool."""
+    B = batch.shape[0]
+    cls = classify_chunk(dp, batch, lengths, first=True, final=True)
+    cls = jnp.concatenate(
+        [cls, jnp.full((B, 1), dp.pad_class, dtype=jnp.int32)], axis=1
+    )  # acc latch step
+    T = cls.shape[1]
+    S, C = dp.n_states, dp.n_classes
+    G = dp.follow.shape[0]
+    TILE_B = min(tile_b, B)
+    if B % TILE_B:
+        raise ValueError(f"batch {B} not divisible by tile {TILE_B}")
+
+    # char_mask [G,C,S] -> [G,S,C]; follow [G,S,S] -> [G,S,S]^T per group.
+    char_mask_t = jnp.swapaxes(dp.char_mask, 1, 2)
+    follow_t = jnp.swapaxes(dp.follow, 1, 2)
+
+    out = pl.pallas_call(
+        functools.partial(_grouped_kernel, T=T, C=C, live=live, acc=acc,
+                          unroll=unroll),
+        grid=(B // TILE_B, G),  # groups innermost: out block revisited
+        in_specs=[
+            pl.BlockSpec((T, TILE_B), lambda i, g: (0, i),
+                         memory_space=pltpu.VMEM),          # cls (transposed)
+            pl.BlockSpec((1, S, C), lambda i, g: (g, 0, 0),
+                         memory_space=pltpu.VMEM),          # char_mask^T
+            pl.BlockSpec((1, S, S), lambda i, g: (g, 0, 0),
+                         memory_space=pltpu.VMEM),          # follow^T
+        ],
+        out_specs=pl.BlockSpec((1, TILE_B), lambda i, g: (0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, B), jnp.int8),
+        interpret=interpret,
+    )(cls.T, char_mask_t, follow_t)
+
+    return (out[0, :] > 0) | jnp.asarray(dp.match_all)
+
+
 def initial_state_kernel(dp: DeviceProgram, live: int, batch_size: int):
     """[B, S] i8 one-hot on the `live` state — the augmented v0."""
     return jnp.tile(

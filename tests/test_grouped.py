@@ -1,0 +1,79 @@
+"""compile_grouped + grouped Pallas kernel: bin packing, shared byte
+classifier, any-match across groups ≡ host regex."""
+
+import random
+import re
+
+import numpy as np
+import pytest
+
+from klogs_tpu.filters.cpu import RegexFilter
+from klogs_tpu.filters.tpu import pack_lines
+from klogs_tpu.ops import nfa
+from klogs_tpu.ops.pallas_nfa import match_batch_grouped_pallas
+from tests.test_compiler import _rand_line, _rand_pattern, oracle
+
+
+def run_grouped(patterns, lines, width=128):
+    dp, live, acc = nfa.compile_grouped(patterns)
+    batch, lengths = pack_lines(lines, width)
+    m = np.asarray(match_batch_grouped_pallas(
+        dp, live, acc, batch, lengths, tile_b=8, interpret=True))
+    return m[: len(lines)].tolist()
+
+
+def test_many_patterns_make_multiple_groups():
+    pats = [f"pattern{i:02d}[a-z]{{3}}\\d+" for i in range(24)]
+    dp, live, acc = nfa.compile_grouped(pats)
+    G = dp.follow.shape[0]
+    assert G >= 2, "24 nontrivial patterns must not fit one 126-position bin"
+    assert dp.n_states == 128
+    assert (live, acc) == (126, 127)
+
+
+def test_grouped_matches_regex_across_groups():
+    pats = [f"needle{i}" for i in range(30)]  # forces several groups
+    lines = [f"has needle{i} inside".encode() for i in range(30)]
+    lines += [b"no needles here", b"needle", b"needle2 and needle27"]
+    assert run_grouped(pats, lines) == RegexFilter(pats).match_lines(lines)
+
+
+def test_single_small_pattern_single_group():
+    dp, live, acc = nfa.compile_grouped(["abc"])
+    assert dp.follow.shape[0] == 1
+    lines = [b"xxabcxx", b"xab", b""]
+    assert run_grouped(["abc"], lines) == [True, False, False]
+
+
+def test_anchors_and_matchall_in_groups():
+    pats = ["^start", "end$", "a|"]  # third is match-all
+    assert run_grouped(pats, [b"nothing"]) == [True]
+    dp, _, _ = nfa.compile_grouped(pats)
+    assert dp.match_all
+
+
+def test_shared_byte_classifier_consistency():
+    # Patterns with clashing byte classes across groups must still agree.
+    pats = [r"[a-m]+X", r"[h-z]+Y", r"\d\d", "q"]
+    lines = [b"abchX", b"hzzzY", b"42", b"q", b"abcY", b"hzX", b"4x"]
+    assert run_grouped(pats, lines) == RegexFilter(pats).match_lines(lines)
+
+
+def test_property_grouped_vs_oracle():
+    rng = random.Random(4242)
+    tested = 0
+    for _ in range(12):
+        k = rng.randrange(4, 12)
+        pats = [_rand_pattern(rng) for _ in range(k)]
+        try:
+            for p in pats:
+                re.compile(p.encode())
+            dp, live, acc = nfa.compile_grouped(pats)
+        except (ValueError, re.error):
+            continue
+        lines = [_rand_line(rng) for _ in range(16)]
+        got = run_grouped(pats, lines, width=16)
+        exp = [oracle(pats, ln) for ln in lines]
+        assert got == exp, f"patterns={pats!r}"
+        tested += 1
+    assert tested >= 6
