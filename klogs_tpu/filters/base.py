@@ -76,5 +76,18 @@ class LogFilter(abc.ABC):
         """One verdict per line; True = keep. Lines include no trailing
         newline requirement — implementations must tolerate either."""
 
+    # -- two-phase API for pipelined execution ------------------------
+    # Device engines override these so a batch can be ENQUEUED without
+    # blocking on its result: dispatch() returns an opaque handle after
+    # (cheap, async) submission; fetch() blocks until the verdicts are
+    # ready. The default degrades to synchronous matching, so every
+    # filter is usable behind AsyncFilterService.
+
+    def dispatch(self, lines: list[bytes]):
+        return self.match_lines(lines)
+
+    def fetch(self, handle) -> list[bool]:
+        return handle
+
     def close(self) -> None:
         """Release engine resources (device buffers, transports)."""

@@ -34,6 +34,7 @@ class FilteredSink(Sink):
         batch_lines: int = 1024,
         deadline_s: float = 0.05,
         on_close: "Callable[[FilteredSink], None] | None" = None,
+        service: "AsyncFilterService | None" = None,
     ):
         self._inner = inner
         self._filter = log_filter
@@ -45,6 +46,11 @@ class FilteredSink(Sink):
         self._deadline_s = deadline_s
         self._on_close = on_close
         self._closed = False
+        self._service = service
+        # Held across match+write so concurrent flushes (write vs the
+        # deadline flusher) cannot reorder this file's lines while a
+        # batch is in flight on the async service.
+        self._flush_lock = asyncio.Lock()
 
     async def write(self, chunk: bytes) -> None:
         lines = self._framer.feed(chunk)
@@ -60,12 +66,19 @@ class FilteredSink(Sink):
             await self._flush_pending()
 
     async def _flush_pending(self) -> None:
+        async with self._flush_lock:
+            await self._flush_pending_locked()
+
+    async def _flush_pending_locked(self) -> None:
         pending, self._pending = self._pending, []
         self._pending_since = None
         if not pending:
             return
         t0 = time.perf_counter()
-        mask = self._filter.match_lines(pending)
+        if self._service is not None:
+            mask = await self._service.match(pending)
+        else:
+            mask = self._filter.match_lines(pending)
         kept = [ln for ln, keep in zip(pending, mask) if keep]
         latency = time.perf_counter() - t0
         bytes_out = 0
@@ -118,6 +131,7 @@ class FilterPipeline:
     stats: FilterStats
     batch_lines: int = 1024
     deadline_s: float = 0.05
+    service: "AsyncFilterService | None" = None
     _live_sinks: "set[FilteredSink]" = dataclasses_field(default_factory=set)
 
     def sink_factory(self, job: StreamJob) -> Sink:
@@ -128,6 +142,7 @@ class FilterPipeline:
             batch_lines=self.batch_lines,
             deadline_s=self.deadline_s,
             on_close=self._live_sinks.discard,
+            service=self.service,
         )
         self._live_sinks.add(sink)
         return sink
@@ -139,11 +154,19 @@ class FilterPipeline:
         chunks arrive. Run as a background task; cancel to stop."""
         while True:
             await asyncio.sleep(self.deadline_s / 2)
-            for sink in list(self._live_sinks):
-                await sink.flush_if_stale()
+            # Concurrent: a serial sweep over N slow flushes would make
+            # the sweep period N x the flush latency (observed: minutes
+            # at 200 sinks). With the coalescing service these merge
+            # into a handful of device batches anyway.
+            await asyncio.gather(
+                *[s.flush_if_stale() for s in list(self._live_sinks)]
+            )
 
     def close(self) -> None:
-        self.log_filter.close()
+        if self.service is not None:
+            self.service.close()  # also closes the filter
+        else:
+            self.log_filter.close()
 
     def print_summary(self) -> None:
         s = self.stats
@@ -157,14 +180,18 @@ class FilterPipeline:
 
 
 def make_pipeline(patterns: list[str], backend: str,
-                  batch_lines: int = 1024, deadline_s: float = 0.05) -> FilterPipeline:
+                  batch_lines: int | None = None,
+                  deadline_s: float = 0.05) -> FilterPipeline:
+    service = None
     if backend == "cpu":
         from klogs_tpu.filters.cpu import RegexFilter
 
         log_filter: LogFilter = RegexFilter(patterns)
+        batch_lines = batch_lines or 1024
     elif backend == "tpu":
         import jax
 
+        from klogs_tpu.filters.async_service import AsyncFilterService
         from klogs_tpu.filters.tpu import NFAEngineFilter
 
         # Multi-chip: shard lines (data) x pattern groups over the mesh;
@@ -175,6 +202,10 @@ def make_pipeline(patterns: list[str], backend: str,
 
             engine = MeshEngine(patterns)
         log_filter = NFAEngineFilter(patterns, engine=engine)
+        # Device batches are cheap per line but each round trip has fixed
+        # latency: bigger batches + the async pipeline hide it.
+        batch_lines = batch_lines or 8192
+        service = AsyncFilterService(log_filter)
     else:
         raise ValueError(f"unknown filter backend {backend!r}")
     return FilterPipeline(
@@ -182,4 +213,5 @@ def make_pipeline(patterns: list[str], backend: str,
         stats=FilterStats(),
         batch_lines=batch_lines,
         deadline_s=deadline_s,
+        service=service,
     )

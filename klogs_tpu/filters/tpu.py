@@ -108,12 +108,17 @@ class NFAEngineFilter(LogFilter):
             self._acc = self._prog.n_states + 1
 
     def match_lines(self, lines: list[bytes]) -> list[bool]:
+        return self.fetch(self.dispatch(lines))
+
+    def dispatch(self, lines: list[bytes]):
+        """Enqueue device work for a batch WITHOUT blocking on results
+        (jax dispatch is asynchronous). Returns a handle for fetch()."""
         if not lines:
-            return []
+            return (0, [])
         if self._prog.match_all:
-            return [True] * len(lines)
+            return (len(lines), None)  # all-match shortcut
         bodies = [ln.rstrip(b"\n") for ln in lines]  # parity with RegexFilter
-        out = np.zeros(len(bodies), dtype=bool)
+        parts = []  # (index_list, device_mask_or_ndarray)
 
         short_idx = [i for i, b in enumerate(bodies) if len(b) <= self._chunk_bytes]
         long_idx = [i for i, b in enumerate(bodies) if len(b) > self._chunk_bytes]
@@ -126,11 +131,19 @@ class NFAEngineFilter(LogFilter):
             ).append(i)
         for width, idxs in buckets.items():
             batch, lengths = pack_lines([bodies[i] for i in idxs], width)
-            mask = np.asarray(self._match_full(batch, lengths))
-            out[idxs] = mask[: len(idxs)]
-
+            parts.append((idxs, self._match_full(batch, lengths)))
         if long_idx:
-            out[long_idx] = self._match_long([bodies[i] for i in long_idx])
+            parts.append((long_idx, self._match_long([bodies[i] for i in long_idx])))
+        return (len(lines), parts)
+
+    def fetch(self, handle) -> list[bool]:
+        """Block until the dispatched batch's verdicts are on host."""
+        n, parts = handle
+        if parts is None:
+            return [True] * n
+        out = np.zeros(n, dtype=bool)
+        for idxs, mask in parts:
+            out[idxs] = np.asarray(mask)[: len(idxs)]
         return out.tolist()
 
     def _match_full(self, batch: np.ndarray, lengths: np.ndarray) -> np.ndarray:
@@ -174,7 +187,7 @@ class NFAEngineFilter(LogFilter):
                     self._dp, chunk, rem, v, matched,
                     first=first, final=final,
                 )
-        return np.asarray(matched)[: len(bodies)]
+        return matched  # device array (padded); fetch() slices on host
 
     def close(self) -> None:
         if self._engine is not None:

@@ -99,9 +99,11 @@ class MeshEngine:
     def data_parallelism(self) -> int:
         return self.grid[0]
 
-    def match_batch(self, batch: np.ndarray, lengths: np.ndarray) -> np.ndarray:
-        """[B, L] u8 + [B] i32 -> [B] bool. B is padded up to a multiple
-        of the data axis so every shard gets equal rows."""
+    def match_batch(self, batch: np.ndarray, lengths: np.ndarray):
+        """[B, L] u8 + [B] i32 -> [>=B] bool mask, returned as a DEVICE
+        array (padded rows at the tail; callers slice after np.asarray —
+        keeps dispatch non-blocking for the async pipeline). B is padded
+        up to a multiple of the data axis so every shard gets equal rows."""
         B = batch.shape[0]
         d = self.grid[0]
         Bp = math.ceil(B / d) * d
@@ -112,8 +114,7 @@ class MeshEngine:
             lengths = np.concatenate(
                 [lengths, np.zeros((Bp - B,), dtype=lengths.dtype)]
             )
-        out = np.asarray(self._fn(self.dp, batch, lengths))
-        return out[:B]
+        return self._fn(self.dp, batch, lengths)
 
     def close(self) -> None:
         pass

@@ -1,0 +1,158 @@
+"""AsyncFilterService: pipelining, backpressure, ordering guarantees."""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from klogs_tpu.filters.async_service import AsyncFilterService
+from klogs_tpu.filters.base import FilterStats, LogFilter
+from klogs_tpu.filters.sink import FilteredSink
+from klogs_tpu.runtime.sink import Sink
+
+
+class SlowFilter(LogFilter):
+    """Keeps lines containing b'keep'; fetch() blocks fetch_delay_s —
+    the model of a device round trip."""
+
+    def __init__(self, fetch_delay_s: float = 0.05):
+        self.fetch_delay_s = fetch_delay_s
+        self.dispatched = 0
+        self.in_flight_peak = 0
+        self._in_flight = 0
+        self._lock = threading.Lock()
+
+    def match_lines(self, lines):
+        return [b"keep" in ln for ln in lines]
+
+    def dispatch(self, lines):
+        self.dispatched += 1
+        with self._lock:
+            self._in_flight += 1
+            self.in_flight_peak = max(self.in_flight_peak, self._in_flight)
+        return list(lines)
+
+    def fetch(self, handle):
+        time.sleep(self.fetch_delay_s)
+        with self._lock:
+            self._in_flight -= 1
+        return self.match_lines(handle)
+
+
+class ListSink(Sink):
+    def __init__(self):
+        self.chunks = []
+        self._bytes = 0
+
+    async def write(self, chunk):
+        self.chunks.append(chunk)
+        self._bytes += len(chunk)
+
+    async def close(self):
+        pass
+
+    @property
+    def bytes_written(self):
+        return self._bytes
+
+
+def test_concurrent_matches_overlap():
+    filt = SlowFilter(fetch_delay_s=0.1)
+    svc = AsyncFilterService(filt, fetch_workers=8)
+
+    async def main():
+        t0 = time.perf_counter()
+        res = await asyncio.gather(
+            *[svc.match([b"keep this", b"drop that"]) for _ in range(8)]
+        )
+        return time.perf_counter() - t0, res
+
+    dt, res = asyncio.run(main())
+    assert all(r == [True, False] for r in res)
+    # 8 x 0.1s serial would be 0.8s; pipelined must overlap.
+    assert dt < 0.45, f"matches did not overlap: {dt:.2f}s"
+    svc.close()
+
+
+def test_backpressure_bounds_in_flight():
+    filt = SlowFilter(fetch_delay_s=0.02)
+    svc = AsyncFilterService(filt, max_in_flight=3, fetch_workers=8,
+                             coalesce_lines=1)  # no merging: N real batches
+
+    async def main():
+        await asyncio.gather(*[svc.match([b"x"]) for _ in range(20)])
+
+    asyncio.run(main())
+    assert filt.in_flight_peak <= 3
+    assert filt.dispatched == 20
+    svc.close()
+
+
+def test_coalescing_merges_concurrent_batches():
+    filt = SlowFilter(fetch_delay_s=0.01)
+    svc = AsyncFilterService(filt, coalesce_lines=1000,
+                             coalesce_delay_s=0.02)
+
+    async def main():
+        return await asyncio.gather(
+            *[svc.match([f"keep {i}".encode(), b"drop"]) for i in range(50)]
+        )
+
+    res = asyncio.run(main())
+    assert all(r == [True, False] for r in res)
+    # 50 concurrent 2-line calls must merge into very few device batches.
+    assert svc.batches_dispatched <= 3, svc.batches_dispatched
+    svc.close()
+
+
+def test_coalesce_size_trigger_flushes_immediately():
+    filt = SlowFilter(fetch_delay_s=0.01)
+    svc = AsyncFilterService(filt, coalesce_lines=8, coalesce_delay_s=10.0)
+
+    async def main():
+        # 4 calls x 2 lines hit the 8-line threshold: must not wait 10 s.
+        return await asyncio.wait_for(
+            asyncio.gather(*[svc.match([b"keep", b"x"]) for _ in range(4)]),
+            timeout=2.0,
+        )
+
+    res = asyncio.run(main())
+    assert all(r == [True, False] for r in res)
+    svc.close()
+
+
+def test_sink_ordering_with_racing_flushes():
+    """write()-triggered flushes racing deadline flushes must not reorder
+    a file's lines, even with slow async completion."""
+    filt = SlowFilter(fetch_delay_s=0.03)
+    svc = AsyncFilterService(filt, fetch_workers=8)
+    inner = ListSink()
+    sink = FilteredSink(inner, filt, FilterStats(), batch_lines=4,
+                        deadline_s=0.001, service=svc)
+
+    async def main():
+        async def feeder():
+            for i in range(40):
+                await sink.write(f"keep {i:03d}\n".encode())
+                await asyncio.sleep(0.002)
+
+        async def flusher():
+            for _ in range(60):
+                await asyncio.sleep(0.003)
+                await sink.flush_if_stale()
+
+        await asyncio.gather(feeder(), flusher())
+        await sink.close()
+
+    asyncio.run(main())
+    got = b"".join(inner.chunks).decode().splitlines()
+    assert got == [f"keep {i:03d}" for i in range(40)], "lines reordered/lost"
+    svc.close()
+
+
+def test_service_closed_raises():
+    svc = AsyncFilterService(SlowFilter())
+    svc.close()
+    with pytest.raises(RuntimeError):
+        asyncio.run(svc.match([b"x"]))
