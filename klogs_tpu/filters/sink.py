@@ -79,17 +79,21 @@ class FilteredSink(Sink):
             mask = await self._service.match(pending)
         else:
             mask = self._filter.match_lines(pending)
-        kept = [ln for ln, keep in zip(pending, mask) if keep]
         latency = time.perf_counter() - t0
-        bytes_out = 0
-        for ln in kept:
-            await self._inner.write(ln)
-            bytes_out += len(ln)
+        from klogs_tpu.native import hostops
+
+        n_kept = sum(mask)
+        if hostops is not None:
+            out = hostops.join_kept(pending, bytes(bytearray(mask)))
+        else:
+            out = b"".join(ln for ln, keep in zip(pending, mask) if keep)
+        if out:
+            await self._inner.write(out)
         self._stats.record_batch(
             n_lines=len(pending),
-            n_matched=len(kept),
+            n_matched=n_kept,
             n_bytes_in=sum(len(ln) for ln in pending),
-            n_bytes_out=bytes_out,
+            n_bytes_out=len(out),
             latency_s=latency,
         )
 

@@ -51,10 +51,18 @@ def pack_lines(lines: list[bytes], width: int) -> tuple[np.ndarray, np.ndarray]:
     verdicts the caller slices off.
 
     Zero-padding bytes are ignored by the engine (positions >= length
-    classify as pad_class), so the fill value is arbitrary.
+    classify as pad_class), so the fill value is arbitrary. Uses the
+    native packer (klogs_tpu.native) when available — the pure-Python
+    per-line loop is the host-side bottleneck otherwise.
     """
     B = len(lines)
     rows = _bucket_batch(B)
+    from klogs_tpu.native import hostops
+
+    if hostops is not None:
+        buf, lens = hostops.pack_lines(lines, width, rows)
+        batch = np.frombuffer(buf, dtype=np.uint8).reshape(rows, width)
+        return batch, np.frombuffer(lens, dtype=np.int32)
     batch = np.zeros((rows, width), dtype=np.uint8)
     lengths = np.zeros((rows,), dtype=np.int32)  # pad rows: empty lines
     for i, ln in enumerate(lines):

@@ -1,0 +1,56 @@
+"""Native host-ops loader: compile-on-first-use with Python fallback.
+
+The extension is a single C file with no dependencies beyond CPython;
+building it is one cc invocation, done lazily and cached next to the
+source. Environments without a toolchain (or where the build fails for
+any reason) silently fall back to the pure-Python implementations —
+the native layer is a fast path, never a requirement.
+
+Set KLOGS_NO_NATIVE=1 to force the fallback (used by tests to cover
+both paths).
+"""
+
+import os
+import subprocess
+import sys
+import sysconfig
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "_hostops.c")
+_SO = os.path.join(_DIR, f"_hostops{sysconfig.get_config_var('EXT_SUFFIX') or '.so'}")
+
+hostops = None
+
+
+def _build() -> bool:
+    include = sysconfig.get_paths()["include"]
+    cc = os.environ.get("CC", "cc")
+    cmd = [cc, "-O3", "-shared", "-fPIC", f"-I{include}", _SRC, "-o", _SO]
+    try:
+        res = subprocess.run(cmd, capture_output=True, timeout=120)
+        return res.returncode == 0
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def _load():
+    global hostops
+    if os.environ.get("KLOGS_NO_NATIVE"):
+        return
+    if not os.path.exists(_SO) or (
+        os.path.exists(_SRC) and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+    ):
+        if not _build():
+            return
+    try:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("klogs_tpu.native._hostops", _SO)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        hostops = mod
+    except Exception:
+        hostops = None
+
+
+_load()

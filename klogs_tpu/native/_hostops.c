@@ -1,0 +1,136 @@
+/* _hostops — native host-side hot loop for klogs_tpu.
+ *
+ * The TPU engine consumes fixed-width [batch, width] uint8 tensors; the
+ * pure-Python packer (one numpy frombuffer+copy per line) caps the host
+ * path well below device rate. This module does the pack in one C pass.
+ *
+ * The reference's only native aspect is being a compiled Go binary
+ * (SURVEY.md section 2); its host hot loop is io.Copy
+ * (/root/reference/cmd/root.go:359-374). This is the equivalent
+ * native layer for the batched-filter design.
+ *
+ * Exposed functions (all GIL-holding, no numpy C-API dependency —
+ * callers wrap the returned buffers with np.frombuffer):
+ *
+ *   pack_lines(lines: list[bytes], width: int, rows: int)
+ *       -> (buffer: bytes, lengths: bytes holding int32[rows])
+ *     Zero-padded row-major [rows, width] pack; rows >= len(lines), the
+ *     excess rows are zero (empty lines). A line longer than width is
+ *     truncated (callers route long lines to the chunked path first).
+ *
+ *   count_keep_bytes(lines: list[bytes], mask: bytes) -> int
+ *   join_kept(lines: list[bytes], mask: bytes) -> bytes
+ *     Gather of mask-selected lines into one contiguous write buffer.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <string.h>
+#include <stdint.h>
+
+static PyObject *
+pack_lines(PyObject *self, PyObject *args)
+{
+    PyObject *list;
+    Py_ssize_t width, rows;
+    if (!PyArg_ParseTuple(args, "O!nn", &PyList_Type, &list, &width, &rows))
+        return NULL;
+    Py_ssize_t n = PyList_GET_SIZE(list);
+    if (rows < n)
+        rows = n;
+    if (width <= 0) {
+        PyErr_SetString(PyExc_ValueError, "width must be positive");
+        return NULL;
+    }
+
+    PyObject *buf = PyBytes_FromStringAndSize(NULL, rows * width);
+    PyObject *lens = PyBytes_FromStringAndSize(NULL, rows * 4);
+    if (!buf || !lens) {
+        Py_XDECREF(buf);
+        Py_XDECREF(lens);
+        return NULL;
+    }
+    char *out = PyBytes_AS_STRING(buf);
+    int32_t *lengths = (int32_t *)PyBytes_AS_STRING(lens);
+    memset(out, 0, rows * width);
+    memset(lengths, 0, rows * 4);
+
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *item = PyList_GET_ITEM(list, i);
+        char *p;
+        Py_ssize_t len;
+        if (PyBytes_AsStringAndSize(item, &p, &len) < 0) {
+            Py_DECREF(buf);
+            Py_DECREF(lens);
+            return NULL;
+        }
+        Py_ssize_t c = len < width ? len : width;
+        memcpy(out + i * width, p, c);
+        lengths[i] = (int32_t)c;
+    }
+    return Py_BuildValue("(NN)", buf, lens);
+}
+
+static PyObject *
+join_kept(PyObject *self, PyObject *args)
+{
+    PyObject *list;
+    Py_buffer mask;
+    if (!PyArg_ParseTuple(args, "O!y*", &PyList_Type, &list, &mask))
+        return NULL;
+    Py_ssize_t n = PyList_GET_SIZE(list);
+    if (mask.len < n) {
+        PyBuffer_Release(&mask);
+        PyErr_SetString(PyExc_ValueError, "mask shorter than lines");
+        return NULL;
+    }
+    const char *m = (const char *)mask.buf;
+
+    Py_ssize_t total = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        if (!m[i])
+            continue;
+        PyObject *item = PyList_GET_ITEM(list, i);
+        if (!PyBytes_Check(item)) {
+            PyBuffer_Release(&mask);
+            PyErr_SetString(PyExc_TypeError, "lines must be bytes");
+            return NULL;
+        }
+        total += PyBytes_GET_SIZE(item);
+    }
+    PyObject *buf = PyBytes_FromStringAndSize(NULL, total);
+    if (!buf) {
+        PyBuffer_Release(&mask);
+        return NULL;
+    }
+    char *out = PyBytes_AS_STRING(buf);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        if (!m[i])
+            continue;
+        PyObject *item = PyList_GET_ITEM(list, i);
+        Py_ssize_t len = PyBytes_GET_SIZE(item);
+        memcpy(out, PyBytes_AS_STRING(item), len);
+        out += len;
+    }
+    PyBuffer_Release(&mask);
+    return buf;
+}
+
+static PyMethodDef Methods[] = {
+    {"pack_lines", pack_lines, METH_VARARGS,
+     "pack_lines(lines, width, rows) -> (bytes, int32-lengths-bytes)"},
+    {"join_kept", join_kept, METH_VARARGS,
+     "join_kept(lines, mask) -> bytes of mask-selected lines"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef module = {
+    PyModuleDef_HEAD_INIT, "_hostops",
+    "Native host-side packing/gather for klogs_tpu", -1, Methods,
+};
+
+PyMODINIT_FUNC
+PyInit__hostops(void)
+{
+    return PyModule_Create(&module);
+}

@@ -1,0 +1,59 @@
+"""Native host-ops: correctness vs the pure-Python fallback."""
+
+import numpy as np
+import pytest
+
+from klogs_tpu import native
+
+
+def require_native():
+    if native.hostops is None:
+        pytest.skip("native extension unavailable (no C toolchain)")
+
+
+def test_pack_lines_matches_python():
+    require_native()
+    lines = [b"", b"a", b"hello\tworld", b"x" * 128, bytes(range(256))[:100]]
+    buf, lens = native.hostops.pack_lines(lines, 128, 8)
+    batch = np.frombuffer(buf, dtype=np.uint8).reshape(8, 128)
+    lengths = np.frombuffer(lens, dtype=np.int32)
+    assert lengths.tolist() == [0, 1, 11, 128, 100, 0, 0, 0]
+    assert batch[2, :11].tobytes() == b"hello\tworld"
+    assert batch[2, 11:].max() == 0
+    assert batch[3].tobytes() == b"x" * 128
+    assert batch[5:].max() == 0
+
+
+def test_pack_lines_truncates_overlong():
+    require_native()
+    buf, lens = native.hostops.pack_lines([b"y" * 300], 128, 1)
+    assert np.frombuffer(lens, dtype=np.int32)[0] == 128
+
+
+def test_join_kept():
+    require_native()
+    lines = [b"a\n", b"bb\n", b"ccc\n", b"d\n"]
+    out = native.hostops.join_kept(lines, bytes([1, 0, 1, 0]))
+    assert out == b"a\nccc\n"
+    assert native.hostops.join_kept(lines, bytes([0, 0, 0, 0])) == b""
+    assert native.hostops.join_kept([], b"") == b""
+
+
+def test_join_kept_rejects_short_mask():
+    require_native()
+    with pytest.raises(ValueError):
+        native.hostops.join_kept([b"a", b"b"], bytes([1]))
+
+
+def test_engine_pack_uses_same_layout(monkeypatch):
+    """pack_lines (module under test by the engine) must be identical
+    with and without the native path."""
+    from klogs_tpu.filters import tpu
+
+    lines = [b"alpha", b"", b"gamma" * 20]
+    with_native = tpu.pack_lines(lines, 128)
+
+    monkeypatch.setattr("klogs_tpu.native.hostops", None)
+    without = tpu.pack_lines(lines, 128)
+    assert np.array_equal(with_native[0], without[0])
+    assert np.array_equal(with_native[1], without[1])
