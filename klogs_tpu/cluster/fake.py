@@ -152,6 +152,10 @@ class FakeLogStream(LogStream):
                 pass
             if f.cut_after_lines is not None and emitted >= f.cut_after_lines:
                 return
+            if f.error_after_lines is not None and emitted >= f.error_after_lines:
+                raise StreamError(
+                    f"stream read error for {self._pod}/{self._c.name}"
+                )
             seq = self._c.next_seq
             self._c.next_seq += 1
             line = synthetic_line(self._pod, self._c.name, seq, self._clock())

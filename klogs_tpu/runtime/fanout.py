@@ -15,6 +15,7 @@ sink) instead of exiting the process with goroutines still running
 
 import asyncio
 import os
+import time
 from dataclasses import dataclass
 from typing import Callable
 
@@ -27,6 +28,16 @@ from klogs_tpu.utils.naming import log_file_name
 # Reference: rest config Burst = 100, the one tuning constant
 # (cmd/root.go:80). Bounds concurrent stream-open requests.
 DEFAULT_OPEN_BURST = 100
+
+# Follow-mode reconnection (improvement over the reference, which has no
+# retry anywhere — SURVEY.md §5 "Failure detection"): a follow stream
+# that dies is reopened with exponential backoff and a server-side
+# `since` covering the gap. A connection that delivered data and lived
+# this long counts as healthy and resets the attempt budget.
+DEFAULT_MAX_RECONNECTS = 5
+RECONNECT_HEALTHY_S = 5.0
+_BACKOFF_BASE_S = 0.5
+_BACKOFF_MAX_S = 10.0
 
 
 @dataclass
@@ -86,6 +97,7 @@ class FanoutRunner:
         log_opts: LogOptions,
         sink_factory: SinkFactory | None = None,
         open_burst: int = DEFAULT_OPEN_BURST,
+        max_reconnects: int = DEFAULT_MAX_RECONNECTS,
     ):
         self.backend = backend
         self.namespace = namespace
@@ -94,6 +106,8 @@ class FanoutRunner:
         self._open_sem = asyncio.Semaphore(open_burst)
         self._streams: list = []
         self._stopping = False
+        self._stop_event = asyncio.Event()
+        self.max_reconnects = max_reconnects
 
     async def _worker(self, job: StreamJob) -> StreamResult:
         result = StreamResult(job=job)
@@ -104,44 +118,100 @@ class FanoutRunner:
             container=job.container,
         )
         sink = self.sink_factory(job)
+        attempt = 0
         try:
-            try:
-                async with self._open_sem:
-                    stream = await self.backend.open_log_stream(
-                        self.namespace, job.pod, opts
+            while True:
+                try:
+                    async with self._open_sem:
+                        stream = await self.backend.open_log_stream(
+                            self.namespace, job.pod, opts
+                        )
+                except StreamError as e:
+                    if await self._should_reconnect(job, attempt, e):
+                        attempt += 1
+                        continue
+                    # Per-stream error isolation (cmd/root.go:326-329).
+                    term.error("Error getting logs for container %s\n%s",
+                               job.container, e)
+                    result.error = str(e)
+                    return result
+
+                if self._stopping:
+                    # stop() already ran; a stream opened after teardown
+                    # would never be closed and run() would hang.
+                    await stream.close()
+                    return result
+                self._streams.append(stream)
+                opened_at = time.monotonic()
+                got_data = False
+                stream_err: StreamError | None = None
+                try:
+                    async for chunk in stream:
+                        got_data = True
+                        await sink.write(chunk)
+                except StreamError as e:
+                    stream_err = e
+                finally:
+                    await stream.close()
+                    try:
+                        self._streams.remove(stream)
+                    except ValueError:
+                        pass
+
+                if not self.log_opts.follow or self._stopping:
+                    if stream_err is not None and not self._stopping:
+                        term.error("Error reading logs for container %s\n%s",
+                                   job.container, stream_err)
+                        result.error = str(stream_err)
+                    return result
+
+                # Follow stream ended while still wanted: reconnect with
+                # a server-side `since` covering the gap (plus 1s overlap
+                # margin; duplicate suppression is up to downstream, as
+                # with kubectl). A healthy long-lived connection resets
+                # the attempt budget.
+                if got_data and time.monotonic() - opened_at >= RECONNECT_HEALTHY_S:
+                    attempt = 0
+                if not await self._should_reconnect(job, attempt, stream_err):
+                    # cmd/root.go:314-317: deferred premature-end warning.
+                    result.premature_end = True
+                    if stream_err is not None:
+                        result.error = str(stream_err)
+                    term.warning(
+                        "Streaming logs ended prematurely for Pod: %s, Container: %s",
+                        job.pod, job.container,
                     )
-            except StreamError as e:
-                # Per-stream error isolation (cmd/root.go:326-329).
-                term.error("Error getting logs for container %s\n%s", job.container, e)
-                result.error = str(e)
-                return result
-
-            if self._stopping:
-                # stop() already ran; a stream opened after teardown
-                # would never be closed and run() would hang.
-                await stream.close()
-                return result
-            self._streams.append(stream)
-            try:
-                async for chunk in stream:
-                    await sink.write(chunk)
-            except StreamError as e:
-                term.error("Error reading logs for container %s\n%s", job.container, e)
-                result.error = str(e)
-            finally:
-                await stream.close()
-
-            if self.log_opts.follow and not self._stopping:
-                # cmd/root.go:314-317: deferred premature-end warning.
-                result.premature_end = True
-                term.warning(
-                    "Streaming logs ended prematurely for Pod: %s, Container: %s",
-                    job.pod, job.container,
+                    return result
+                attempt += 1
+                opts = LogOptions(
+                    since_seconds=max(1, int(time.monotonic() - opened_at) + 1),
+                    tail_lines=None,  # tail would re-dump history after a cut
+                    follow=True,
+                    container=job.container,
                 )
-            return result
         finally:
             await sink.close()
             result.bytes_written = sink.bytes_written
+
+    async def _should_reconnect(self, job: StreamJob, attempt: int,
+                                err: "StreamError | None") -> bool:
+        """Backoff-gated reconnect decision for follow mode; sleeps the
+        backoff (stop-aware) when reconnecting."""
+        if not self.log_opts.follow or self._stopping:
+            return False
+        if attempt >= self.max_reconnects:
+            return False
+        delay = min(_BACKOFF_BASE_S * (2 ** attempt), _BACKOFF_MAX_S)
+        term.warning(
+            "Stream for %s/%s ended (%s); reconnecting in %.1fs (attempt %d/%d)",
+            job.pod, job.container, err if err else "EOF", delay,
+            attempt + 1, self.max_reconnects,
+        )
+        try:
+            await asyncio.wait_for(self._stop_event.wait(), timeout=delay)
+            return False  # stop fired during backoff
+        except asyncio.TimeoutError:
+            return not self._stopping
 
     async def run(
         self,
@@ -178,5 +248,6 @@ class FanoutRunner:
         """Explicit teardown: close all live streams; workers then drain
         and flush their sinks."""
         self._stopping = True
+        self._stop_event.set()
         for s in list(self._streams):
             await s.close()

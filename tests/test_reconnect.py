@@ -1,0 +1,97 @@
+"""Follow-mode reconnection (improvement over the reference, which has
+no retry anywhere): backoff, gap re-fetch via since, budget exhaustion,
+stop-aware backoff abort."""
+
+import asyncio
+
+import pytest
+
+from klogs_tpu.cluster.fake import FakeCluster, Faults
+from klogs_tpu.cluster.types import LogOptions
+from klogs_tpu.runtime import fanout
+from klogs_tpu.runtime.fanout import FanoutRunner, plan_jobs
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_cluster(**kw):
+    return FakeCluster.synthetic(
+        n_pods=1, n_containers=1, lines_per_container=10, **kw
+    )
+
+
+@pytest.fixture(autouse=True)
+def fast_backoff(monkeypatch):
+    monkeypatch.setattr(fanout, "_BACKOFF_BASE_S", 0.01)
+    monkeypatch.setattr(fanout, "_BACKOFF_MAX_S", 0.05)
+
+
+def test_follow_reconnects_after_error(tmp_path, capsys):
+    fc = make_cluster(follow_interval_s=0.001)
+    cont = fc.namespaces["default"]["pod-0000"].containers["c0"]
+    cont.faults = Faults(error_after_lines=15)
+    jobs = plan_jobs(run(fc.list_pods("default")), str(tmp_path), False)
+    runner = FanoutRunner(fc, "default", LogOptions(follow=True))
+
+    async def scenario():
+        stop = asyncio.Event()
+        task = asyncio.create_task(runner.run(jobs, stop=stop))
+        await asyncio.sleep(0.6)
+        stop.set()
+        return await task
+
+    results = run(asyncio.wait_for(scenario(), timeout=10))
+    out = capsys.readouterr().out
+    assert "reconnecting" in out
+    # Reconnections kept the stream alive: more data than one 15-line
+    # connection could deliver (the fault re-fires every connection, so
+    # the budget eventually exhausts -> premature_end).
+    data = open(jobs[0].path, "rb").read()
+    assert len(data.splitlines()) > 15
+    assert results[0].premature_end is True
+
+
+def test_budget_exhaustion_marks_premature(tmp_path, capsys):
+    fc = make_cluster(follow_interval_s=0.001)
+    cont = fc.namespaces["default"]["pod-0000"].containers["c0"]
+    cont.faults = Faults(cut_after_lines=3)
+    jobs = plan_jobs(run(fc.list_pods("default")), str(tmp_path), False)
+    runner = FanoutRunner(fc, "default", LogOptions(follow=True),
+                          max_reconnects=2)
+
+    async def scenario():
+        return await runner.run(jobs, stop=asyncio.Event())
+
+    results = run(asyncio.wait_for(scenario(), timeout=10))
+    assert results[0].premature_end is True
+    out = capsys.readouterr().out
+    assert out.count("reconnecting") == 2
+    assert "ended prematurely" in out
+
+
+def test_no_reconnect_in_batch_mode(tmp_path, capsys):
+    fc = make_cluster()
+    cont = fc.namespaces["default"]["pod-0000"].containers["c0"]
+    cont.faults = Faults(error_after_lines=5)
+    jobs = plan_jobs(run(fc.list_pods("default")), str(tmp_path), False)
+    runner = FanoutRunner(fc, "default", LogOptions())
+    results = run(asyncio.wait_for(runner.run(jobs), timeout=10))
+    out = capsys.readouterr().out
+    assert "reconnecting" not in out
+    assert results[0].error is not None
+
+
+def test_open_failure_retries_in_follow(tmp_path, capsys):
+    fc = make_cluster(follow_interval_s=0.001)
+    cont = fc.namespaces["default"]["pod-0000"].containers["c0"]
+    cont.faults = Faults(fail_open=True)
+    jobs = plan_jobs(run(fc.list_pods("default")), str(tmp_path), False)
+    runner = FanoutRunner(fc, "default", LogOptions(follow=True),
+                          max_reconnects=2)
+    results = run(asyncio.wait_for(runner.run(jobs, stop=asyncio.Event()),
+                                   timeout=10))
+    out = capsys.readouterr().out
+    assert out.count("reconnecting") == 2
+    assert results[0].error is not None
