@@ -197,7 +197,7 @@ def make_pipeline_for(opts: Options):
     from klogs_tpu.filters.sink import make_pipeline
 
     try:
-        return make_pipeline(opts.match, opts.backend)
+        return make_pipeline(opts.match, opts.backend, remote=opts.remote)
     except _re.error as e:
         term.fatal("invalid --match pattern %r: %s", e.pattern, e)
     except ImportError as e:
@@ -222,6 +222,8 @@ async def run_async(
             print_plan(pods, jobs)
 
         pipeline = make_pipeline_for(opts)
+        if pipeline is not None:
+            await pipeline.start()  # remote: verify pattern set up front
         runner = FanoutRunner(
             backend, namespace, log_opts,
             sink_factory=pipeline.sink_factory if pipeline else None,

@@ -20,6 +20,7 @@ New (north-star) flags, absent from the reference:
 
   --match           repeatable regex; only matching lines are written
   --backend         filter engine: cpu (host regex) | tpu (batch NFA)
+  --remote          gate writes via a klogs-filterd service (gRPC)
   --stats           print lines/sec, matched %, batch-latency summary
   --cluster         cluster backend: kube (real) | fake (hermetic demo)
 """
@@ -48,6 +49,7 @@ class Options:
     # North-star extensions
     match: list[str] = field(default_factory=list)
     backend: str = "cpu"
+    remote: str | None = None
     stats: bool = False
     cluster: str = "kube"
 
@@ -133,6 +135,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="Line-filter engine: host regex (cpu) or batch-NFA on TPU",
     )
     p.add_argument(
+        "--remote",
+        default=None,
+        metavar="HOST:PORT",
+        help="Filter via a remote klogs-filterd service "
+        "(python -m klogs_tpu.service) instead of an in-process engine",
+    )
+    p.add_argument(
         "--stats",
         action="store_true",
         help="Print lines/sec, matched %%, and batch-latency summary",
@@ -161,6 +170,7 @@ def parse_args(argv: list[str] | None = None) -> Options:
         init_containers=ns.init_containers,
         match=list(ns.match),
         backend=ns.backend,
+        remote=ns.remote,
         stats=ns.stats,
         cluster=ns.cluster,
     )

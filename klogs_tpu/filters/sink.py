@@ -129,13 +129,18 @@ class FilteredSink(Sink):
 
 @dataclass
 class FilterPipeline:
-    """Shared engine + stats across all per-container sinks."""
+    """Shared engine + stats across all per-container sinks.
 
-    log_filter: LogFilter
+    ``log_filter`` may be None when ``service`` is a remote client (the
+    engine lives in the filterd process); sinks then always go through
+    the service."""
+
+    log_filter: LogFilter | None
     stats: FilterStats
     batch_lines: int = 1024
     deadline_s: float = 0.05
     service: "AsyncFilterService | None" = None
+    patterns: list[str] | None = None
     _live_sinks: "set[FilteredSink]" = dataclasses_field(default_factory=set)
 
     def sink_factory(self, job: StreamJob) -> Sink:
@@ -166,10 +171,17 @@ class FilterPipeline:
                 *[s.flush_if_stale() for s in list(self._live_sinks)]
             )
 
+    async def start(self) -> None:
+        """Pre-flight: remote services verify the collector's pattern
+        set against the server's before any line flows."""
+        verify = getattr(self.service, "verify_patterns", None)
+        if verify is not None and self.patterns is not None:
+            await verify(self.patterns)
+
     def close(self) -> None:
         if self.service is not None:
-            self.service.close()  # also closes the filter
-        else:
+            self.service.close()  # in-process: also closes the filter
+        elif self.log_filter is not None:
             self.log_filter.close()
 
     def print_summary(self) -> None:
@@ -185,8 +197,20 @@ class FilterPipeline:
 
 def make_pipeline(patterns: list[str], backend: str,
                   batch_lines: int | None = None,
-                  deadline_s: float = 0.05) -> FilterPipeline:
+                  deadline_s: float = 0.05,
+                  remote: str | None = None) -> FilterPipeline:
     service = None
+    if remote is not None:
+        from klogs_tpu.service.client import RemoteFilterClient
+
+        return FilterPipeline(
+            log_filter=None,
+            stats=FilterStats(),
+            batch_lines=batch_lines or 8192,
+            deadline_s=deadline_s,
+            service=RemoteFilterClient(remote),
+            patterns=patterns,
+        )
     if backend == "cpu":
         from klogs_tpu.filters.cpu import RegexFilter
 

@@ -1,0 +1,28 @@
+"""`python -m klogs_tpu.service` — run the filter service daemon."""
+
+import argparse
+import asyncio
+
+from klogs_tpu.service.server import serve
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        prog="klogs-filterd",
+        description="klogs_tpu filter service: owns the TPU engine, "
+        "serves Match RPCs to log collectors",
+    )
+    ap.add_argument("--match", action="append", required=True,
+                    help="regex pattern (repeatable)")
+    ap.add_argument("--backend", choices=["cpu", "tpu"], default="tpu")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=50051)
+    ns = ap.parse_args()
+    try:
+        asyncio.run(serve(ns.match, ns.backend, ns.host, ns.port))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

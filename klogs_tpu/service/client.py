@@ -1,0 +1,52 @@
+"""Remote filter client — plugs into FilteredSink's async `service` slot.
+
+Satisfies the same awaitable-match protocol as AsyncFilterService, so a
+collector can gate writes on a remote TPU process exactly as it would on
+an in-process engine. RPCs pipeline naturally over one HTTP/2 channel
+(each in-flight Match is its own stream), so concurrent sink flushes
+overlap without extra machinery.
+"""
+
+import grpc
+
+from klogs_tpu.service import transport
+
+
+class PatternMismatch(RuntimeError):
+    pass
+
+
+class RemoteFilterClient:
+    def __init__(self, target: str):
+        self._target = target
+        self._channel = grpc.aio.insecure_channel(target)
+        self._match_rpc = self._channel.unary_unary(transport.MATCH)
+        self._hello_rpc = self._channel.unary_unary(transport.HELLO)
+
+    async def hello(self) -> dict:
+        return transport.unpack(await self._hello_rpc(b""))
+
+    async def verify_patterns(self, patterns: list[str]) -> None:
+        """Fail fast if the server filters with a different pattern set
+        than this collector was invoked with."""
+        info = await self.hello()
+        if list(info.get("patterns", [])) != list(patterns):
+            raise PatternMismatch(
+                f"filter service at {self._target} serves patterns "
+                f"{info.get('patterns')!r}, collector wants {patterns!r}"
+            )
+
+    async def match(self, lines: list[bytes]) -> list[bool]:
+        resp = await self._match_rpc(transport.encode_match_request(lines))
+        return transport.decode_match_response(resp)
+
+    def close(self) -> None:
+        # grpc.aio channel close is a coroutine; schedule if a loop is
+        # running, else the channel dies with the process.
+        import asyncio
+
+        try:
+            loop = asyncio.get_running_loop()
+            loop.create_task(self._channel.close())
+        except RuntimeError:
+            pass

@@ -1,0 +1,85 @@
+"""Filter service server: owns the engine + device, serves Match RPCs.
+
+Run standalone:  python -m klogs_tpu.service --match ERROR --match 'WARN.*' \
+                     --backend tpu --port 50051
+
+All client batches funnel into one AsyncFilterService, so concurrent
+collectors coalesce into shared device batches (the device's efficient
+regime) regardless of how small each client's flushes are.
+"""
+
+import asyncio
+
+import grpc
+
+from klogs_tpu.filters.async_service import AsyncFilterService
+from klogs_tpu.service import transport
+from klogs_tpu.version import BUILD_VERSION
+
+
+def _make_filter(patterns: list[str], backend: str):
+    if backend == "cpu":
+        from klogs_tpu.filters.cpu import RegexFilter
+
+        return RegexFilter(patterns)
+    from klogs_tpu.filters.tpu import NFAEngineFilter
+
+    return NFAEngineFilter(patterns)
+
+
+class FilterServer:
+    def __init__(self, patterns: list[str], backend: str = "tpu",
+                 host: str = "127.0.0.1", port: int = 50051):
+        self.patterns = list(patterns)
+        self.backend = backend
+        self.host = host
+        self.port = port
+        self._service = AsyncFilterService(_make_filter(patterns, backend))
+        self._server: grpc.aio.Server | None = None
+
+    async def _hello(self, request: bytes, context) -> bytes:
+        return transport.pack({
+            "patterns": self.patterns,
+            "backend": self.backend,
+            "version": BUILD_VERSION,
+        })
+
+    async def _match(self, request: bytes, context) -> bytes:
+        lines = transport.decode_match_request(request)
+        mask = await self._service.match(lines)
+        return transport.encode_match_response(mask)
+
+    async def start(self) -> int:
+        """Binds and starts serving; returns the bound port (useful when
+        port=0 asks the OS for an ephemeral one)."""
+        handler = grpc.method_handlers_generic_handler(
+            transport.SERVICE,
+            {
+                "Hello": grpc.unary_unary_rpc_method_handler(self._hello),
+                "Match": grpc.unary_unary_rpc_method_handler(self._match),
+            },
+        )
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers((handler,))
+        self.port = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        await self._server.start()
+        return self.port
+
+    async def wait(self) -> None:
+        await self._server.wait_for_termination()
+
+    async def stop(self, grace: float = 1.0) -> None:
+        if self._server is not None:
+            await self._server.stop(grace)
+        self._service.close()
+
+
+async def serve(patterns: list[str], backend: str, host: str, port: int) -> None:
+    server = FilterServer(patterns, backend, host, port)
+    bound = await server.start()
+    print(f"klogs filterd: serving {len(patterns)} pattern(s) "
+          f"[{backend}] on {host}:{bound}", flush=True)
+    try:
+        await server.wait()
+    finally:
+        await server.stop()

@@ -1,0 +1,56 @@
+"""gRPC transport for the remote filter service.
+
+North-star role: the host->TPU-process batch boundary ("ships batches
+over gRPC to a co-located JAX process"). The log-collecting process —
+which may be anywhere a kubeconfig works — sends line batches; the
+process that owns the TPU (jax initialized once, kernels warm) returns
+keep-masks. The service end coalesces batches across ALL clients via
+AsyncFilterService, so many small collectors still produce jumbo device
+batches.
+
+Wire format: gRPC generic methods (no protoc codegen — the environment
+has grpcio but not grpcio-tools) with msgpack bodies:
+
+  /klogs.Filter/Hello   {} -> {"patterns": [...], "backend": str,
+                               "version": str}
+  /klogs.Filter/Match   {"lines": [bytes, ...]} -> {"mask": bytes}
+                        (mask[i] == 1 -> keep lines[i])
+
+Clients verify Hello.patterns against their own --match set, failing
+fast on mismatched deployments rather than silently filtering with the
+wrong patterns.
+
+The reference's closest analog is its apiserver REST client
+(/root/reference/cmd/root.go:322-325) — the one network boundary in
+that design; this is the second boundary the TPU architecture adds.
+"""
+
+import msgpack
+
+SERVICE = "klogs.Filter"
+HELLO = f"/{SERVICE}/Hello"
+MATCH = f"/{SERVICE}/Match"
+
+
+def pack(obj) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def unpack(data: bytes):
+    return msgpack.unpackb(data, raw=False)
+
+
+def encode_match_request(lines: list[bytes]) -> bytes:
+    return pack({"lines": lines})
+
+
+def decode_match_request(data: bytes) -> list[bytes]:
+    return unpack(data)["lines"]
+
+
+def encode_match_response(mask: list[bool]) -> bytes:
+    return pack({"mask": bytes(bytearray(mask))})
+
+
+def decode_match_response(data: bytes) -> list[bool]:
+    return [bool(b) for b in unpack(data)["mask"]]

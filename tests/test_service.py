@@ -1,0 +1,123 @@
+"""Filter service (gRPC): round trip, pattern verification, CLI e2e
+through --remote against FakeCluster."""
+
+import asyncio
+import os
+
+import pytest
+
+pytest.importorskip("grpc")
+
+from klogs_tpu import app
+from klogs_tpu.cli import parse_args
+from klogs_tpu.cluster.fake import FakeCluster
+from klogs_tpu.filters.cpu import RegexFilter
+from klogs_tpu.service.client import PatternMismatch, RemoteFilterClient
+from klogs_tpu.service.server import FilterServer
+
+PATTERNS = ["ERROR", r"WARN.*\d"]
+
+
+async def with_server(patterns, backend, fn):
+    server = FilterServer(patterns, backend=backend, port=0)
+    port = await server.start()
+    client = RemoteFilterClient(f"127.0.0.1:{port}")
+    try:
+        return await fn(client, port)
+    finally:
+        client.close()
+        await server.stop()
+
+
+def test_match_round_trip():
+    lines = [b"an ERROR here", b"all good", b"WARN code 42", b"WARN none"]
+
+    async def fn(client, _):
+        await client.verify_patterns(PATTERNS)
+        return await client.match(lines)
+
+    got = asyncio.run(with_server(PATTERNS, "cpu", fn))
+    assert got == RegexFilter(PATTERNS).match_lines(lines)
+
+
+def test_hello_reports_config():
+    async def fn(client, _):
+        return await client.hello()
+
+    info = asyncio.run(with_server(PATTERNS, "cpu", fn))
+    assert info["patterns"] == PATTERNS
+    assert info["backend"] == "cpu"
+
+
+def test_pattern_mismatch_fails_fast():
+    async def fn(client, _):
+        with pytest.raises(PatternMismatch):
+            await client.verify_patterns(["different"])
+
+    asyncio.run(with_server(PATTERNS, "cpu", fn))
+
+
+def test_concurrent_clients_coalesce():
+    async def fn(client, port):
+        others = [RemoteFilterClient(f"127.0.0.1:{port}") for _ in range(3)]
+        try:
+            results = await asyncio.gather(
+                client.match([b"ERROR x"]),
+                *[c.match([b"nope", b"WARN 1"]) for c in others],
+            )
+        finally:
+            for c in others:
+                c.close()
+        return results
+
+    res = asyncio.run(with_server(PATTERNS, "cpu", fn))
+    assert res[0] == [True]
+    assert all(r == [False, True] for r in res[1:])
+
+
+def test_cli_e2e_through_remote(tmp_path):
+    out_dir = str(tmp_path / "logs")
+
+    async def main():
+        server = FilterServer(["INFO"], backend="tpu", port=0)
+        port = await server.start()
+        try:
+            opts = parse_args([
+                "-n", "default", "-a", "-p", out_dir,
+                "--match", "INFO", "--remote", f"127.0.0.1:{port}",
+            ])
+            fc = FakeCluster.synthetic(n_pods=2, n_containers=1,
+                                       lines_per_container=40)
+            return await app.run_async(opts, backend=fc)
+        finally:
+            await server.stop()
+
+    rc = asyncio.run(main())
+    assert rc == 0
+    files = sorted(os.listdir(out_dir))
+    assert len(files) == 2
+    total = 0
+    for f in files:
+        with open(os.path.join(out_dir, f), "rb") as fh:
+            lines = fh.read().splitlines()
+        assert lines and all(b"INFO" in ln for ln in lines)
+        total += len(lines)
+    assert total == 20  # 1/4 of 80 lines are INFO
+
+
+def test_cli_remote_pattern_mismatch_aborts(tmp_path):
+    async def main():
+        server = FilterServer(["OTHER"], backend="cpu", port=0)
+        port = await server.start()
+        try:
+            opts = parse_args([
+                "-n", "default", "-a", "-p", str(tmp_path / "x"),
+                "--match", "INFO", "--remote", f"127.0.0.1:{port}",
+            ])
+            fc = FakeCluster.synthetic(n_pods=1)
+            with pytest.raises(PatternMismatch):
+                await app.run_async(opts, backend=fc)
+        finally:
+            await server.stop()
+
+    asyncio.run(main())
