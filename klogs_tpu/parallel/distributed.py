@@ -1,0 +1,50 @@
+"""Multi-host initialization for the filter mesh.
+
+Single-host multi-chip needs nothing: ``jax.devices()`` sees every local
+chip and MeshEngine builds its (data, pattern) mesh over them, with the
+pattern-OR collective riding ICI.
+
+Multi-host (e.g. v5e-16+ pods, or a filterd fleet spanning hosts) uses
+jax's standard distributed runtime over DCN: every process calls
+``initialize()`` before first jax use, after which ``jax.devices()``
+is the GLOBAL device list and the same MeshEngine code shards over all
+hosts — collectives ride ICI within a slice and DCN across hosts, laid
+out by XLA from the mesh axes (scaling-book recipe; nothing here is
+host-count-aware).
+
+The reference is strictly single-process (one Go binary, SURVEY.md §2);
+this is the subsystem its design never needed but the TPU architecture
+makes first-class.
+
+Environment-driven (the TPU runtime populates these on Cloud TPU pods;
+set them manually elsewhere):
+  KLOGS_COORDINATOR   host:port of process 0 (else jax defaults apply)
+  KLOGS_NUM_PROCESSES total process count
+  KLOGS_PROCESS_ID    this process's index
+"""
+
+import os
+
+import jax
+
+
+def initialize(coordinator: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> None:
+    """Idempotent jax.distributed bring-up. No-ops when the environment
+    describes a single process."""
+    coordinator = coordinator or os.environ.get("KLOGS_COORDINATOR")
+    num_processes = num_processes or _int_env("KLOGS_NUM_PROCESSES")
+    process_id = process_id if process_id is not None else _int_env("KLOGS_PROCESS_ID")
+    if num_processes in (None, 1):
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def _int_env(name: str) -> int | None:
+    v = os.environ.get(name)
+    return int(v) if v else None

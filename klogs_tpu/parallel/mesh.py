@@ -23,6 +23,7 @@ import math
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from klogs_tpu.filters.compiler.glushkov import compile_patterns
@@ -58,11 +59,23 @@ class MeshEngine:
     """Pattern-sharded, data-parallel match engine over a jax Mesh.
 
     Drop-in ``engine`` for NFAEngineFilter: exposes match_batch over
-    numpy arrays, returning a host bool mask.
+    numpy arrays, returning a device mask handle.
+
+    Two SPMD implementations with identical semantics:
+
+    - ``impl="gspmd"`` (default): sharding annotations on a vmapped
+      any-match; XLA's partitioner inserts the cross-shard all-reduce.
+    - ``impl="shard_map"``: per-shard code with an EXPLICIT collective —
+      each pattern shard evaluates its own automaton on its data rows,
+      then ``jax.lax.pmax`` ORs the bitmask across the ``pattern`` axis
+      over ICI. Same collective XLA would insert, written out so the
+      comm pattern is visible/auditable (SURVEY.md §5 "Distributed
+      communication backend").
     """
 
     def __init__(self, patterns: list[str], ignore_case: bool = False,
-                 devices=None, grid: tuple[int, int] | None = None):
+                 devices=None, grid: tuple[int, int] | None = None,
+                 impl: str = "gspmd"):
         devices = devices if devices is not None else jax.devices()
         if grid is None:
             grid = choose_grid(len(devices), len(patterns))
@@ -85,15 +98,52 @@ class MeshEngine:
             lambda _: NamedSharding(self.mesh, P("pattern")), self.dp
         )
         self.dp = jax.device_put(self.dp, prog_sharding)
-        self._fn = jax.jit(
-            nfa.match_batch_grouped,
-            in_shardings=(
-                prog_sharding,
-                NamedSharding(self.mesh, P("data", None)),
-                NamedSharding(self.mesh, P("data")),
-            ),
-            out_shardings=NamedSharding(self.mesh, P("data")),
-        )
+        if impl == "gspmd":
+            self._fn = jax.jit(
+                nfa.match_batch_grouped,
+                in_shardings=(
+                    prog_sharding,
+                    NamedSharding(self.mesh, P("data", None)),
+                    NamedSharding(self.mesh, P("data")),
+                ),
+                out_shardings=NamedSharding(self.mesh, P("data")),
+            )
+        elif impl == "shard_map":
+            try:
+                from jax import shard_map  # jax >= 0.8
+            except ImportError:
+                from jax.experimental.shard_map import shard_map
+
+            def per_shard(dp_shard, batch_local, lengths_local):
+                # dp leaves arrive with a leading local group axis of 1.
+                local = jax.tree_util.tree_map(lambda x: x[0], dp_shard)
+                matched = nfa.match_batch(local, batch_local, lengths_local)
+                # OR across pattern shards = max of 0/1 over the axis;
+                # rides ICI when the mesh spans chips.
+                return jax.lax.pmax(matched.astype(jnp.int32), "pattern") > 0
+
+            specs = dict(
+                mesh=self.mesh,
+                in_specs=(
+                    jax.tree_util.tree_map(lambda _: P("pattern"), self.dp),
+                    P("data", None),
+                    P("data"),
+                ),
+                out_specs=P("data"),
+            )
+            # The scan carry is zeros-initialized inside match_batch,
+            # which the varying-manual-axes checker flags as
+            # unvarying-meets-varying; the pmax above establishes the
+            # replication the out_spec needs, so the check is safely
+            # off. (Knob renamed check_rep -> check_vma in jax 0.8.)
+            try:
+                smapped = shard_map(per_shard, check_vma=False, **specs)
+            except TypeError:
+                smapped = shard_map(per_shard, check_rep=False, **specs)
+            self._fn = jax.jit(smapped)
+        else:
+            raise ValueError(f"unknown impl {impl!r}")
+        self.impl = impl
 
     @property
     def data_parallelism(self) -> int:

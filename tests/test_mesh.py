@@ -40,10 +40,11 @@ def test_split_patterns_balanced():
     assert sorted(sum(groups, [])) == sorted(f"p{i}" for i in range(7))
 
 
+@pytest.mark.parametrize("impl", ["gspmd", "shard_map"])
 @pytest.mark.parametrize("grid", [(8, 1), (4, 2), (2, 4), (1, 8)])
-def test_mesh_grids_agree_with_cpu(grid):
+def test_mesh_grids_agree_with_cpu(grid, impl):
     pats = ["ERROR", r"WARN.*\d", "^2026", "timeout$", "a+b", "x{3}"]
-    eng = MeshEngine(pats, grid=grid)
+    eng = MeshEngine(pats, grid=grid, impl=impl)
     f = NFAEngineFilter(pats, engine=eng)
     lines = [
         b"2026 ERROR x", b"all good", b"WARN 42", b"request timeout",
