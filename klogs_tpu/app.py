@@ -212,6 +212,15 @@ async def run_async(
 ) -> int:
     widgets.splash_screen()
     backend = backend or make_backend(opts)
+    profiling = False
+    if opts.profile:
+        # Optional tracing hook (SURVEY.md §5: the reference has none;
+        # the TPU build adds jax profiler capture for the filter path).
+        import jax.profiler
+
+        jax.profiler.start_trace(opts.profile)
+        profiling = True
+        term.info("Profiling to %s", term.green(opts.profile))
     try:
         namespace = await resolve_namespace(backend, opts, select_keys)
         pods = await select_pods(backend, namespace, opts, select_keys)
@@ -265,6 +274,10 @@ async def run_async(
             pipeline.close()
         return 0
     finally:
+        if profiling:
+            import jax.profiler
+
+            jax.profiler.stop_trace()
         await backend.close()
 
 

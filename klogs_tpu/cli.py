@@ -21,6 +21,7 @@ New (north-star) flags, absent from the reference:
   --match           repeatable regex; only matching lines are written
   --backend         filter engine: cpu (host regex) | tpu (batch NFA)
   --remote          gate writes via a klogs-filterd service (gRPC)
+  --profile         write a JAX profiler trace of the run to DIR
   --stats           print lines/sec, matched %, batch-latency summary
   --cluster         cluster backend: kube (real) | fake (hermetic demo)
 """
@@ -51,6 +52,7 @@ class Options:
     backend: str = "cpu"
     remote: str | None = None
     stats: bool = False
+    profile: str | None = None
     cluster: str = "kube"
 
 
@@ -147,6 +149,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="Print lines/sec, matched %%, and batch-latency summary",
     )
     p.add_argument(
+        "--profile",
+        default=None,
+        metavar="DIR",
+        help="Write a JAX profiler trace of the run to DIR (inspect with "
+        "TensorBoard / xprof)",
+    )
+    p.add_argument(
         "--cluster",
         choices=["kube", "fake"],
         default="kube",
@@ -172,6 +181,7 @@ def parse_args(argv: list[str] | None = None) -> Options:
         backend=ns.backend,
         remote=ns.remote,
         stats=ns.stats,
+        profile=ns.profile,
         cluster=ns.cluster,
     )
 
