@@ -216,8 +216,10 @@ async def run_async(
     if opts.profile:
         # Optional tracing hook (SURVEY.md §5: the reference has none;
         # the TPU build adds jax profiler capture for the filter path).
-        import jax.profiler
-
+        try:
+            import jax.profiler
+        except ImportError as e:
+            term.fatal("--profile requires jax: %s", e)
         jax.profiler.start_trace(opts.profile)
         profiling = True
         term.info("Profiling to %s", term.green(opts.profile))
@@ -277,7 +279,12 @@ async def run_async(
         if profiling:
             import jax.profiler
 
-            jax.profiler.stop_trace()
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:
+                # Trace serialization failure must not skip backend
+                # cleanup or mask an in-flight exception.
+                term.warning("Failed to write profiler trace: %s", e)
         await backend.close()
 
 
