@@ -1,15 +1,153 @@
-"""Real Kubernetes backend (stdlib REST client). Placeholder until the
-transport lands; --cluster fake is fully functional."""
+"""Real Kubernetes backend over the apiserver REST API (aiohttp).
 
-from klogs_tpu.cluster.backend import ClusterBackend
+The data path mirrors the reference's client-go usage without client-go:
+- namespace Get/List       (configNamespace/listNamespaces,
+                            /root/reference/cmd/root.go:90-123)
+- pod List + labelSelector (listAllPods/findPodByLabel,
+                            cmd/root.go:126-164,377-397)
+- pod log GET, chunked,    (GetLogs(...).Stream, cmd/root.go:322-325;
+  follow/since/tail         option mapping per getLopOpts,
+                            cmd/root.go:201-221)
+
+Concurrency bound: the aiohttp connector limit plays the role of the
+reference's rest config Burst = 100 (cmd/root.go:80).
+
+Ready filtering (PodReady condition, cmd/root.go:137-143) happens here
+so the app layer is backend-agnostic; FakeCluster implements the same
+contract for hermetic tests.
+"""
+
+from typing import AsyncIterator
+
+import aiohttp
+
+from klogs_tpu.cluster.backend import ClusterBackend, LogStream, StreamError
+from klogs_tpu.cluster.kubeconfig import ClusterCreds, KubeconfigError, load_creds
+from klogs_tpu.cluster.types import ContainerInfo, LogOptions, PodInfo
 from klogs_tpu.ui import term
+
+BURST = 100  # ≙ rest config Burst (cmd/root.go:80)
+CHUNK_BYTES = 64 * 1024
+
+
+class KubeLogStream(LogStream):
+    def __init__(self, resp: aiohttp.ClientResponse):
+        self._resp = resp
+
+    def __aiter__(self) -> AsyncIterator[bytes]:
+        return self._chunks()
+
+    async def _chunks(self) -> AsyncIterator[bytes]:
+        try:
+            async for chunk in self._resp.content.iter_chunked(CHUNK_BYTES):
+                yield chunk
+        except aiohttp.ClientError as e:
+            raise StreamError(f"log stream failed: {e}") from e
+
+    async def close(self) -> None:
+        self._resp.close()
 
 
 class KubeBackend(ClusterBackend):
+    def __init__(self, creds: ClusterCreds):
+        self._creds = creds
+        headers = {}
+        if creds.token:
+            headers["Authorization"] = f"Bearer {creds.token}"
+        self._session = aiohttp.ClientSession(
+            base_url=creds.server,
+            headers=headers,
+            connector=aiohttp.TCPConnector(
+                limit=BURST, ssl=creds.ssl_context
+            ),
+        )
+
     @classmethod
     def from_kubeconfig(cls, kubeconfig: str) -> "KubeBackend":
-        term.fatal(
-            "the real Kubernetes backend is not implemented yet in this build; "
-            "use --cluster fake"
+        try:
+            return cls(load_creds(kubeconfig))
+        except KubeconfigError as e:
+            # ≙ pterm.Fatal on bad kubeconfig (cmd/root.go:78).
+            term.fatal("%s", e)
+            raise AssertionError("unreachable")
+
+    def current_context(self) -> tuple[str, str]:
+        return self._creds.context_name, self._creds.namespace
+
+    async def _get_json(self, path: str, params: dict | None = None):
+        async with self._session.get(path, params=params or {}) as resp:
+            if resp.status == 404:
+                return None
+            resp.raise_for_status()
+            return await resp.json()
+
+    async def namespace_exists(self, namespace: str) -> bool:
+        return await self._get_json(f"/api/v1/namespaces/{namespace}") is not None
+
+    async def list_namespaces(self) -> list[str]:
+        data = await self._get_json("/api/v1/namespaces")
+        return [item["metadata"]["name"] for item in data.get("items", [])]
+
+    async def list_pods(
+        self, namespace: str, label_selector: str | None = None
+    ) -> list[PodInfo]:
+        params = {"labelSelector": label_selector} if label_selector else None
+        data = await self._get_json(
+            f"/api/v1/namespaces/{namespace}/pods", params
         )
-        raise AssertionError("unreachable")
+        if data is None:
+            return []
+        return [_pod_info(item, namespace) for item in data.get("items", [])]
+
+    async def open_log_stream(
+        self, namespace: str, pod: str, opts: LogOptions
+    ) -> LogStream:
+        params: dict = {"container": opts.container}
+        if opts.follow:
+            params["follow"] = "true"
+        if opts.since_seconds is not None:
+            params["sinceSeconds"] = str(opts.since_seconds)
+        if opts.tail_lines is not None:
+            params["tailLines"] = str(opts.tail_lines)
+        try:
+            resp = await self._session.get(
+                f"/api/v1/namespaces/{namespace}/pods/{pod}/log",
+                params=params,
+                timeout=aiohttp.ClientTimeout(total=None, sock_connect=30),
+            )
+            if resp.status != 200:
+                body = (await resp.text())[:300]
+                resp.close()
+                raise StreamError(
+                    f"GET log for {pod}/{opts.container}: "
+                    f"HTTP {resp.status}: {body}"
+                )
+        except aiohttp.ClientError as e:
+            raise StreamError(f"open log stream {pod}/{opts.container}: {e}") from e
+        return KubeLogStream(resp)
+
+    async def close(self) -> None:
+        await self._session.close()
+
+
+def _pod_info(item: dict, namespace: str) -> PodInfo:
+    meta = item.get("metadata", {})
+    spec = item.get("spec", {})
+    status = item.get("status", {})
+    ready = any(
+        c.get("type") == "Ready" and c.get("status") == "True"
+        for c in status.get("conditions", [])
+    )  # ≙ PodReady scan (cmd/root.go:137-143)
+    return PodInfo(
+        name=meta.get("name", ""),
+        namespace=namespace,
+        labels=meta.get("labels", {}) or {},
+        ready=ready,
+        containers=[
+            ContainerInfo(c["name"]) for c in spec.get("containers", [])
+        ],
+        init_containers=[
+            ContainerInfo(c["name"], init=True)
+            for c in spec.get("initContainers", [])
+        ],
+    )

@@ -1,0 +1,117 @@
+"""kubeconfig loading and TLS/auth resolution.
+
+The subset klogs needs (configClient, /root/reference/cmd/root.go:69-87
+and getCurrentNamespace, cmd/root.go:185-198): resolve the file
+($KUBECONFIG, explicit --kubeconfig, else ~/.kube/config), pick the
+current context, and produce everything required to talk to its
+cluster: server URL, CA trust, client-cert/token auth, and the
+context's default namespace.
+
+Supported auth: client certificates (inline *-data or file paths) and
+bearer tokens (inline or tokenFile). Exec-plugin credential helpers are
+not supported in this build — a clear error tells the user to mint a
+token instead.
+"""
+
+import base64
+import os
+import ssl
+import tempfile
+from dataclasses import dataclass
+
+import yaml
+
+
+class KubeconfigError(RuntimeError):
+    pass
+
+
+@dataclass
+class ClusterCreds:
+    context_name: str
+    namespace: str
+    server: str  # https://host:port
+    ssl_context: ssl.SSLContext
+    token: str | None  # Authorization: Bearer
+
+
+def default_kubeconfig_path() -> str:
+    env = os.environ.get("KUBECONFIG")
+    if env:
+        return env.split(os.pathsep)[0]
+    return os.path.join(os.path.expanduser("~"), ".kube", "config")
+
+
+def _materialize(inline_b64: str | None, path: str | None, label: str) -> str | None:
+    """Inline base64 data wins over file paths (kubectl precedence);
+    inline data lands in a private temp file for ssl's file-based API."""
+    if inline_b64:
+        fd, tmp = tempfile.mkstemp(prefix=f"klogs-{label}-")
+        with os.fdopen(fd, "wb") as f:
+            f.write(base64.b64decode(inline_b64))
+        return tmp
+    return path
+
+
+def load_creds(kubeconfig: str = "") -> ClusterCreds:
+    path = kubeconfig or default_kubeconfig_path()
+    try:
+        with open(path) as f:
+            cfg = yaml.safe_load(f)
+    except OSError as e:
+        raise KubeconfigError(f"cannot read kubeconfig {path}: {e}") from e
+    if not isinstance(cfg, dict):
+        raise KubeconfigError(f"kubeconfig {path} is not a mapping")
+
+    ctx_name = cfg.get("current-context") or ""
+    contexts = {c["name"]: c.get("context", {}) for c in cfg.get("contexts", [])}
+    if not ctx_name or ctx_name not in contexts:
+        raise KubeconfigError(
+            f"kubeconfig {path} has no usable current-context ({ctx_name!r})"
+        )
+    ctx = contexts[ctx_name]
+    namespace = ctx.get("namespace") or "default"
+
+    clusters = {c["name"]: c.get("cluster", {}) for c in cfg.get("clusters", [])}
+    users = {u["name"]: u.get("user", {}) for u in cfg.get("users", [])}
+    cluster = clusters.get(ctx.get("cluster", ""))
+    if cluster is None:
+        raise KubeconfigError(f"context {ctx_name!r} names unknown cluster")
+    user = users.get(ctx.get("user", ""), {})
+
+    server = cluster.get("server")
+    if not server:
+        raise KubeconfigError(f"cluster for context {ctx_name!r} has no server")
+
+    if cluster.get("insecure-skip-tls-verify"):
+        ssl_ctx = ssl._create_unverified_context()
+    else:
+        ca = _materialize(cluster.get("certificate-authority-data"),
+                          cluster.get("certificate-authority"), "ca")
+        ssl_ctx = ssl.create_default_context(cafile=ca)
+
+    cert = _materialize(user.get("client-certificate-data"),
+                        user.get("client-certificate"), "cert")
+    key = _materialize(user.get("client-key-data"),
+                       user.get("client-key"), "key")
+    if cert and key:
+        ssl_ctx.load_cert_chain(cert, key)
+
+    token = user.get("token")
+    if not token and user.get("tokenFile"):
+        with open(user["tokenFile"]) as f:
+            token = f.read().strip()
+    if not token and not (cert and key) and user.get("exec"):
+        raise KubeconfigError(
+            "exec-plugin credential helpers are not supported; create a "
+            "ServiceAccount token (kubectl create token ...) and put it in "
+            "the kubeconfig user as `token:`"
+        )
+
+    return ClusterCreds(
+        context_name=ctx_name,
+        namespace=namespace,
+        server=server.rstrip("/"),
+        ssl_context=ssl_ctx,
+        token=token,
+    )
