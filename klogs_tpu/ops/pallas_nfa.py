@@ -126,28 +126,48 @@ DEFAULT_TILE_B_GROUPED = 4096
 
 
 def _grouped_kernel(cls_ref, char_mask_t_ref, follow_t_ref, out_ref,
-                    *, T: int, C: int, live: int, acc: int, unroll: int = 1):
+                    *, T: int, C: int, live: int, acc: int,
+                    unroll: int = 1, interleave: int = 1):
     """One (batch-tile, group) grid cell. The grid iterates groups
     innermost, so out_ref (indexed by tile only) stays VMEM-resident and
-    accumulates the OR across groups."""
+    accumulates the OR across groups.
+
+    ``interleave=2`` splits the lane tile into two independent halves
+    advanced in the same loop body — two dependency chains let the
+    scheduler overlap one half's MXU matmuls with the other's VPU
+    compare/AND (the serial step chain is otherwise MXU-then-VPU with
+    bubbles). Semantics identical; pick by measurement.
+    """
     TILE_B = cls_ref.shape[1]
     S = follow_t_ref.shape[1]
     g = pl.program_id(1)
-    iota_c = jax.lax.broadcasted_iota(jnp.int32, (C, TILE_B), 0)
-    v0 = (jax.lax.broadcasted_iota(jnp.int32, (S, TILE_B), 0) == live
-          ).astype(jnp.int8)
+    H = TILE_B // interleave
 
-    def step(t, v):
-        c = cls_ref[pl.ds(t, 1), :]
-        onehot = (iota_c == c).astype(jnp.int8)
-        mask = jnp.dot(char_mask_t_ref[0], onehot,
-                       preferred_element_type=jnp.int32)
-        reach = jnp.dot(follow_t_ref[0], v,
-                        preferred_element_type=jnp.int32)
-        return ((reach > 0) & (mask > 0)).astype(jnp.int8)
+    def make_step(lo):
+        iota_c = jax.lax.broadcasted_iota(jnp.int32, (C, H), 0)
 
-    v = jax.lax.fori_loop(0, T, step, v0, unroll=unroll)
-    matched = v[acc : acc + 1, :]
+        def half_step(t, v):
+            c = cls_ref[pl.ds(t, 1), lo : lo + H]
+            onehot = (iota_c == c).astype(jnp.int8)
+            mask = jnp.dot(char_mask_t_ref[0], onehot,
+                           preferred_element_type=jnp.int32)
+            reach = jnp.dot(follow_t_ref[0], v,
+                            preferred_element_type=jnp.int32)
+            return ((reach > 0) & (mask > 0)).astype(jnp.int8)
+
+        return half_step
+
+    v0_half = [
+        (jax.lax.broadcasted_iota(jnp.int32, (S, H), 0) == live).astype(jnp.int8)
+        for _ in range(interleave)
+    ]
+    steps = [make_step(i * H) for i in range(interleave)]
+
+    def step(t, vs):
+        return tuple(s(t, v) for s, v in zip(steps, vs))
+
+    vs = jax.lax.fori_loop(0, T, step, tuple(v0_half), unroll=unroll)
+    matched = jnp.concatenate([v[acc : acc + 1, :] for v in vs], axis=1)
 
     @pl.when(g == 0)
     def _():
@@ -159,12 +179,14 @@ def _grouped_kernel(cls_ref, char_mask_t_ref, follow_t_ref, out_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("live", "acc", "tile_b",
-                                             "interpret", "unroll"))
+                                             "interpret", "unroll",
+                                             "interleave"))
 def match_batch_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
                                batch: jax.Array, lengths: jax.Array,
                                tile_b: int = DEFAULT_TILE_B_GROUPED,
                                interpret: bool = False,
-                               unroll: int = 1) -> jax.Array:
+                               unroll: int = 1,
+                               interleave: int = 1) -> jax.Array:
     """Full-line match over a compile_grouped program ([G, ...] leaves,
     shared byte classifier): [B, L] u8 + [B] -> [B] bool."""
     B = batch.shape[0]
@@ -185,7 +207,7 @@ def match_batch_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
 
     out = pl.pallas_call(
         functools.partial(_grouped_kernel, T=T, C=C, live=live, acc=acc,
-                          unroll=unroll),
+                          unroll=unroll, interleave=interleave),
         grid=(B // TILE_B, G),  # groups innermost: out block revisited
         in_specs=[
             pl.BlockSpec((T, TILE_B), lambda i, g: (0, i),
