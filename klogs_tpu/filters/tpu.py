@@ -158,9 +158,12 @@ class NFAEngineFilter(LogFilter):
         if self._engine is not None:
             return self._engine.match_batch(batch, lengths)
         if self._kernel in ("pallas", "interpret"):
+            from klogs_tpu.ops.tune import env_overrides
+
             return self._pallas.match_batch_grouped_pallas(
                 self._dp_grouped, self._g_live, self._g_acc, batch, lengths,
                 interpret=(self._kernel == "interpret"),
+                **env_overrides(),
             )
         return self._nfa.match_batch(self._dp, batch, lengths)
 

@@ -1,0 +1,129 @@
+"""Kernel autotuning: pick (tile_b, interleave) by measurement.
+
+The grouped kernel's best configuration depends on hardware details the
+code cannot see (VMEM per core, MXU/VPU overlap behavior, dispatch
+latency of the attach), so it is measured, not guessed: a short
+pipelined sweep on the live device, cached per (automaton shape, batch
+geometry, device kind) in ``~/.cache/klogs_tpu/tune.json``.
+
+Hooked in two places:
+- NFAEngineFilter reads KLOGS_TPU_TILE / KLOGS_TPU_INTERLEAVE env
+  overrides, else the cache (if a prior tune ran), else defaults.
+- bench.py / operators run ``tune_grouped`` explicitly (KLOGS_BENCH_TUNE=1).
+"""
+
+import json
+import os
+import time
+
+CANDIDATE_TILES = (1024, 2048, 4096, 8192)
+CANDIDATE_INTERLEAVE = (1, 2)
+
+
+def _cache_path() -> str:
+    base = os.environ.get("XDG_CACHE_HOME",
+                          os.path.join(os.path.expanduser("~"), ".cache"))
+    return os.path.join(base, "klogs_tpu", "tune.json")
+
+
+def _key(dp, batch_shape, device_kind: str) -> str:
+    G = dp.follow.shape[0]
+    return f"{device_kind}|G{G}|S{dp.n_states}|C{dp.n_classes}|B{batch_shape[0]}x{batch_shape[1]}"
+
+
+def load_cached(dp, batch_shape, device_kind: str) -> dict | None:
+    try:
+        with open(_cache_path()) as f:
+            return json.load(f).get(_key(dp, batch_shape, device_kind))
+    except (OSError, ValueError):
+        return None
+
+
+def _store(dp, batch_shape, device_kind: str, cfg: dict) -> None:
+    path = _cache_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    try:
+        with open(path) as f:
+            all_cfg = json.load(f)
+    except (OSError, ValueError):
+        all_cfg = {}
+    all_cfg[_key(dp, batch_shape, device_kind)] = cfg
+    with open(path, "w") as f:
+        json.dump(all_cfg, f, indent=1)
+
+
+def tune_grouped(dp, live: int, acc: int, batch, lengths,
+                 repeats: int = 3, n_flight: int = 6,
+                 runner=None, quiet: bool = False) -> dict:
+    """Sweep the candidate grid on the live device; returns the winning
+    {"tile_b", "interleave", "lines_per_s"} and caches it.
+
+    ``runner(tile_b, interleave) -> lines_per_s`` is injectable for
+    tests; the default measures match_batch_grouped_pallas pipelined
+    (N dispatches in flight, one sync — per-call blocking would measure
+    the attach round trip, not the kernel).
+    """
+    import jax
+
+    from klogs_tpu.ops.pallas_nfa import match_batch_grouped_pallas
+
+    B = batch.shape[0]
+
+    def default_runner(tile_b: int, interleave: int) -> float:
+        if B % tile_b and tile_b < B:
+            return 0.0
+        run = lambda: match_batch_grouped_pallas(
+            dp, live, acc, batch, lengths,
+            tile_b=tile_b, interleave=interleave,
+        )
+        run().block_until_ready()  # compile
+        best = 0.0
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            outs = [run() for _ in range(n_flight)]
+            outs[-1].block_until_ready()
+            best = max(best, n_flight * B / (time.perf_counter() - t0))
+        return best
+
+    runner = runner or default_runner
+    results = []
+    seen = set()
+    for tile in (min(t, B) for t in CANDIDATE_TILES):
+        for il in CANDIDATE_INTERLEAVE:
+            if tile % il or tile // il < 8 or (tile, il) in seen:
+                continue
+            seen.add((tile, il))
+            try:
+                lps = runner(tile, il)
+            except Exception as e:  # VMEM overflow / compile failure
+                if not quiet:
+                    print(f"tune: tile={tile} interleave={il} failed: "
+                          f"{str(e)[:80]}")
+                continue
+            if lps > 0:
+                results.append({"tile_b": tile, "interleave": il,
+                                "lines_per_s": round(lps, 1)})
+                if not quiet:
+                    print(f"tune: tile={tile} interleave={il} "
+                          f"-> {lps:,.0f} lines/s")
+    if not results:
+        raise RuntimeError("kernel tuning failed for every candidate config")
+    best = max(results, key=lambda r: r["lines_per_s"])
+    try:
+        import jax
+
+        device_kind = jax.devices()[0].device_kind
+    except Exception:
+        device_kind = "unknown"
+    _store(dp, batch.shape, device_kind, best)
+    return best
+
+
+def env_overrides() -> dict:
+    """KLOGS_TPU_TILE / KLOGS_TPU_INTERLEAVE, when set."""
+    out = {}
+    if os.environ.get("KLOGS_TPU_TILE"):
+        out["tile_b"] = int(os.environ["KLOGS_TPU_TILE"])
+    if os.environ.get("KLOGS_TPU_INTERLEAVE"):
+        out["interleave"] = int(os.environ["KLOGS_TPU_INTERLEAVE"])
+    return out

@@ -1,0 +1,61 @@
+"""Autotune harness plumbing (runner injected; no device timing)."""
+
+import numpy as np
+import pytest
+
+from klogs_tpu.ops import nfa
+from klogs_tpu.ops.tune import env_overrides, load_cached, tune_grouped
+
+
+@pytest.fixture
+def dp():
+    d, live, acc = nfa.compile_grouped(["ERROR", "WARN"])
+    return d, live, acc
+
+
+def test_tune_picks_best_and_caches(dp, tmp_path, monkeypatch):
+    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path))
+    d, live, acc = dp
+    batch = np.zeros((4096, 128), np.uint8)
+    lengths = np.full(4096, 100, np.int32)
+    calls = []
+
+    def runner(tile_b, interleave):
+        calls.append((tile_b, interleave))
+        return 1000.0 * tile_b / (1 + interleave)  # favor tile 8192, il 1
+
+    best = tune_grouped(d, live, acc, batch, lengths, runner=runner, quiet=True)
+    # Tiles are clamped to the 4096-row batch, so 4096/il=1 wins.
+    assert best["tile_b"] == 4096 and best["interleave"] == 1
+    assert len(calls) >= 6
+    assert all(t <= 4096 for t, _ in calls)
+    cached = load_cached(d, batch.shape, _device_kind())
+    assert cached == best
+
+
+def test_tune_survives_failing_configs(dp, tmp_path, monkeypatch):
+    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path))
+    d, live, acc = dp
+    batch = np.zeros((1024, 128), np.uint8)
+    lengths = np.full(1024, 10, np.int32)
+
+    def runner(tile_b, interleave):
+        if tile_b > 1024:
+            raise RuntimeError("VMEM OOM")
+        return 500.0 / interleave
+
+    best = tune_grouped(d, live, acc, batch, lengths, runner=runner, quiet=True)
+    assert best["tile_b"] == 1024 and best["interleave"] == 1
+
+
+def test_env_overrides(monkeypatch):
+    assert env_overrides() == {}
+    monkeypatch.setenv("KLOGS_TPU_TILE", "2048")
+    monkeypatch.setenv("KLOGS_TPU_INTERLEAVE", "2")
+    assert env_overrides() == {"tile_b": 2048, "interleave": 2}
+
+
+def _device_kind():
+    import jax
+
+    return jax.devices()[0].device_kind
