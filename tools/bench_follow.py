@@ -1,0 +1,72 @@
+"""Follow-mode latency/throughput bench (BASELINE.md config 4).
+
+Drives the FULL production pipeline (FakeCluster follow streams →
+fan-out → framing → coalescing async filter → gated file writes) at a
+controlled offered load and reports sustained lines/sec plus batch
+latency percentiles from FilterStats.
+
+Distinct from bench.py (the driver contract) because follow mode needs
+wall-clock dwell time; run it by hand / from CI:
+
+    python tools/bench_follow.py --pods 200 --seconds 60 --backend tpu
+
+Env: KLOGS_FOLLOW_RATE_HZ per-stream line rate (default 100).
+"""
+
+import argparse
+import asyncio
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from klogs_tpu import app  # noqa: E402
+from klogs_tpu.cli import parse_args  # noqa: E402
+from klogs_tpu.cluster.fake import FakeCluster  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pods", type=int, default=200)
+    ap.add_argument("--seconds", type=float, default=60)
+    ap.add_argument("--backend", choices=["cpu", "tpu"], default="tpu")
+    ap.add_argument("--match", action="append",
+                    default=None, help="patterns (default: 'failed')")
+    ap.add_argument("--backlog-lines", type=int, default=50,
+                    help="historical lines per container at start")
+    ns = ap.parse_args()
+    patterns = ns.match or ["failed"]
+    rate = float(os.environ.get("KLOGS_FOLLOW_RATE_HZ", "100"))
+
+    out_dir = tempfile.mkdtemp(prefix="klogs-bench-follow-")
+    fc = FakeCluster.synthetic(
+        n_pods=ns.pods, n_containers=1,
+        lines_per_container=ns.backlog_lines,
+        follow_interval_s=1.0 / rate,
+    )
+    argv = ["-n", "default", "-a", "-f", "-p", out_dir,
+            "--backend", ns.backend, "--stats"]
+    for p in patterns:
+        argv += ["--match", p]
+    opts = parse_args(argv)
+
+    async def run():
+        stop = asyncio.Event()
+
+        async def stopper():
+            await asyncio.sleep(ns.seconds)
+            stop.set()
+
+        asyncio.create_task(stopper())
+        t0 = time.perf_counter()
+        await app.run_async(opts, backend=fc, stop=stop)
+        print(f"run returned {time.perf_counter() - t0 - ns.seconds:.1f}s "
+              f"after stop (drain+teardown)")
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()
