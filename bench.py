@@ -92,7 +92,13 @@ def device_lps(lines, repeats: int):
 
     if use_kernel:
         dp, live, acc = nfa.compile_grouped(PATTERNS)
-        run = lambda: match_batch_grouped_pallas(dp, live, acc, db, dl)
+        kw = {}
+        if os.environ.get("KLOGS_BENCH_TUNE") == "1":
+            from klogs_tpu.ops.tune import tune_grouped
+
+            best = tune_grouped(dp, live, acc, db, dl, quiet=False)
+            kw = {"tile_b": best["tile_b"], "interleave": best["interleave"]}
+        run = lambda: match_batch_grouped_pallas(dp, live, acc, db, dl, **kw)
     else:
         from klogs_tpu.filters.compiler.glushkov import compile_patterns
 
