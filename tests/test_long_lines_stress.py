@@ -1,0 +1,57 @@
+"""Long-line stress: tens-of-KB lines (hundreds of chunk crossings) through the chunked
+carried-state path, mixed with short lines, on both execution paths —
+the long-context scaling story (SURVEY.md §5) at realistic sizes."""
+
+import random
+import re
+
+import pytest
+
+from klogs_tpu.filters.cpu import RegexFilter
+from klogs_tpu.filters.tpu import NFAEngineFilter
+
+
+@pytest.mark.parametrize("kernel", ["jnp", "interpret"])
+def test_100kb_lines_match_parity(kernel):
+    rng = random.Random(3)
+    filler = bytes(rng.choice(b"abcdefgh ") for _ in range(20_000))
+    lines = [
+        filler[:10_000] + b"needle in the middle" + filler[10_000:],
+        filler,  # no needle
+        b"needle early" + filler,
+        filler + b"needle at end",
+        b"short needle",
+        b"",
+    ]
+    pats = ["needle"]
+    f = NFAEngineFilter(pats, chunk_bytes=2048, kernel=kernel)
+    assert f.match_lines(lines) == RegexFilter(pats).match_lines(lines)
+
+
+def test_anchored_on_long_lines():
+    n = 40_000
+    body = b"z" * n
+    pats = ["^BEGIN", "END$", r"^\d{4}"]
+    lines = [
+        b"BEGIN" + body,
+        body + b"END",
+        b"2026" + body,
+        b"x" + b"BEGIN" + body,          # ^BEGIN must not fire mid-line
+        body + b"END" + b"x",            # END$ must not fire before tail
+    ]
+    f = NFAEngineFilter(pats, chunk_bytes=4096)
+    assert f.match_lines(lines) == RegexFilter(pats).match_lines(lines) == [
+        True, True, True, False, False,
+    ]
+
+
+def test_pattern_spanning_many_chunks():
+    # A bounded repeat long enough to span several 1 KiB chunks keeps
+    # carried NFA state correct across >100 chunk boundaries.
+    pats = [r"a[0-9]{600}b"]
+    digits = bytes(random.Random(7).choice(b"0123456789") for _ in range(600))
+    good = b"x" * 500 + b"a" + digits + b"b" + b"y" * 20_000
+    bad = b"x" * 500 + b"a" + digits[:-1] + b"qb" + b"y" * 20_000
+    f = NFAEngineFilter(pats, chunk_bytes=512)
+    expect = RegexFilter(pats).match_lines([good, bad])
+    assert f.match_lines([good, bad]) == expect == [True, False]
