@@ -1,0 +1,163 @@
+"""Sequence-parallel matching of ONE huge line (the long-context op).
+
+The vector scan (ops/nfa, ops/pallas_nfa) is latency-bound on a single
+line: T sequential steps, one tiny matmul each — a 1 MB line takes ~1.5 s
+at ~1.5 us/step no matter how wide the machine is. This module removes
+the sequential bottleneck with the classic linear-recurrence trick:
+
+The AUGMENTED automaton (nfa.augment — inject folded into the `live`
+self-loop, accept into the absorbing `acc` sink) makes the per-byte
+update LINEAR over the boolean semiring:
+
+    v_{t} = v_{t-1} @ A[c_t],   A[c][i,j] = Follow[i,j] AND B[c][j]
+
+Matrix products are associative, so a tile of T0 bytes folds into one
+transfer matrix M_tile = A[c_1] ... A[c_T0] by a log2(T0)-depth tree of
+BATCHED [S,S]x[S,S] matmuls — T0-way parallel work the MXU eats whole —
+and tiles compose across the line (and across DEVICES, each taking a
+contiguous span, with one [S,S] matrix per device to gather: the
+sequence-parallel layout SURVEY.md §5 notes as the scaling option).
+
+Cost model, honestly: the matrix path does S x more multiply work per
+byte than the vector scan (S^3 vs S^2 per step-ish), but it converts a
+serial chain into parallel batched matmuls. For S=128 on v5e the vector
+scan is ~us/byte (latency) while the tree is ~ns/byte (throughput) —
+a ~100x single-line win, growing linearly with devices. Use it when one
+line is huge; the batched vector kernel remains optimal when
+parallelism already comes from many lines.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from klogs_tpu.ops.nfa import DeviceProgram
+
+DEFAULT_TILE_T = 512
+
+
+def _bmm_bool(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Batched boolean matrix product on int8 0/1 operands."""
+    return (
+        jnp.einsum("bij,bjk->bik", a, b, preferred_element_type=jnp.int32) > 0
+    ).astype(jnp.int8)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def tile_transfer_matrices(dp: DeviceProgram, cls: jax.Array) -> jax.Array:
+    """classes [N, T0] -> transfer matrices [N, S, S] (one per tile),
+    each the ordered product of its per-character step matrices, built
+    by a log-depth pairwise tree so every level is one batched matmul.
+    T0 must be a power of two (pad with pad_class: its step matrix is
+    absorbing for live/acc and kills everything else, which is exactly
+    the semantics of positions past the end of the line)."""
+    N, T0 = cls.shape
+    S = dp.n_states
+    # A[c][i,j] = follow[i,j] & char_mask[c][j]
+    a = dp.follow[None, :, :].astype(jnp.int8) * \
+        dp.char_mask[cls.reshape(-1)][:, None, :].astype(jnp.int8)  # [N*T0,S,S]
+    while a.shape[0] > N:
+        a = _bmm_bool(a[0::2], a[1::2])
+    return a
+
+
+def _pad_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def classify_line(dp: DeviceProgram, line: bytes, tile_t: int) -> np.ndarray:
+    """Class ids for one line incl. BEGIN/END/latch, padded to a
+    multiple of tile_t (tile_t must be a power of two)."""
+    body = np.frombuffer(line, dtype=np.uint8)
+    cls = np.asarray(dp.byte_class)[body]
+    full = np.concatenate([
+        np.array([dp.begin_class], dtype=np.int32),
+        cls.astype(np.int32),
+        np.array([dp.end_class, dp.pad_class], dtype=np.int32),  # END + latch
+    ])
+    T = len(full)
+    pad = -T % tile_t
+    if pad:
+        full = np.concatenate(
+            [full, np.full(pad, dp.pad_class, dtype=np.int32)])
+    return full
+
+
+def match_line_scan(dp: DeviceProgram, live: int, acc: int, line: bytes,
+                    tile_t: int = DEFAULT_TILE_T) -> bool:
+    """Single-device sequence-parallel match of one line: per-tile
+    transfer matrices by batched tree, then a cheap sequential
+    vector-matrix fold across tiles (S^2 per tile_t bytes)."""
+    assert tile_t & (tile_t - 1) == 0, "tile_t must be a power of two"
+    cls = classify_line(dp, line, tile_t).reshape(-1, tile_t)
+    mats = tile_transfer_matrices(dp, jnp.asarray(cls))  # [n_tiles, S, S]
+
+    def fold(v, m):
+        return (
+            jnp.einsum("j,jk->k", v, m, preferred_element_type=jnp.int32) > 0
+        ).astype(jnp.int8), None
+
+    v0 = (jnp.arange(dp.n_states) == live).astype(jnp.int8)
+    v, _ = jax.lax.scan(fold, v0, mats)
+    return bool(np.asarray(v)[acc]) or dp.match_all
+
+
+def match_line_sharded(dp: DeviceProgram, live: int, acc: int, line: bytes,
+                       mesh=None, tile_t: int = DEFAULT_TILE_T) -> bool:
+    """Sequence-parallel across DEVICES: the line's tiles shard over a
+    1-D ``seq`` mesh axis; each device folds its contiguous span into
+    one [S, S] transfer matrix, and the D per-device matrices compose
+    after an all-gather — D-1 extra [S,S] matmuls total, the analog of
+    a ring/all-to-all sequence-parallel step."""
+    import jax.sharding as shd
+
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    if mesh is None:
+        devs = np.asarray(jax.devices())
+        mesh = shd.Mesh(devs, ("seq",))
+    D = mesh.devices.size
+    P = shd.PartitionSpec
+
+    cls = classify_line(dp, line, tile_t)
+    n_tiles = len(cls) // tile_t
+    pad_tiles = -n_tiles % D
+    if pad_tiles:
+        cls = np.concatenate(
+            [cls, np.full(pad_tiles * tile_t, dp.pad_class, dtype=np.int32)])
+    cls = cls.reshape(-1, tile_t)
+
+    def per_device(cls_local):
+        mats = tile_transfer_matrices(dp, cls_local)  # [tiles/D, S, S]
+
+        def fold(m_acc, m):
+            return _bmm_bool(m_acc[None], m[None])[0], None
+
+        eye = jnp.eye(dp.n_states, dtype=jnp.int8)
+        m_dev, _ = jax.lax.scan(fold, eye, mats)  # [S, S]
+        # One matrix per device; compose in device order.
+        all_m = jax.lax.all_gather(m_dev, "seq")  # [D, S, S]
+
+        def fold2(m_acc, m):
+            return _bmm_bool(m_acc[None], m[None])[0], None
+
+        m_total, _ = jax.lax.scan(fold2, eye, all_m)
+        return m_total[None]  # [1, S, S] -> gathered to [D, S, S]
+
+    specs = dict(mesh=mesh, in_specs=(P("seq"),), out_specs=P("seq"))
+    try:
+        fn = shard_map(per_device, check_vma=False, **specs)
+    except TypeError:
+        fn = shard_map(per_device, check_rep=False, **specs)
+    m_total = np.asarray(jax.jit(fn)(jnp.asarray(cls)))[0]  # replicated
+    v0 = np.zeros(dp.n_states, dtype=np.int64)
+    v0[live] = 1
+    return bool((v0 @ m_total)[acc] > 0) or dp.match_all

@@ -1,0 +1,72 @@
+"""Sequence-parallel single-line matching ≡ host regex — single device
+and sharded over the 8-device CPU mesh."""
+
+import random
+import re
+
+import numpy as np
+import pytest
+
+import jax
+
+from klogs_tpu.filters.cpu import RegexFilter
+from klogs_tpu.ops import nfa
+from klogs_tpu.ops.seqscan import match_line_scan, match_line_sharded
+from tests.test_compiler import oracle
+
+
+def compile_aug(patterns):
+    from klogs_tpu.filters.compiler.glushkov import compile_patterns
+
+    prog = compile_patterns(patterns)
+    dp = nfa.pack_program(nfa.augment(prog), dtype=np.int8)
+    return dp, prog.n_states, prog.n_states + 1  # live, acc
+
+
+CASES = [
+    (["needle"], b"x" * 5000 + b"needle" + b"y" * 5000, True),
+    (["needle"], b"x" * 5000 + b"needl" + b"y" * 5000, False),
+    (["^start"], b"start" + b"z" * 3000, True),
+    (["^start"], b"z" + b"start" + b"z" * 3000, False),
+    (["end$"], b"z" * 3000 + b"end", True),
+    (["end$"], b"z" * 3000 + b"end" + b"!", False),
+    ([r"a[0-9]{200}b"], b"a" + b"7" * 200 + b"b" + b"pad" * 500, True),
+    (["x", "q"], b"".join(bytes([65 + i % 20]) for i in range(4000)), False),
+    (["^$"], b"", True),
+    (["^$"], b"x", False),
+]
+
+
+@pytest.mark.parametrize("patterns,line,expected", CASES,
+                         ids=lambda v: repr(v)[:30])
+def test_single_device(patterns, line, expected):
+    assert oracle(patterns, line) == expected
+    dp, live, acc = compile_aug(patterns)
+    assert match_line_scan(dp, live, acc, line, tile_t=128) == expected
+
+
+@pytest.mark.parametrize("patterns,line,expected", CASES[:6],
+                         ids=lambda v: repr(v)[:30])
+def test_sharded_8dev(patterns, line, expected):
+    assert jax.device_count() == 8
+    dp, live, acc = compile_aug(patterns)
+    assert match_line_sharded(dp, live, acc, line, tile_t=128) == expected
+
+
+def test_property_vs_oracle():
+    rng = random.Random(11)
+    alphabet = b"ab0 ."
+    for _ in range(10):
+        pats = [
+            "".join(rng.choice("ab0.") for _ in range(rng.randrange(1, 4)))
+            for _ in range(rng.randrange(1, 3))
+        ]
+        line = bytes(rng.choice(alphabet) for _ in range(rng.randrange(300, 900)))
+        expect = oracle(pats, line)
+        dp, live, acc = compile_aug(pats)
+        assert match_line_scan(dp, live, acc, line, tile_t=64) == expect, pats
+
+
+def test_matchall_shortcut():
+    dp, live, acc = compile_aug(["a|"])
+    assert match_line_scan(dp, live, acc, b"zzz") is True
