@@ -311,7 +311,10 @@ def stack_programs(progs: list[NFAProgram], dtype=jnp.float32) -> DeviceProgram:
 
 
 def compile_grouped(patterns: list[str], ignore_case: bool = False,
-                    max_positions: int = 126, dtype=jnp.int8):
+                    max_positions: int = 126, dtype=jnp.int8,
+                    n_groups: int | None = None,
+                    states_pad: int | None = None,
+                    classes_pad: int | None = None):
     """Compile K patterns into G small AUGMENTED automata with a SHARED
     byte classifier, stacked as [G, ...] arrays — the single-chip perf
     lever: MXU cost of the reachability matmul is quadratic in the state
@@ -319,8 +322,13 @@ def compile_grouped(patterns: list[str], ignore_case: bool = False,
     live/acc included) beat one union automaton of G*126 states by ~G x.
 
     Returns (DeviceProgram with [G, ...] leaves and a shared [256]
-    byte_class, live_index, acc_index). live/acc sit at S-2/S-1 in every
-    group. Any-match over groups == any-match over patterns.
+    byte_class, live_index, acc_index). live/acc sit at S-2/S-1 and the
+    BEGIN/END/PAD classes at C-3/C-2/C-1 in every group, so programs
+    compiled with forced pads (``n_groups``/``states_pad``/``classes_pad``
+    — used to make several pattern shards shape-uniform for stacking
+    under shard_map) share all static metadata. Extra forced groups are
+    all-dead (zero char_mask: can never match). Any-match over groups ==
+    any-match over patterns.
     """
     from klogs_tpu.filters.compiler.glushkov import compile_patterns
 
@@ -339,16 +347,17 @@ def compile_grouped(patterns: list[str], ignore_case: bool = False,
         else:
             bins.append((n, [p]))
     progs = [compile_patterns(ps, ignore_case=ignore_case) for _, ps in bins]
-    G = len(progs)
+    G = max(len(progs), n_groups or 0)
 
     # Shared byte classifier: bytes equivalent in EVERY group collapse.
-    sig = np.stack([p.byte_class for p in progs], axis=1)  # [256, G]
+    sig = np.stack([p.byte_class for p in progs], axis=1)  # [256, G']
     uniq, byte_class = np.unique(sig, axis=0, return_inverse=True)
     byte_class = byte_class.astype(np.int32)
     n_glob = uniq.shape[0]
-    begin_c, end_c, pad_c = n_glob, n_glob + 1, n_glob + 2
-    C = _pad_to(n_glob + 3, 8)
-    S = max(LANE, _pad_to(max(p.n_states for p in progs) + 2, LANE))
+    C = max(_pad_to(n_glob + 3, 8), classes_pad or 0)
+    begin_c, end_c, pad_c = C - 3, C - 2, C - 1
+    S = max(LANE, _pad_to(max(p.n_states for p in progs) + 2, LANE),
+            states_pad or 0)
     live, acc = S - 2, S - 1
 
     char_mask = np.zeros((G, C, S), dtype=np.float32)

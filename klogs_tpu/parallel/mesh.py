@@ -71,6 +71,11 @@ class MeshEngine:
       over ICI. Same collective XLA would insert, written out so the
       comm pattern is visible/auditable (SURVEY.md §5 "Distributed
       communication backend").
+    - ``impl="pallas"`` (and ``"pallas_interpret"`` for hermetic tests):
+      shard_map with the grouped Pallas kernel as the per-shard compute —
+      the production multi-chip hot path (VMEM-resident kernel per chip,
+      pmax OR across pattern shards over ICI). Pattern groups are
+      bin-packed per shard via compile_grouped.
     """
 
     def __init__(self, patterns: list[str], ignore_case: bool = False,
@@ -83,14 +88,16 @@ class MeshEngine:
         if d * g != len(devices):
             raise ValueError(f"grid {grid} != device count {len(devices)}")
         groups = split_patterns(patterns, g)
-        g = len(groups)  # may shrink if fewer patterns than shards
-        progs = [compile_patterns(grp, ignore_case=ignore_case) for grp in groups]
-        # If g shrank, replicate the last group to fill the axis: a
+        # If fewer pattern groups than shards, replicate the last: a
         # duplicate group changes nothing under any-match.
-        while len(progs) < grid[1]:
-            progs.append(progs[-1])
+        while len(groups) < grid[1]:
+            groups.append(groups[-1])
         self.grid = (d, grid[1])
         self.mesh = Mesh(np.asarray(devices).reshape(self.grid), ("data", "pattern"))
+        if impl in ("pallas", "pallas_interpret"):
+            self._init_pallas(groups, ignore_case, impl)
+            return
+        progs = [compile_patterns(grp, ignore_case=ignore_case) for grp in groups]
         self.dp = nfa.stack_programs(progs)
         self.match_all = self.dp.match_all
 
@@ -143,6 +150,60 @@ class MeshEngine:
             self._fn = jax.jit(smapped)
         else:
             raise ValueError(f"unknown impl {impl!r}")
+        self.impl = impl
+
+    def _init_pallas(self, groups: list[list[str]], ignore_case: bool,
+                     impl: str) -> None:
+        """shard_map with the grouped Pallas kernel as per-shard compute
+        — the production multi-chip hot path. Shards must be
+        shape-uniform, so each shard's pattern set compiles twice: once
+        to learn its natural (G, S, C), then with forced pads to the
+        maxima (dead filler groups can never match)."""
+        from klogs_tpu.ops.pallas_nfa import match_batch_grouped_pallas
+
+        probe = [nfa.compile_grouped(ps, ignore_case=ignore_case)[0]
+                 for ps in groups]
+        G = max(p.follow.shape[0] for p in probe)
+        S = max(p.n_states for p in probe)
+        C = max(p.n_classes for p in probe)
+        dps = [nfa.compile_grouped(ps, ignore_case=ignore_case,
+                                   n_groups=G, states_pad=S, classes_pad=C)[0]
+               for ps in groups]
+        live, acc = S - 2, S - 1
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *dps
+        )  # leaves [n_shards, ...]; aux uniform by construction
+        self.dp = stacked
+        self.match_all = stacked.match_all
+        interpret = impl == "pallas_interpret"
+
+        def per_shard(dp_shard, batch_local, lengths_local):
+            local = jax.tree_util.tree_map(lambda x: x[0], dp_shard)
+            matched = match_batch_grouped_pallas(
+                local, live, acc, batch_local, lengths_local,
+                tile_b=min(2048, batch_local.shape[0]), interpret=interpret,
+            )
+            return jax.lax.pmax(matched.astype(jnp.int32), "pattern") > 0
+
+        try:
+            from jax import shard_map
+        except ImportError:
+            from jax.experimental.shard_map import shard_map
+
+        specs = dict(
+            mesh=self.mesh,
+            in_specs=(
+                jax.tree_util.tree_map(lambda _: P("pattern"), stacked),
+                P("data", None),
+                P("data"),
+            ),
+            out_specs=P("data"),
+        )
+        try:
+            smapped = shard_map(per_shard, check_vma=False, **specs)
+        except TypeError:
+            smapped = shard_map(per_shard, check_rep=False, **specs)
+        self._fn = jax.jit(smapped)
         self.impl = impl
 
     @property

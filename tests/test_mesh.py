@@ -40,7 +40,7 @@ def test_split_patterns_balanced():
     assert sorted(sum(groups, [])) == sorted(f"p{i}" for i in range(7))
 
 
-@pytest.mark.parametrize("impl", ["gspmd", "shard_map"])
+@pytest.mark.parametrize("impl", ["gspmd", "shard_map", "pallas_interpret"])
 @pytest.mark.parametrize("grid", [(8, 1), (4, 2), (2, 4), (1, 8)])
 def test_mesh_grids_agree_with_cpu(grid, impl):
     pats = ["ERROR", r"WARN.*\d", "^2026", "timeout$", "a+b", "x{3}"]
