@@ -228,7 +228,10 @@ def make_pipeline(patterns: list[str], backend: str,
         if jax.device_count() > 1:
             from klogs_tpu.parallel.mesh import MeshEngine
 
-            engine = MeshEngine(patterns)
+            # Real chips: per-shard Pallas kernel; virtual/CPU meshes:
+            # GSPMD over the jnp path (kernel needs Mosaic or interpret).
+            impl = "pallas" if jax.default_backend() != "cpu" else "gspmd"
+            engine = MeshEngine(patterns, impl=impl)
         log_filter = NFAEngineFilter(patterns, engine=engine)
         # Device batches are cheap per line but each round trip has fixed
         # latency: bigger batches + the async pipeline hide it.
