@@ -19,7 +19,10 @@ class PatternMismatch(RuntimeError):
 class RemoteFilterClient:
     def __init__(self, target: str):
         self._target = target
-        self._channel = grpc.aio.insecure_channel(target)
+        self._channel = grpc.aio.insecure_channel(target, options=[
+            ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+            ("grpc.max_send_message_length", 256 * 1024 * 1024),
+        ])
         self._match_rpc = self._channel.unary_unary(transport.MATCH)
         self._hello_rpc = self._channel.unary_unary(transport.HELLO)
 

@@ -59,7 +59,13 @@ class FilterServer:
                 "Match": grpc.unary_unary_rpc_method_handler(self._match),
             },
         )
-        self._server = grpc.aio.server()
+        # Jumbo batches (thousands of long lines) exceed gRPC's 4 MB
+        # default message cap; the batcher bounds real sizes well under
+        # this.
+        self._server = grpc.aio.server(options=[
+            ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+            ("grpc.max_send_message_length", 256 * 1024 * 1024),
+        ])
         self._server.add_generic_rpc_handlers((handler,))
         self.port = self._server.add_insecure_port(f"{self.host}:{self.port}")
         await self._server.start()

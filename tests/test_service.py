@@ -105,6 +105,18 @@ def test_cli_e2e_through_remote(tmp_path):
     assert total == 20  # 1/4 of 80 lines are INFO
 
 
+def test_jumbo_batch_over_default_grpc_cap():
+    """A coalesced batch well past gRPC's 4 MB default must round-trip."""
+    lines = [b"x" * 4096 for _ in range(2000)]  # ~8 MB
+    lines[500] = b"y" * 2000 + b"ERROR" + b"y" * 2000
+
+    async def fn(client, _):
+        return await client.match(lines)
+
+    got = asyncio.run(with_server(PATTERNS, "cpu", fn))
+    assert got.count(True) == 1 and got[500] is True
+
+
 def test_cli_remote_pattern_mismatch_aborts(tmp_path):
     async def main():
         server = FilterServer(["OTHER"], backend="cpu", port=0)
