@@ -76,6 +76,12 @@ class NFAEngineFilter(LogFilter):
     same code path on CPU — semantics are identical, per conftest's
     hermetic setup)."""
 
+    # Above this, a single line routes to the sequence-parallel scan
+    # (ops/seqscan): the chunked vector path costs len/chunk_bytes
+    # SEQUENTIAL device dispatches, which for one huge line is pure
+    # latency; the transfer-matrix tree turns it into batched matmuls.
+    SEQ_SCAN_BYTES = 128 * 1024
+
     def __init__(self, patterns: list[str], ignore_case: bool = False,
                  chunk_bytes: int = 4096, engine=None, kernel: str | None = None):
         import jax
@@ -129,7 +135,9 @@ class NFAEngineFilter(LogFilter):
         parts = []  # (index_list, device_mask_or_ndarray)
 
         short_idx = [i for i, b in enumerate(bodies) if len(b) <= self._chunk_bytes]
-        long_idx = [i for i, b in enumerate(bodies) if len(b) > self._chunk_bytes]
+        long_idx = [i for i, b in enumerate(bodies)
+                    if self._chunk_bytes < len(b) <= self.SEQ_SCAN_BYTES]
+        huge_idx = [i for i, b in enumerate(bodies) if len(b) > self.SEQ_SCAN_BYTES]
 
         # Bucket short lines by padded width to bound jit-cache churn.
         buckets: dict[int, list[int]] = {}
@@ -142,6 +150,8 @@ class NFAEngineFilter(LogFilter):
             parts.append((idxs, self._match_full(batch, lengths)))
         if long_idx:
             parts.append((long_idx, self._match_long([bodies[i] for i in long_idx])))
+        if huge_idx:
+            parts.append((huge_idx, self._match_huge([bodies[i] for i in huge_idx])))
         return (len(lines), parts)
 
     def fetch(self, handle) -> list[bool]:
@@ -199,6 +209,25 @@ class NFAEngineFilter(LogFilter):
                     first=first, final=final,
                 )
         return matched  # device array (padded); fetch() slices on host
+
+    def _match_huge(self, bodies: list[bytes]) -> np.ndarray:
+        """Sequence-parallel scan per line (ops/seqscan): log-depth
+        batched transfer-matrix composition instead of len/chunk
+        sequential dispatches."""
+        import jax.numpy as jnp
+
+        from klogs_tpu.ops import seqscan
+
+        if not hasattr(self, "_dp_seq"):
+            aug = self._nfa.augment(self._prog)
+            self._dp_seq = self._nfa.pack_program(aug, dtype=jnp.int8)
+            self._seq_live = self._prog.n_states
+            self._seq_acc = self._prog.n_states + 1
+        return np.array([
+            seqscan.match_line_scan(self._dp_seq, self._seq_live,
+                                    self._seq_acc, b)
+            for b in bodies
+        ], dtype=bool)
 
     def close(self) -> None:
         if self._engine is not None:

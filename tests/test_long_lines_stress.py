@@ -55,3 +55,17 @@ def test_pattern_spanning_many_chunks():
     f = NFAEngineFilter(pats, chunk_bytes=512)
     expect = RegexFilter(pats).match_lines([good, bad])
     assert f.match_lines([good, bad]) == expect == [True, False]
+
+
+@pytest.mark.parametrize("kernel", ["jnp", "interpret"])
+def test_huge_lines_route_to_seqscan(kernel, monkeypatch):
+    """Lines past SEQ_SCAN_BYTES take the sequence-parallel path and
+    still agree with the host regex, mixed with short/long lines."""
+    monkeypatch.setattr(NFAEngineFilter, "SEQ_SCAN_BYTES", 8192)
+    pats = ["needle", "tail$"]
+    huge_hit = b"q" * 20_000 + b"needle" + b"q" * 20_000
+    huge_tail = b"q" * 30_000 + b"tail"
+    huge_miss = b"q" * 40_000
+    lines = [b"short needle", huge_hit, b"q" * 5000, huge_tail, huge_miss]
+    f = NFAEngineFilter(pats, chunk_bytes=2048, kernel=kernel)
+    assert f.match_lines(lines) == RegexFilter(pats).match_lines(lines)
