@@ -169,3 +169,14 @@ def test_property_vs_regex_filter(kernel):
 
 def test_empty_batch():
     assert NFAEngineFilter(["x"]).match_lines([]) == []
+
+
+def test_binary_lines_and_nul_bytes():
+    """Log lines are opaque bytes (io.Copy in the reference): NUL and
+    high bytes must flow through matching unharmed."""
+    pats = ["café", r"a\x00b", "日本"]
+    lines = [b"xx caf\xc3\xa9 yy", b"a\x00b", b"\x00\x01\x02",
+             "日本語".encode(), b"cafe", bytes(range(256))]
+    for kernel in KERNELS:
+        f = NFAEngineFilter(pats, kernel=kernel)
+        assert f.match_lines(lines) == RegexFilter(pats).match_lines(lines)
