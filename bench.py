@@ -127,30 +127,96 @@ def device_lps(lines, repeats: int):
 
 
 def _device_subprocess(timeout_s: float):
-    """Run the device measurement in a child process with a hard
-    timeout: a wedged TPU attach hangs inside backend init (C code), so
-    in-process timeouts cannot interrupt it and the driver would stall.
-    Returns (pipelined, e2e) or None."""
+    """Run the device measurement in a child process, retrying until the
+    timeout budget is spent. A wedged TPU attach hangs inside backend
+    init (C code) — in-process timeouts cannot interrupt it — and the
+    wedge is transient: it clears with waiting, so one shot wastes the
+    budget. The child prints ATTACHED as soon as ``jax.devices()``
+    returns; only that attach phase runs on a short per-attempt timer
+    (wedges manifest there). Once attached, the child keeps the whole
+    remaining budget, so a slow-but-healthy measurement (big batch, tune
+    sweep, slow remote compiles) is never killed mid-run. Returns
+    (pipelined, e2e) or None once the budget is exhausted."""
     import subprocess
 
     code = (
-        "import bench, json, os, sys;"
+        "import json, os, sys;"
+        "import jax; jax.devices();"
+        "print('ATTACHED', flush=True);"
+        "import bench;"
         "n=int(os.environ.get('KLOGS_BENCH_LINES','200000'));"
         "b=int(os.environ.get('KLOGS_BENCH_DEVICE_BATCH','32768'));"
         "r=int(os.environ.get('KLOGS_BENCH_REPEATS','3'));"
         "lines=bench.make_lines(min(n,b));"
         "print('RESULT:'+json.dumps(bench.device_lps(lines,r)))"
     )
-    try:
-        res = subprocess.run(
-            [sys.executable, "-c", code], capture_output=True, text=True,
-            timeout=timeout_s, cwd=os.path.dirname(os.path.abspath(__file__)),
-        )
-    except subprocess.TimeoutExpired:
-        return None
-    for line in res.stdout.splitlines():
-        if line.startswith("RESULT:"):
-            return json.loads(line[len("RESULT:"):])
+    import selectors
+    import tempfile
+
+    attach_s = float(os.environ.get("KLOGS_BENCH_DEVICE_ATTACH_S", "120"))
+    retry_pause_s = float(os.environ.get("KLOGS_BENCH_DEVICE_RETRY_PAUSE_S", "45"))
+    deadline = time.monotonic() + timeout_s
+    attempt = 0
+    while attempt == 0 or deadline - time.monotonic() > 5:
+        attempt += 1
+        # stderr goes to a temp FILE, not a pipe: a chatty child (libtpu
+        # warning storms, compile logs) would fill a 64KB pipe we don't
+        # drain and deadlock before ever printing RESULT — and the file
+        # keeps diagnostics for every failure mode, including kills.
+        with tempfile.TemporaryFile(mode="w+") as errf:
+            proc = subprocess.Popen(
+                [sys.executable, "-u", "-c", code],
+                stdout=subprocess.PIPE, stderr=errf,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            attach_deadline = min(time.monotonic() + attach_s, deadline)
+            attached = False
+            result = None
+            failure = None
+            # Raw-fd reads + manual line splitting: a buffered readline()
+            # would block past the watchdog on a partial line, and its
+            # lookahead buffer would desync select().
+            fd = proc.stdout.fileno()
+            buf = b""
+            sel = selectors.DefaultSelector()
+            sel.register(fd, selectors.EVENT_READ)
+            try:
+                while result is None:
+                    now = time.monotonic()
+                    cutoff = deadline if attached else attach_deadline
+                    if now >= cutoff:
+                        phase = "measurement" if attached else "attach"
+                        failure = f"{phase} timed out (killed)"
+                        proc.kill()
+                        break
+                    if not sel.select(timeout=min(5.0, cutoff - now)):
+                        continue
+                    chunk = os.read(fd, 65536)
+                    if chunk == b"":  # EOF: child exited
+                        proc.wait()
+                        failure = f"exited rc={proc.returncode}"
+                        break
+                    buf += chunk
+                    while b"\n" in buf:
+                        line, buf = buf.split(b"\n", 1)
+                        if line.startswith(b"ATTACHED"):
+                            attached = True
+                        elif line.startswith(b"RESULT:"):
+                            result = json.loads(line[len(b"RESULT:"):])
+                            break
+            finally:
+                sel.close()
+                if proc.poll() is None:
+                    proc.kill()
+                proc.wait()
+            if result is not None:
+                return result
+            errf.seek(0)
+            tail = errf.read().strip().splitlines()[-3:]
+            print(f"bench: device attempt {attempt} {failure}: "
+                  f"{' | '.join(tail)}", file=sys.stderr)
+        if deadline - time.monotonic() > retry_pause_s:
+            time.sleep(retry_pause_s)
     return None
 
 

@@ -57,6 +57,10 @@ class AsyncFilterService:
         self._pending_lines = 0
         self._kick_handle: asyncio.TimerHandle | None = None
         self._closed = False
+        # Strong refs: the loop only weakly references tasks, so a
+        # coalesced-batch task could be GC'd mid-flight, stranding every
+        # caller future in its group.
+        self._tasks: set[asyncio.Task] = set()
         self.batches_dispatched = 0  # for tests / stats
 
     async def match(self, lines: list[bytes]) -> list[bool]:
@@ -86,7 +90,9 @@ class AsyncFilterService:
             return
         group, self._pending = self._pending, []
         self._pending_lines = 0
-        loop.create_task(self._run_group(group))
+        task = loop.create_task(self._run_group(group))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
 
     async def _run_group(self, group) -> None:
         loop = asyncio.get_running_loop()

@@ -188,18 +188,26 @@ def match_batch_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
                                unroll: int = 1,
                                interleave: int = 1) -> jax.Array:
     """Full-line match over a compile_grouped program ([G, ...] leaves,
-    shared byte classifier): [B, L] u8 + [B] -> [B] bool."""
+    shared byte classifier): [B, L] u8 + [B] -> [B] bool.
+
+    Any batch size works: B is padded up to a multiple of the tile
+    inside (zero-length pad rows can only hit via match_all, and they
+    are sliced off before return), so callers — in particular MeshEngine
+    shards whose local batch need not divide the tile — never trip a
+    divisibility error."""
     B = batch.shape[0]
+    TILE_B = min(tile_b, B)
+    Bp = -(-B // TILE_B) * TILE_B
+    if Bp != B:
+        batch = jnp.pad(batch, ((0, Bp - B), (0, 0)))
+        lengths = jnp.pad(lengths, (0, Bp - B))
     cls = classify_chunk(dp, batch, lengths, first=True, final=True)
     cls = jnp.concatenate(
-        [cls, jnp.full((B, 1), dp.pad_class, dtype=jnp.int32)], axis=1
+        [cls, jnp.full((Bp, 1), dp.pad_class, dtype=jnp.int32)], axis=1
     )  # acc latch step
     T = cls.shape[1]
     S, C = dp.n_states, dp.n_classes
     G = dp.follow.shape[0]
-    TILE_B = min(tile_b, B)
-    if B % TILE_B:
-        raise ValueError(f"batch {B} not divisible by tile {TILE_B}")
 
     # char_mask [G,C,S] -> [G,S,C]; follow [G,S,S] -> [G,S,S]^T per group.
     char_mask_t = jnp.swapaxes(dp.char_mask, 1, 2)
@@ -208,7 +216,7 @@ def match_batch_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
     out = pl.pallas_call(
         functools.partial(_grouped_kernel, T=T, C=C, live=live, acc=acc,
                           unroll=unroll, interleave=interleave),
-        grid=(B // TILE_B, G),  # groups innermost: out block revisited
+        grid=(Bp // TILE_B, G),  # groups innermost: out block revisited
         in_specs=[
             pl.BlockSpec((T, TILE_B), lambda i, g: (0, i),
                          memory_space=pltpu.VMEM),          # cls (transposed)
@@ -219,11 +227,11 @@ def match_batch_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
         ],
         out_specs=pl.BlockSpec((1, TILE_B), lambda i, g: (0, i),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((1, B), jnp.int8),
+        out_shape=jax.ShapeDtypeStruct((1, Bp), jnp.int8),
         interpret=interpret,
     )(cls.T, char_mask_t, follow_t)
 
-    return (out[0, :] > 0) | jnp.asarray(dp.match_all)
+    return (out[0, :B] > 0) | jnp.asarray(dp.match_all)
 
 
 def initial_state_kernel(dp: DeviceProgram, live: int, batch_size: int):

@@ -37,6 +37,19 @@ from klogs_tpu.ops.nfa import DeviceProgram
 
 DEFAULT_TILE_T = 512
 
+# Peak footprint of the tree's first level is N*T0 step matrices of S^2
+# int8 each (>=16 KB per input byte at S=128). One jumbo line processed
+# in a single call therefore OOMs the device — a ~1 MB line would want
+# ~16 GB. Matching is instead CHUNKED: at most this many step-matrix
+# bytes are materialized per tile_transfer_matrices call, and the
+# resulting per-chunk matrices fold sequentially into the carry.
+DEFAULT_STEP_BYTES_BUDGET = 128 << 20
+
+
+def _tiles_per_chunk(tile_t: int, n_states: int,
+                     budget: int = DEFAULT_STEP_BYTES_BUDGET) -> int:
+    return max(1, budget // (tile_t * n_states * n_states))
+
 
 def _bmm_bool(a: jax.Array, b: jax.Array) -> jax.Array:
     """Batched boolean matrix product on int8 0/1 operands."""
@@ -52,7 +65,10 @@ def tile_transfer_matrices(dp: DeviceProgram, cls: jax.Array) -> jax.Array:
     by a log-depth pairwise tree so every level is one batched matmul.
     T0 must be a power of two (pad with pad_class: its step matrix is
     absorbing for live/acc and kills everything else, which is exactly
-    the semantics of positions past the end of the line)."""
+    the semantics of positions past the end of the line).
+
+    Materializes N*T0 step matrices — callers must bound N*T0 (see
+    _tiles_per_chunk / DEFAULT_STEP_BYTES_BUDGET)."""
     N, T0 = cls.shape
     S = dp.n_states
     # A[c][i,j] = follow[i,j] & char_mask[c][j]
@@ -88,32 +104,63 @@ def classify_line(dp: DeviceProgram, line: bytes, tile_t: int) -> np.ndarray:
     return full
 
 
-def match_line_scan(dp: DeviceProgram, live: int, acc: int, line: bytes,
-                    tile_t: int = DEFAULT_TILE_T) -> bool:
-    """Single-device sequence-parallel match of one line: per-tile
-    transfer matrices by batched tree, then a cheap sequential
-    vector-matrix fold across tiles (S^2 per tile_t bytes)."""
-    assert tile_t & (tile_t - 1) == 0, "tile_t must be a power of two"
-    cls = classify_line(dp, line, tile_t).reshape(-1, tile_t)
-    mats = tile_transfer_matrices(dp, jnp.asarray(cls))  # [n_tiles, S, S]
+@functools.partial(jax.jit, static_argnames=("live",))
+def _scan_chunked(dp: DeviceProgram, cls3: jax.Array, live: int) -> jax.Array:
+    """cls3 [n_chunks, tiles_per_chunk, tile_t] -> final state vector.
+    The outer scan bounds peak memory to ONE chunk's step matrices."""
 
-    def fold(v, m):
-        return (
-            jnp.einsum("j,jk->k", v, m, preferred_element_type=jnp.int32) > 0
-        ).astype(jnp.int8), None
+    def chunk_step(v, cls_chunk):
+        mats = tile_transfer_matrices(dp, cls_chunk)  # [tpc, S, S]
+
+        def fold(v, m):
+            return (
+                jnp.einsum("j,jk->k", v, m,
+                           preferred_element_type=jnp.int32) > 0
+            ).astype(jnp.int8), None
+
+        v, _ = jax.lax.scan(fold, v, mats)
+        return v, None
 
     v0 = (jnp.arange(dp.n_states) == live).astype(jnp.int8)
-    v, _ = jax.lax.scan(fold, v0, mats)
+    v, _ = jax.lax.scan(chunk_step, v0, cls3)
+    return v
+
+
+def _chunk_classes(dp: DeviceProgram, cls: np.ndarray, tile_t: int,
+                   tiles_per_chunk: int, round_to: int = 1) -> np.ndarray:
+    """[n_tiles, tile_t] -> [n_chunks, tiles_per_chunk, tile_t], chunk
+    count padded (with pad_class tiles, which are identity for live/acc)
+    up to a power of two times ``round_to`` so the jit cache sees
+    O(log line-length) distinct shapes, not one per length."""
+    n_tiles = cls.shape[0]
+    n_chunks = _pad_pow2(-(-n_tiles // tiles_per_chunk))
+    n_chunks = -(-n_chunks // round_to) * round_to
+    pad = n_chunks * tiles_per_chunk - n_tiles
+    if pad:
+        cls = np.concatenate(
+            [cls, np.full((pad, tile_t), dp.pad_class, dtype=np.int32)])
+    return cls.reshape(n_chunks, tiles_per_chunk, tile_t)
+
+
+def match_line_scan(dp: DeviceProgram, live: int, acc: int, line: bytes,
+                    tile_t: int = DEFAULT_TILE_T,
+                    step_bytes_budget: int = DEFAULT_STEP_BYTES_BUDGET) -> bool:
+    """Single-device sequence-parallel match of one line: per-tile
+    transfer matrices by batched tree, then a cheap sequential
+    vector-matrix fold across tiles (S^2 per tile_t bytes). Peak device
+    memory is bounded by ``step_bytes_budget`` regardless of line size —
+    tiles are processed in fixed-size chunks folded into the carry."""
+    assert tile_t & (tile_t - 1) == 0, "tile_t must be a power of two"
+    cls = classify_line(dp, line, tile_t).reshape(-1, tile_t)
+    tpc = _tiles_per_chunk(tile_t, dp.n_states, step_bytes_budget)
+    cls3 = _chunk_classes(dp, cls, tile_t, tpc)
+    v = _scan_chunked(dp, jnp.asarray(cls3), live)
     return bool(np.asarray(v)[acc]) or dp.match_all
 
 
-def match_line_sharded(dp: DeviceProgram, live: int, acc: int, line: bytes,
-                       mesh=None, tile_t: int = DEFAULT_TILE_T) -> bool:
-    """Sequence-parallel across DEVICES: the line's tiles shard over a
-    1-D ``seq`` mesh axis; each device folds its contiguous span into
-    one [S, S] transfer matrix, and the D per-device matrices compose
-    after an all-gather — D-1 extra [S,S] matmuls total, the analog of
-    a ring/all-to-all sequence-parallel step."""
+def _sharded_fn(mesh, n_states: int):
+    """Build (once per mesh, via the jit cache on the returned callable)
+    the shard_map'd per-device chunked fold."""
     import jax.sharding as shd
 
     try:
@@ -121,28 +168,22 @@ def match_line_sharded(dp: DeviceProgram, live: int, acc: int, line: bytes,
     except ImportError:
         from jax.experimental.shard_map import shard_map
 
-    if mesh is None:
-        devs = np.asarray(jax.devices())
-        mesh = shd.Mesh(devs, ("seq",))
-    D = mesh.devices.size
     P = shd.PartitionSpec
 
-    cls = classify_line(dp, line, tile_t)
-    n_tiles = len(cls) // tile_t
-    pad_tiles = -n_tiles % D
-    if pad_tiles:
-        cls = np.concatenate(
-            [cls, np.full(pad_tiles * tile_t, dp.pad_class, dtype=np.int32)])
-    cls = cls.reshape(-1, tile_t)
+    def per_device(dp, cls3_local):
+        eye = jnp.eye(n_states, dtype=jnp.int8)
 
-    def per_device(cls_local):
-        mats = tile_transfer_matrices(dp, cls_local)  # [tiles/D, S, S]
+        def chunk_step(m_acc, cls_chunk):
+            mats = tile_transfer_matrices(dp, cls_chunk)  # [tpc, S, S]
 
-        def fold(m_acc, m):
-            return _bmm_bool(m_acc[None], m[None])[0], None
+            def fold(m, m2):
+                return _bmm_bool(m[None], m2[None])[0], None
 
-        eye = jnp.eye(dp.n_states, dtype=jnp.int8)
-        m_dev, _ = jax.lax.scan(fold, eye, mats)  # [S, S]
+            m, _ = jax.lax.scan(fold, m_acc, mats)
+            return m, None
+
+        # Chunked fold bounds peak memory to one chunk's step matrices.
+        m_dev, _ = jax.lax.scan(chunk_step, eye, cls3_local)  # [S, S]
         # One matrix per device; compose in device order.
         all_m = jax.lax.all_gather(m_dev, "seq")  # [D, S, S]
 
@@ -152,12 +193,47 @@ def match_line_sharded(dp: DeviceProgram, live: int, acc: int, line: bytes,
         m_total, _ = jax.lax.scan(fold2, eye, all_m)
         return m_total[None]  # [1, S, S] -> gathered to [D, S, S]
 
-    specs = dict(mesh=mesh, in_specs=(P("seq"),), out_specs=P("seq"))
+    specs = dict(mesh=mesh,
+                 in_specs=(P(), P("seq")),
+                 out_specs=P("seq"))
     try:
         fn = shard_map(per_device, check_vma=False, **specs)
     except TypeError:
         fn = shard_map(per_device, check_rep=False, **specs)
-    m_total = np.asarray(jax.jit(fn)(jnp.asarray(cls)))[0]  # replicated
+    return jax.jit(fn)
+
+
+_SHARDED_CACHE: dict = {}
+
+
+def match_line_sharded(dp: DeviceProgram, live: int, acc: int, line: bytes,
+                       mesh=None, tile_t: int = DEFAULT_TILE_T,
+                       step_bytes_budget: int = DEFAULT_STEP_BYTES_BUDGET) -> bool:
+    """Sequence-parallel across DEVICES: the line's tile-chunks shard
+    over a 1-D ``seq`` mesh axis; each device folds its contiguous span
+    into one [S, S] transfer matrix (chunk by chunk, so peak memory is
+    bounded by ``step_bytes_budget`` per device), and the D per-device
+    matrices compose after an all-gather — D-1 extra [S,S] matmuls
+    total, the analog of a ring/all-to-all sequence-parallel step. The
+    shard_map'd program is cached per (mesh, S); chunk counts are padded
+    to powers of two so distinct line lengths reuse compilations."""
+    import jax.sharding as shd
+
+    if mesh is None:
+        devs = np.asarray(jax.devices())
+        mesh = shd.Mesh(devs, ("seq",))
+    D = mesh.devices.size
+
+    cls = classify_line(dp, line, tile_t).reshape(-1, tile_t)
+    tpc = _tiles_per_chunk(tile_t, dp.n_states, step_bytes_budget)
+    # Chunk count a power of two AND a multiple of D -> equal spans.
+    cls3 = _chunk_classes(dp, cls, tile_t, tpc, round_to=D)
+
+    key = (mesh, dp.n_states)
+    fn = _SHARDED_CACHE.get(key)
+    if fn is None:
+        fn = _SHARDED_CACHE[key] = _sharded_fn(mesh, dp.n_states)
+    m_total = np.asarray(fn(dp, jnp.asarray(cls3)))[0]  # replicated
     v0 = np.zeros(dp.n_states, dtype=np.int64)
     v0[live] = 1
     return bool((v0 @ m_total)[acc] > 0) or dp.match_all

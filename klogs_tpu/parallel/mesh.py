@@ -170,6 +170,14 @@ class MeshEngine:
                                    n_groups=G, states_pad=S, classes_pad=C)[0]
                for ps in groups]
         live, acc = S - 2, S - 1
+        # match_all is pytree AUX data and may differ across shards (a
+        # nullable pattern in one group only); tree_map stacking requires
+        # identical aux, so force the any() verdict uniformly — the OR
+        # across shards is what the engine computes anyway.
+        import dataclasses
+
+        any_match_all = any(d.match_all for d in dps)
+        dps = [dataclasses.replace(d, match_all=any_match_all) for d in dps]
         stacked = jax.tree_util.tree_map(
             lambda *xs: jnp.stack(xs), *dps
         )  # leaves [n_shards, ...]; aux uniform by construction
@@ -179,9 +187,11 @@ class MeshEngine:
 
         def per_shard(dp_shard, batch_local, lengths_local):
             local = jax.tree_util.tree_map(lambda x: x[0], dp_shard)
+            # tile_b is a cap; the kernel wrapper pads any local batch up
+            # to a tile multiple, so non-power-of-two shard sizes work.
             matched = match_batch_grouped_pallas(
                 local, live, acc, batch_local, lengths_local,
-                tile_b=min(2048, batch_local.shape[0]), interpret=interpret,
+                tile_b=2048, interpret=interpret,
             )
             return jax.lax.pmax(matched.astype(jnp.int32), "pattern") > 0
 

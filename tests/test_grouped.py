@@ -77,3 +77,18 @@ def test_property_grouped_vs_oracle():
         assert got == exp, f"patterns={pats!r}"
         tested += 1
     assert tested >= 6
+
+
+def test_non_divisible_batch_pads_inside_kernel():
+    # The wrapper must pad any batch up to a tile multiple (VERDICT r1:
+    # a direct caller whose B is > tile and not a multiple used to die).
+    pats = ["ERROR", r"x\d+"]
+    dp, live, acc = nfa.compile_grouped(pats)
+    lines = ([b"ERROR here", b"fine", b"x42", b"xab"] * 6)[:21]  # B=21
+    batch, lengths = pack_lines(lines, 32)
+    batch, lengths = batch[:21], lengths[:21]  # defeat pack bucketing
+    for tile in (4, 8, 16):
+        m = np.asarray(match_batch_grouped_pallas(
+            dp, live, acc, batch, lengths, tile_b=tile, interpret=True))
+        assert m.shape == (21,)
+        assert m.tolist() == RegexFilter(pats).match_lines(lines)

@@ -70,3 +70,27 @@ def test_property_vs_oracle():
 def test_matchall_shortcut():
     dp, live, acc = compile_aug(["a|"])
     assert match_line_scan(dp, live, acc, b"zzz") is True
+
+
+def test_chunked_budget_bounds_memory():
+    # A tiny step-matrix budget forces many chunks; results must be
+    # identical (ADVICE r1 high: unbounded [T,S,S] materialization).
+    patterns, line, expected = CASES[0]
+    dp, live, acc = compile_aug(patterns)
+    # budget < one tile's step matrices -> tiles_per_chunk == 1
+    assert match_line_scan(dp, live, acc, line, tile_t=128,
+                           step_bytes_budget=1 << 16) == expected
+    patterns, line, expected = CASES[4]  # end$ anchor crosses chunks
+    dp, live, acc = compile_aug(patterns)
+    assert match_line_scan(dp, live, acc, line, tile_t=128,
+                           step_bytes_budget=1 << 16) == expected
+
+
+def test_sharded_chunked_budget():
+    dp, live, acc = compile_aug(["needle"])
+    line = b"x" * 20000 + b"needle" + b"y" * 20000
+    assert match_line_sharded(dp, live, acc, line, tile_t=128,
+                              step_bytes_budget=1 << 16) is True
+    line = b"x" * 40000
+    assert match_line_sharded(dp, live, acc, line, tile_t=128,
+                              step_bytes_budget=1 << 16) is False
