@@ -233,48 +233,53 @@ async def run_async(
             print_plan(pods, jobs)
 
         pipeline = make_pipeline_for(opts)
-        if pipeline is not None:
-            await pipeline.start()  # remote: verify pattern set up front
-        runner = FanoutRunner(
-            backend, namespace, log_opts,
-            sink_factory=pipeline.sink_factory if pipeline else None,
-        )
-        if opts.follow and jobs:
-            flusher = (
-                asyncio.create_task(pipeline.run_deadline_flusher())
-                if pipeline is not None else None
+        try:
+            if pipeline is not None:
+                await pipeline.start()  # remote: verify patterns up front
+            runner = FanoutRunner(
+                backend, namespace, log_opts,
+                sink_factory=pipeline.sink_factory if pipeline else None,
             )
-            if stop is None:
-                stop = asyncio.Event()
-                watcher_done = threading.Event()
-                watcher = asyncio.create_task(
-                    _watch_for_quit(stop, opts.log_path, watcher_done)
+            if opts.follow and jobs:
+                flusher = (
+                    asyncio.create_task(pipeline.run_deadline_flusher())
+                    if pipeline is not None else None
                 )
+                if stop is None:
+                    stop = asyncio.Event()
+                    watcher_done = threading.Event()
+                    watcher = asyncio.create_task(
+                        _watch_for_quit(stop, opts.log_path, watcher_done)
+                    )
+                else:
+                    watcher = watcher_done = None
+                try:
+                    await runner.run(jobs, stop=stop)
+                finally:
+                    if watcher is not None:
+                        # Unblock the /dev/tty reader thread so the
+                        # terminal is restored and the process can exit.
+                        watcher_done.set()
+                        await watcher
+                    if flusher is not None:
+                        flusher.cancel()
+                        try:
+                            await flusher
+                        except asyncio.CancelledError:
+                            pass
             else:
-                watcher = watcher_done = None
-            try:
-                await runner.run(jobs, stop=stop)
-            finally:
-                if watcher is not None:
-                    # Unblock the /dev/tty reader thread so the terminal
-                    # is restored and the process can exit.
-                    watcher_done.set()
-                    await watcher
-                if flusher is not None:
-                    flusher.cancel()
-                    try:
-                        await flusher
-                    except asyncio.CancelledError:
-                        pass
-        else:
-            await runner.run(jobs)
+                await runner.run(jobs)
 
-        print_log_size(log_files, opts.log_path)
-        if pipeline is not None:
-            if opts.stats:
+            print_log_size(log_files, opts.log_path)
+            if pipeline is not None and opts.stats:
                 pipeline.print_summary()
-            pipeline.close()
-        return 0
+            return 0
+        finally:
+            # Close inside the loop even on error/Ctrl-C paths — an
+            # unawaited grpc channel or in-flight batch task would be
+            # destroyed pending at loop teardown.
+            if pipeline is not None:
+                await pipeline.aclose()
     finally:
         if profiling:
             import jax.profiler

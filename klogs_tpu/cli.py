@@ -196,11 +196,18 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     from klogs_tpu.app import run
+    from klogs_tpu.cluster.backend import ClusterError
     from klogs_tpu.ui.interactive import NotInteractive
 
     try:
         return run(opts)
     except term.FatalError:
+        return 1
+    except ClusterError as e:
+        # One friendly line for control-plane failures (401/403/
+        # unreachable apiserver), not a traceback; ≙ pterm.Fatal/panic
+        # in the reference (cmd/root.go:78,110,130).
+        term.error("%s", e)
         return 1
     except NotInteractive as e:
         term.error("%s", e)

@@ -21,7 +21,12 @@ from typing import AsyncIterator
 
 import aiohttp
 
-from klogs_tpu.cluster.backend import ClusterBackend, LogStream, StreamError
+from klogs_tpu.cluster.backend import (
+    ClusterBackend,
+    ClusterError,
+    LogStream,
+    StreamError,
+)
 from klogs_tpu.cluster.kubeconfig import ClusterCreds, KubeconfigError, load_creds
 from klogs_tpu.cluster.types import ContainerInfo, LogOptions, PodInfo
 from klogs_tpu.ui import term
@@ -75,11 +80,31 @@ class KubeBackend(ClusterBackend):
         return self._creds.context_name, self._creds.namespace
 
     async def _get_json(self, path: str, params: dict | None = None):
-        async with self._session.get(path, params=params or {}) as resp:
-            if resp.status == 404:
-                return None
-            resp.raise_for_status()
-            return await resp.json()
+        """Control-plane GET. Failures surface as ClusterError with a
+        one-line human message (the app boundary prints it and exits 1,
+        ≙ the reference's pterm panic, cmd/root.go:110,130) instead of a
+        raw aiohttp traceback."""
+        try:
+            async with self._session.get(path, params=params or {}) as resp:
+                if resp.status == 404:
+                    return None
+                if resp.status in (401, 403):
+                    word = "Unauthorized" if resp.status == 401 else "Forbidden"
+                    raise ClusterError(
+                        f"{word} (HTTP {resp.status}) from "
+                        f"{self._creds.server}{path} — check your kubeconfig "
+                        f"credentials (context {self._creds.context_name!r})"
+                    )
+                if resp.status >= 400:
+                    body = (await resp.text())[:200]
+                    raise ClusterError(
+                        f"apiserver error HTTP {resp.status} on {path}: {body}"
+                    )
+                return await resp.json()
+        except aiohttp.ClientError as e:
+            raise ClusterError(
+                f"cannot reach apiserver {self._creds.server}: {e}"
+            ) from e
 
     async def namespace_exists(self, namespace: str) -> bool:
         return await self._get_json(f"/api/v1/namespaces/{namespace}") is not None

@@ -1,23 +1,28 @@
 """kubeconfig loading and TLS/auth resolution.
 
 The subset klogs needs (configClient, /root/reference/cmd/root.go:69-87
-and getCurrentNamespace, cmd/root.go:185-198): resolve the file
-($KUBECONFIG, explicit --kubeconfig, else ~/.kube/config), pick the
-current context, and produce everything required to talk to its
-cluster: server URL, CA trust, client-cert/token auth, and the
-context's default namespace.
+and getCurrentNamespace, cmd/root.go:185-198): resolve the file(s)
+($KUBECONFIG — a path LIST merged with client-go semantics, explicit
+--kubeconfig, else ~/.kube/config), pick the current context, and
+produce everything required to talk to its cluster: server URL, CA
+trust, client-cert/token auth, and the context's default namespace.
 
-Supported auth: client certificates (inline *-data or file paths) and
-bearer tokens (inline or tokenFile). Exec-plugin credential helpers are
-not supported in this build — a clear error tells the user to mint a
-token instead.
+Supported auth: client certificates (inline *-data or file paths),
+bearer tokens (inline or tokenFile), and exec-plugin credential helpers
+(the client-go mode GKE/EKS/AKS default kubeconfigs use, reference gets
+it via clientcmd at cmd/root.go:76): the helper command runs
+non-interactively, its ExecCredential JSON yields a token or client
+cert, and the result is cached until its expirationTimestamp.
 """
 
 import base64
+import json
 import os
 import ssl
+import subprocess
 import tempfile
 from dataclasses import dataclass
+from datetime import datetime, timezone
 
 import yaml
 
@@ -35,39 +40,172 @@ class ClusterCreds:
     token: str | None  # Authorization: Bearer
 
 
-def default_kubeconfig_path() -> str:
+def kubeconfig_paths() -> list[str]:
+    """$KUBECONFIG as a pathsep-separated list (client-go semantics),
+    else the single default ~/.kube/config."""
     env = os.environ.get("KUBECONFIG")
     if env:
-        return env.split(os.pathsep)[0]
-    return os.path.join(os.path.expanduser("~"), ".kube", "config")
+        return [p for p in env.split(os.pathsep) if p]
+    return [os.path.join(os.path.expanduser("~"), ".kube", "config")]
+
+
+def default_kubeconfig_path() -> str:
+    return kubeconfig_paths()[0]
+
+
+def _merge_configs(paths: list[str]) -> dict:
+    """client-go merge (clientcmd.Load): per-name map entries and the
+    current-context scalar each come from the FIRST file that defines
+    them; later files never override. Missing files are skipped; a file
+    that exists but fails to parse is an error; all-missing is an
+    error."""
+    merged: dict = {"clusters": [], "contexts": [], "users": [],
+                    "current-context": ""}
+    seen: dict[str, set] = {"clusters": set(), "contexts": set(),
+                            "users": set()}
+    loaded_any = False
+    for path in paths:
+        try:
+            with open(path) as f:
+                cfg = yaml.safe_load(f)
+        except FileNotFoundError:
+            continue
+        except OSError as e:
+            raise KubeconfigError(f"cannot read kubeconfig {path}: {e}") from e
+        except yaml.YAMLError as e:
+            raise KubeconfigError(f"kubeconfig {path} is not valid YAML: {e}") from e
+        if cfg is None:
+            # Empty file (or only comments): client-go treats it as an
+            # empty config and proceeds with the rest of the list.
+            loaded_any = True
+            continue
+        if not isinstance(cfg, dict):
+            raise KubeconfigError(f"kubeconfig {path} is not a mapping")
+        loaded_any = True
+        for section in ("clusters", "contexts", "users"):
+            for item in cfg.get(section) or []:
+                name = item.get("name")
+                if name and name not in seen[section]:
+                    seen[section].add(name)
+                    merged[section].append(item)
+        if not merged["current-context"] and cfg.get("current-context"):
+            merged["current-context"] = cfg["current-context"]
+    if not loaded_any:
+        raise KubeconfigError(
+            f"no kubeconfig found at {os.pathsep.join(paths)}"
+        )
+    return merged
+
+
+def _write_temp(data: bytes, label: str) -> str:
+    fd, tmp = tempfile.mkstemp(prefix=f"klogs-{label}-")
+    with os.fdopen(fd, "wb") as f:
+        f.write(data)
+    return tmp
 
 
 def _materialize(inline_b64: str | None, path: str | None, label: str) -> str | None:
     """Inline base64 data wins over file paths (kubectl precedence);
     inline data lands in a private temp file for ssl's file-based API."""
     if inline_b64:
-        fd, tmp = tempfile.mkstemp(prefix=f"klogs-{label}-")
-        with os.fdopen(fd, "wb") as f:
-            f.write(base64.b64decode(inline_b64))
-        return tmp
+        return _write_temp(base64.b64decode(inline_b64), label)
     return path
 
 
-def load_creds(kubeconfig: str = "") -> ClusterCreds:
-    path = kubeconfig or default_kubeconfig_path()
+# ExecCredential cache: helper runs are slow (they often hit a cloud
+# metadata/token endpoint), so results are reused until their
+# expirationTimestamp. Keyed by the full exec spec.
+_EXEC_CACHE: dict[str, tuple[datetime | None, dict]] = {}
+
+_EXEC_API_VERSIONS = (
+    "client.authentication.k8s.io/v1",
+    "client.authentication.k8s.io/v1beta1",
+    "client.authentication.k8s.io/v1alpha1",
+)
+
+_EXEC_TIMEOUT_S = 60
+
+
+def _parse_rfc3339(ts: str) -> datetime | None:
     try:
-        with open(path) as f:
-            cfg = yaml.safe_load(f)
-    except OSError as e:
-        raise KubeconfigError(f"cannot read kubeconfig {path}: {e}") from e
-    if not isinstance(cfg, dict):
-        raise KubeconfigError(f"kubeconfig {path} is not a mapping")
+        return datetime.fromisoformat(ts.replace("Z", "+00:00"))
+    except ValueError:
+        return None
+
+
+def exec_credential(spec: dict) -> dict:
+    """Run a kubeconfig exec credential helper and return the
+    ExecCredential ``status`` dict (token and/or client cert). Results
+    cache until status.expirationTimestamp (no expiry -> cached for the
+    process lifetime, per client-go). Never prompts: the helper runs
+    with interactive=false."""
+    key = json.dumps(spec, sort_keys=True, default=str)
+    hit = _EXEC_CACHE.get(key)
+    if hit is not None:
+        expiry, status = hit
+        if expiry is None or datetime.now(timezone.utc) < expiry:
+            return status
+
+    command = spec.get("command")
+    if not command:
+        raise KubeconfigError("kubeconfig exec entry has no command")
+    api_version = spec.get("apiVersion") or _EXEC_API_VERSIONS[1]
+    if api_version not in _EXEC_API_VERSIONS:
+        raise KubeconfigError(
+            f"unsupported exec plugin apiVersion {api_version!r}")
+    argv = [command] + list(spec.get("args") or [])
+    env = dict(os.environ)
+    for pair in spec.get("env") or []:
+        if pair.get("name"):
+            env[pair["name"]] = pair.get("value", "")
+    env["KUBERNETES_EXEC_INFO"] = json.dumps({
+        "apiVersion": api_version,
+        "kind": "ExecCredential",
+        "spec": {"interactive": False},
+    })
+    try:
+        res = subprocess.run(argv, capture_output=True, text=True, env=env,
+                             timeout=_EXEC_TIMEOUT_S)
+    except FileNotFoundError as e:
+        raise KubeconfigError(
+            f"exec credential helper {command!r} not found: {e}") from e
+    except subprocess.TimeoutExpired as e:
+        raise KubeconfigError(
+            f"exec credential helper {command!r} timed out") from e
+    if res.returncode != 0:
+        tail = (res.stderr or "").strip().splitlines()[-3:]
+        raise KubeconfigError(
+            f"exec credential helper {command!r} failed "
+            f"(rc={res.returncode}): {' '.join(tail)}")
+    try:
+        cred = json.loads(res.stdout)
+    except ValueError as e:
+        raise KubeconfigError(
+            f"exec credential helper {command!r} printed invalid JSON") from e
+    status = cred.get("status") or {}
+    if not (status.get("token")
+            or (status.get("clientCertificateData")
+                and status.get("clientKeyData"))):
+        raise KubeconfigError(
+            f"exec credential helper {command!r} returned neither a token "
+            "nor a client certificate")
+    expiry = None
+    if status.get("expirationTimestamp"):
+        expiry = _parse_rfc3339(status["expirationTimestamp"])
+    _EXEC_CACHE[key] = (expiry, status)
+    return status
+
+
+def load_creds(kubeconfig: str = "") -> ClusterCreds:
+    paths = [kubeconfig] if kubeconfig else kubeconfig_paths()
+    cfg = _merge_configs(paths)
+    path_desc = os.pathsep.join(paths)
 
     ctx_name = cfg.get("current-context") or ""
     contexts = {c["name"]: c.get("context", {}) for c in cfg.get("contexts", [])}
     if not ctx_name or ctx_name not in contexts:
         raise KubeconfigError(
-            f"kubeconfig {path} has no usable current-context ({ctx_name!r})"
+            f"kubeconfig {path_desc} has no usable current-context ({ctx_name!r})"
         )
     ctx = contexts[ctx_name]
     namespace = ctx.get("namespace") or "default"
@@ -102,11 +240,14 @@ def load_creds(kubeconfig: str = "") -> ClusterCreds:
         with open(user["tokenFile"]) as f:
             token = f.read().strip()
     if not token and not (cert and key) and user.get("exec"):
-        raise KubeconfigError(
-            "exec-plugin credential helpers are not supported; create a "
-            "ServiceAccount token (kubectl create token ...) and put it in "
-            "the kubeconfig user as `token:`"
-        )
+        status = exec_credential(user["exec"])
+        token = status.get("token")
+        if not token:
+            # ExecCredential cert/key are PEM text, not base64.
+            ec = _write_temp(status["clientCertificateData"].encode(),
+                             "exec-cert")
+            ek = _write_temp(status["clientKeyData"].encode(), "exec-key")
+            ssl_ctx.load_cert_chain(ec, ek)
 
     return ClusterCreds(
         context_name=ctx_name,

@@ -30,9 +30,10 @@ dispatch has ms-scale fixed cost.
 """
 
 import asyncio
+import time
 from concurrent.futures import ThreadPoolExecutor
 
-from klogs_tpu.filters.base import LogFilter
+from klogs_tpu.filters.base import FilterStats, LogFilter
 
 DEFAULT_MAX_IN_FLIGHT = 16
 DEFAULT_FETCH_WORKERS = 4
@@ -45,8 +46,12 @@ class AsyncFilterService:
                  max_in_flight: int = DEFAULT_MAX_IN_FLIGHT,
                  fetch_workers: int = DEFAULT_FETCH_WORKERS,
                  coalesce_lines: int = DEFAULT_COALESCE_LINES,
-                 coalesce_delay_s: float = DEFAULT_COALESCE_DELAY_S):
+                 coalesce_delay_s: float = DEFAULT_COALESCE_DELAY_S,
+                 stats: FilterStats | None = None):
         self._filter = log_filter
+        # Optional split-latency recording (queue wait vs device time) so
+        # --stats can tell saturation queueing from engine latency.
+        self._stats = stats
         self._sem = asyncio.Semaphore(max_in_flight)
         self._pool = ThreadPoolExecutor(
             max_workers=fetch_workers, thread_name_prefix="klogs-fetch"
@@ -72,7 +77,7 @@ class AsyncFilterService:
             return []
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
-        self._pending.append((lines, fut))
+        self._pending.append((lines, fut, time.perf_counter()))
         self._pending_lines += len(lines)
         if self._pending_lines >= self._coalesce_lines:
             self._kick(loop)
@@ -97,25 +102,47 @@ class AsyncFilterService:
     async def _run_group(self, group) -> None:
         loop = asyncio.get_running_loop()
         all_lines: list[bytes] = []
-        for lines, _ in group:
+        for lines, _, _ in group:
             all_lines.extend(lines)
         try:
             async with self._sem:
+                t_dispatch = time.perf_counter()
+                if self._stats is not None:
+                    for _, _, enq in group:
+                        self._stats.record_queue_wait(t_dispatch - enq)
                 handle = self._filter.dispatch(all_lines)
                 self.batches_dispatched += 1
                 verdicts = await loop.run_in_executor(
                     self._pool, self._filter.fetch, handle
                 )
+                if self._stats is not None:
+                    self._stats.record_device_batch(
+                        time.perf_counter() - t_dispatch)
         except Exception as e:
-            for _, fut in group:
+            for _, fut, _ in group:
                 if not fut.done():
                     fut.set_exception(e)
             return
         off = 0
-        for lines, fut in group:
+        for lines, fut, _ in group:
             if not fut.done():
                 fut.set_result(verdicts[off : off + len(lines)])
             off += len(lines)
+
+    async def aclose(self) -> None:
+        """Graceful shutdown: dispatch any coalescing (un-kicked) lines,
+        then drain in-flight batch tasks, so no caller future is
+        stranded and no task dies with the loop."""
+        self._closed = True
+        if self._pending:
+            self._kick(asyncio.get_running_loop())
+        elif self._kick_handle is not None:
+            self._kick_handle.cancel()
+            self._kick_handle = None
+        if self._tasks:
+            await asyncio.gather(*list(self._tasks), return_exceptions=True)
+        self._pool.shutdown(wait=True)
+        self._filter.close()
 
     def close(self) -> None:
         self._closed = True

@@ -25,43 +25,98 @@ from dataclasses import dataclass, field
 _LATENCY_RESERVOIR = 8192
 
 
+def _percentile(xs: list[float], q: float) -> float:
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    idx = min(len(xs) - 1, max(0, round(q / 100 * (len(xs) - 1))))
+    return xs[idx]
+
+
+@dataclass
+class _Reservoir:
+    """Bounded uniform sample over an unbounded series."""
+
+    xs: list[float] = field(default_factory=list)
+    count: int = 0
+    _rng: random.Random = field(default_factory=lambda: random.Random(0))
+
+    def add(self, x: float) -> None:
+        self.count += 1
+        if len(self.xs) < _LATENCY_RESERVOIR:
+            self.xs.append(x)
+        else:  # reservoir sampling: uniform over all samples so far
+            j = self._rng.randrange(self.count)
+            if j < _LATENCY_RESERVOIR:
+                self.xs[j] = x
+
+    def percentile(self, q: float) -> float:
+        return _percentile(self.xs, q)
+
+
 @dataclass
 class FilterStats:
     """Aggregate counters across all streams, for the --stats summary
-    and the north-star metrics (lines/sec, matched %, batch latency)."""
+    and the north-star metrics (lines/sec, matched %, batch latency).
+
+    Three latency series are kept separate so saturation diagnosis is
+    possible (the e2e number conflates them):
+    - batch (e2e): sink-observed await, enqueue -> verdicts.
+    - queue: enqueue -> device dispatch (coalescing + backpressure wait),
+      recorded by AsyncFilterService.
+    - device: dispatch -> verdicts fetched, recorded by
+      AsyncFilterService.
+    """
 
     lines_in: int = 0
     lines_matched: int = 0
     bytes_in: int = 0
     bytes_out: int = 0
     batches: int = 0
-    batch_latencies_s: list[float] = field(default_factory=list)
     started_at: float = field(default_factory=time.perf_counter)
-    _rng: random.Random = field(default_factory=lambda: random.Random(0))
+    # Warmup boundary: timestamp when the FIRST batch started filtering.
+    # lines_per_sec measures from here, not from pipeline construction —
+    # otherwise jit warmup deflates short runs (VERDICT r1).
+    first_batch_started_at: float | None = None
+    _batch: _Reservoir = field(default_factory=_Reservoir)
+    _queue: _Reservoir = field(default_factory=_Reservoir)
+    _device: _Reservoir = field(default_factory=_Reservoir)
 
     def record_batch(self, n_lines: int, n_matched: int, n_bytes_in: int,
                      n_bytes_out: int, latency_s: float) -> None:
+        if self.first_batch_started_at is None:
+            self.first_batch_started_at = time.perf_counter() - latency_s
         self.lines_in += n_lines
         self.lines_matched += n_matched
         self.bytes_in += n_bytes_in
         self.bytes_out += n_bytes_out
         self.batches += 1
-        if len(self.batch_latencies_s) < _LATENCY_RESERVOIR:
-            self.batch_latencies_s.append(latency_s)
-        else:  # reservoir sampling: uniform over all batches so far
-            j = self._rng.randrange(self.batches)
-            if j < _LATENCY_RESERVOIR:
-                self.batch_latencies_s[j] = latency_s
+        self._batch.add(latency_s)
+
+    def record_queue_wait(self, wait_s: float) -> None:
+        self._queue.add(wait_s)
+
+    def record_device_batch(self, latency_s: float) -> None:
+        self._device.add(latency_s)
 
     def percentile_latency_s(self, q: float) -> float:
-        if not self.batch_latencies_s:
-            return 0.0
-        xs = sorted(self.batch_latencies_s)
-        idx = min(len(xs) - 1, max(0, round(q / 100 * (len(xs) - 1))))
-        return xs[idx]
+        return self._batch.percentile(q)
+
+    def percentile_queue_s(self, q: float) -> float:
+        return self._queue.percentile(q)
+
+    def percentile_device_s(self, q: float) -> float:
+        return self._device.percentile(q)
+
+    @property
+    def has_service_latencies(self) -> bool:
+        return self._device.count > 0
 
     def lines_per_sec(self) -> float:
-        elapsed = time.perf_counter() - self.started_at
+        start = (self.first_batch_started_at
+                 if self.first_batch_started_at is not None
+                 else self.started_at)
+        elapsed = time.perf_counter() - start
         return self.lines_in / elapsed if elapsed > 0 else 0.0
 
     def matched_pct(self) -> float:

@@ -178,6 +178,19 @@ class FilterPipeline:
         if verify is not None and self.patterns is not None:
             await verify(self.patterns)
 
+    async def aclose(self) -> None:
+        """Awaited teardown (run_async calls this): services that hold
+        loop resources (grpc channel, in-flight batch tasks) shut down
+        cleanly inside the loop instead of leaking fire-and-forget
+        tasks into interpreter exit."""
+        aclose = getattr(self.service, "aclose", None)
+        if aclose is not None:
+            await aclose()
+        elif self.service is not None:
+            self.service.close()
+        elif self.log_filter is not None:
+            self.log_filter.close()
+
     def close(self) -> None:
         if self.service is not None:
             self.service.close()  # in-process: also closes the filter
@@ -193,19 +206,29 @@ class FilterPipeline:
             s.percentile_latency_s(50) * 1e3, s.percentile_latency_s(99) * 1e3,
             s.batches,
         )
+        if s.has_service_latencies:
+            # Split so saturation is diagnosable: queue = coalesce +
+            # backpressure wait before dispatch; device = engine time.
+            term.info(
+                "  queue p50=%.2fms p99=%.2fms | device p50=%.2fms p99=%.2fms",
+                s.percentile_queue_s(50) * 1e3, s.percentile_queue_s(99) * 1e3,
+                s.percentile_device_s(50) * 1e3,
+                s.percentile_device_s(99) * 1e3,
+            )
 
 
 def make_pipeline(patterns: list[str], backend: str,
                   batch_lines: int | None = None,
                   deadline_s: float = 0.05,
                   remote: str | None = None) -> FilterPipeline:
+    stats = FilterStats()
     service = None
     if remote is not None:
         from klogs_tpu.service.client import RemoteFilterClient
 
         return FilterPipeline(
             log_filter=None,
-            stats=FilterStats(),
+            stats=stats,
             batch_lines=batch_lines or 8192,
             deadline_s=deadline_s,
             service=RemoteFilterClient(remote),
@@ -236,12 +259,12 @@ def make_pipeline(patterns: list[str], backend: str,
         # Device batches are cheap per line but each round trip has fixed
         # latency: bigger batches + the async pipeline hide it.
         batch_lines = batch_lines or 8192
-        service = AsyncFilterService(log_filter)
+        service = AsyncFilterService(log_filter, stats=stats)
     else:
         raise ValueError(f"unknown filter backend {backend!r}")
     return FilterPipeline(
         log_filter=log_filter,
-        stats=FilterStats(),
+        stats=stats,
         batch_lines=batch_lines,
         deadline_s=deadline_s,
         service=service,

@@ -143,11 +143,17 @@ class FanoutRunner:
                     return result
                 self._streams.append(stream)
                 opened_at = time.monotonic()
+                # Gap re-fetch must start at the LAST RECEIVED chunk, not
+                # the stream open: a long-lived healthy follow stream that
+                # drops would otherwise re-fetch (and duplicate) its whole
+                # connection lifetime of logs.
+                last_data = opened_at
                 got_data = False
                 stream_err: StreamError | None = None
                 try:
                     async for chunk in stream:
                         got_data = True
+                        last_data = time.monotonic()
                         await sink.write(chunk)
                 except StreamError as e:
                     stream_err = e
@@ -184,7 +190,7 @@ class FanoutRunner:
                     return result
                 attempt += 1
                 opts = LogOptions(
-                    since_seconds=max(1, int(time.monotonic() - opened_at) + 1),
+                    since_seconds=max(1, int(time.monotonic() - last_data) + 1),
                     tail_lines=None,  # tail would re-dump history after a cut
                     follow=True,
                     container=job.container,

@@ -43,9 +43,15 @@ class RemoteFilterClient:
         resp = await self._match_rpc(transport.encode_match_request(lines))
         return transport.decode_match_response(resp)
 
+    async def aclose(self) -> None:
+        """Graceful shutdown: awaited from the pipeline so the channel
+        closes before the event loop exits (a fire-and-forget task here
+        leaks and warns under an exiting loop)."""
+        await self._channel.close()
+
     def close(self) -> None:
-        # grpc.aio channel close is a coroutine; schedule if a loop is
-        # running, else the channel dies with the process.
+        # Sync fallback (non-async teardown paths only): schedule if a
+        # loop is running, else the channel dies with the process.
         import asyncio
 
         try:

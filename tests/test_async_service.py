@@ -156,3 +156,46 @@ def test_service_closed_raises():
     svc.close()
     with pytest.raises(RuntimeError):
         asyncio.run(svc.match([b"x"]))
+
+
+def test_service_records_queue_and_device_latency():
+    import asyncio
+
+    from klogs_tpu.filters.async_service import AsyncFilterService
+    from klogs_tpu.filters.base import FilterStats
+    from klogs_tpu.filters.cpu import RegexFilter
+
+    stats = FilterStats()
+    svc = AsyncFilterService(RegexFilter(["ERROR"]), stats=stats)
+
+    async def fn():
+        a = svc.match([b"an ERROR", b"ok"])
+        b = svc.match([b"fine"])
+        ra, rb = await asyncio.gather(a, b)
+        assert ra == [True, False] and rb == [False]
+        await svc.aclose()
+
+    asyncio.run(fn())
+    assert stats.has_service_latencies
+    assert stats.percentile_device_s(50) > 0
+    # Every caller contributed a queue-wait sample.
+    assert stats._queue.count == 2
+
+
+def test_aclose_dispatches_pending_coalescing_lines():
+    # aclose() before the coalesce timer fires must dispatch the pending
+    # group, not strand the caller future forever.
+    import asyncio
+
+    from klogs_tpu.filters.async_service import AsyncFilterService
+    from klogs_tpu.filters.cpu import RegexFilter
+
+    svc = AsyncFilterService(RegexFilter(["ERROR"]), coalesce_delay_s=5.0)
+
+    async def fn():
+        t = asyncio.create_task(svc.match([b"an ERROR", b"ok"]))
+        await asyncio.sleep(0)  # enqueue happens, timer armed (5s away)
+        await svc.aclose()
+        return await asyncio.wait_for(t, timeout=1)
+
+    assert asyncio.run(fn()) == [True, False]

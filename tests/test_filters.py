@@ -167,3 +167,31 @@ class TestMatchEndToEnd:
         f1 = open(os.path.join(out1, "pod-0000__c0.log"), "rb").read()
         f2 = open(os.path.join(out2, "pod-0000__c0.log"), "rb").read()
         assert f1 == f2  # match-everything filter keeps every byte
+
+
+def test_stats_lines_per_sec_excludes_warmup():
+    # VERDICT r1: throughput must clock from the first batch, not from
+    # pipeline construction (jit warmup deflated short runs).
+    import time as _time
+
+    from klogs_tpu.filters.base import FilterStats
+
+    s = FilterStats()
+    s.started_at -= 3600.0  # pretend construction was an hour ago
+    s.record_batch(n_lines=1000, n_matched=10, n_bytes_in=0, n_bytes_out=0,
+                   latency_s=0.01)
+    # An hour-old construction clock would give ~0.3 lines/s.
+    assert s.lines_per_sec() > 1000
+    assert s.first_batch_started_at is not None
+
+
+def test_stats_queue_vs_device_split():
+    from klogs_tpu.filters.base import FilterStats
+
+    s = FilterStats()
+    for w in (0.001, 0.002, 0.003):
+        s.record_queue_wait(w)
+    s.record_device_batch(0.05)
+    assert s.has_service_latencies
+    assert abs(s.percentile_queue_s(50) - 0.002) < 1e-9
+    assert abs(s.percentile_device_s(99) - 0.05) < 1e-9

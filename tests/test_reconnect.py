@@ -95,3 +95,67 @@ def test_open_failure_retries_in_follow(tmp_path, capsys):
     out = capsys.readouterr().out
     assert out.count("reconnecting") == 2
     assert results[0].error is not None
+
+
+def test_gap_refetch_measured_from_last_chunk(tmp_path, monkeypatch):
+    """ADVICE r1: `since` on reconnect must cover the gap since the LAST
+    RECEIVED chunk, not the stream-open time — a dropped hour-old healthy
+    stream must not re-fetch (duplicate) its whole lifetime."""
+
+    class Clock:
+        def __init__(self):
+            self.value = 1000.0
+
+        def monotonic(self):
+            return self.value
+
+    clock = Clock()
+    monkeypatch.setattr(fanout, "time", clock)
+
+    opened_opts = []
+
+    class OneChunkStream:
+        def __init__(self, idle_before_chunk_s, idle_after_chunk_s):
+            self._phase = 0
+            self._before = idle_before_chunk_s
+            self._after = idle_after_chunk_s
+
+        def __aiter__(self):
+            return self
+
+        async def __anext__(self):
+            if self._phase == 0:
+                self._phase = 1
+                clock.value += self._before  # long quiet period, then data
+                return b"line\n"
+            clock.value += self._after  # short quiet, then the drop
+            raise StopAsyncIteration
+
+        async def close(self):
+            pass
+
+    from klogs_tpu.cluster.backend import StreamError
+    from klogs_tpu.runtime.fanout import StreamJob
+
+    class Backend:
+        def __init__(self):
+            self.calls = 0
+
+        async def open_log_stream(self, namespace, pod, opts):
+            opened_opts.append(opts)
+            self.calls += 1
+            if self.calls == 1:
+                # Healthy for 600s before delivering, drops 5s after.
+                return OneChunkStream(600.0, 5.0)
+            raise StreamError("gone")  # exhausts the 1-reconnect budget
+
+        async def close(self):
+            pass
+
+    runner = FanoutRunner(Backend(), "default", LogOptions(follow=True),
+                          max_reconnects=1)
+    job = StreamJob("p", "c0", False, str(tmp_path / "p__c0.log"))
+    run(asyncio.wait_for(runner.run([job], stop=asyncio.Event()), timeout=10))
+    assert len(opened_opts) == 2
+    # Gap = 5s since last chunk (+1 margin), NOT 605s since open.
+    assert opened_opts[1].since_seconds <= 7, opened_opts[1]

