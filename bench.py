@@ -98,7 +98,23 @@ def device_lps(lines, repeats: int):
 
             best = tune_grouped(dp, live, acc, db, dl, quiet=False)
             kw = {"tile_b": best["tile_b"], "interleave": best["interleave"]}
+        # Production path: two-phase (prefilter candidate mask gates
+        # kernel tiles). KLOGS_TPU_PREFILTER=0 measures the plain NFA.
+        if os.environ.get("KLOGS_TPU_PREFILTER", "1") != "0":
+            from klogs_tpu.filters.compiler.prefilter import compile_prefilter
+            from klogs_tpu.ops.prefilter import device_tables
+
+            pf = compile_prefilter(PATTERNS)
+            if pf.usable:
+                kw["prefilter_tables"] = device_tables(pf)
         run = lambda: match_batch_grouped_pallas(dp, live, acc, db, dl, **kw)
+        if "prefilter_tables" in kw:
+            try:
+                run().block_until_ready()
+            except Exception as e:  # Mosaic/compile trouble: fall back
+                print(f"bench: prefiltered kernel failed ({str(e)[:120]}); "
+                      "falling back to plain NFA", file=sys.stderr)
+                kw.pop("prefilter_tables")
     else:
         from klogs_tpu.filters.compiler.glushkov import compile_patterns
 

@@ -17,6 +17,7 @@ so the app layer is backend-agnostic; FakeCluster implements the same
 contract for hermetic tests.
 """
 
+import asyncio
 from typing import AsyncIterator
 
 import aiohttp
@@ -101,9 +102,12 @@ class KubeBackend(ClusterBackend):
                         f"apiserver error HTTP {resp.status} on {path}: {body}"
                     )
                 return await resp.json()
-        except aiohttp.ClientError as e:
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            # asyncio.TimeoutError: aiohttp's total-timeout is not a
+            # ClientError subclass but is the same "can't reach it" UX.
             raise ClusterError(
-                f"cannot reach apiserver {self._creds.server}: {e}"
+                f"cannot reach apiserver {self._creds.server}: "
+                f"{e or 'request timed out'}"
             ) from e
 
     async def namespace_exists(self, namespace: str) -> bool:

@@ -49,10 +49,6 @@ def kubeconfig_paths() -> list[str]:
     return [os.path.join(os.path.expanduser("~"), ".kube", "config")]
 
 
-def default_kubeconfig_path() -> str:
-    return kubeconfig_paths()[0]
-
-
 def _merge_configs(paths: list[str]) -> dict:
     """client-go merge (clientcmd.Load): per-name map entries and the
     current-context scalar each come from the FIRST file that defines
@@ -126,11 +122,19 @@ _EXEC_API_VERSIONS = (
 _EXEC_TIMEOUT_S = 60
 
 
-def _parse_rfc3339(ts: str) -> datetime | None:
+def _parse_rfc3339(ts: str) -> datetime:
+    """Expiry timestamp parsing, erring toward re-running the helper:
+    tz-naive values are assumed UTC (a naive/aware comparison would
+    TypeError), and an unparseable value counts as already expired
+    (caching a broken-expiry credential forever would serve stale
+    tokens)."""
     try:
-        return datetime.fromisoformat(ts.replace("Z", "+00:00"))
+        dt = datetime.fromisoformat(ts.replace("Z", "+00:00"))
     except ValueError:
-        return None
+        return datetime.min.replace(tzinfo=timezone.utc)
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return dt
 
 
 def exec_credential(spec: dict) -> dict:
@@ -189,9 +193,8 @@ def exec_credential(spec: dict) -> dict:
         raise KubeconfigError(
             f"exec credential helper {command!r} returned neither a token "
             "nor a client certificate")
-    expiry = None
-    if status.get("expirationTimestamp"):
-        expiry = _parse_rfc3339(status["expirationTimestamp"])
+    expiry = (_parse_rfc3339(status["expirationTimestamp"])
+              if status.get("expirationTimestamp") else None)
     _EXEC_CACHE[key] = (expiry, status)
     return status
 

@@ -1,0 +1,262 @@
+"""Mandatory-pair extraction: the compile side of the two-phase filter.
+
+A log filter selects RARE lines, so most of the NFA kernel's work is
+spent proving non-matches. This module derives, per pattern, a CNF over
+adjacent byte-pair containment — an AND of OR-clauses, each clause a set
+of (S1, S2) byte-set pairs such that EVERY match of the pattern contains
+two adjacent bytes x in S1, y in S2 for at least one pair of the clause.
+A *necessary* condition only (the classic literal-prefilter idea,
+rebuilt for byte-set regexes; no reference counterpart — the reference
+streams unfiltered, /root/reference/cmd/root.go:359-374).
+
+The runtime test compiles each clause into one LUT bit slot: the slot's
+first/second LUTs are the UNION over the clause's pairs (a slot firing
+on a cross-pair over-approximates the OR — still necessary-safe), and a
+pattern's requirement is the AND of its clause slots. The device side is
+a handful of 256-entry LUT lookups + bitwise ops per byte (VPU work,
+~100x cheaper than the NFA matmuls); its verdict gates which batch tiles
+the Pallas kernel actually scans (ops/pallas_nfa.py skip-tiles path).
+
+Extraction is structural over the parser AST (CNF per node):
+
+- Sym(bytes B): no clauses; begins/ends with a byte in B.
+- Cat: clauses of all parts plus boundary singleton clauses (last-set of
+  a definite part x first-set of the next definite part, with only
+  empty-only nodes between).
+- Alt: CNF of an alternation distributes: (A1&A2..)|(B1&B2..) becomes
+  AND over all (Ai|Bj) — clause unions, capped for size.
+- Star / optional: may match empty -> true (no clauses), breaks
+  adjacency.
+- Sentinels (^ $): match no byte; empty-only for factor purposes.
+
+Pairs with huge byte-sets (e.g. involving `.`) are uselessly weak and
+are pruned by a selectivity cap; clauses are ranked by a byte-rarity
+prior so the retained ones discriminate on real log text.
+"""
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from klogs_tpu.filters.compiler.parser import (
+    Alt,
+    Cat,
+    Epsilon,
+    Star,
+    Sym,
+    parse,
+)
+
+# A pair side bigger than this matches too often to pay for its LUT bit.
+MAX_SET_BYTES = 48
+# LUT bitmask width: at most this many clause slots across the pattern
+# set (W = ceil(slots/32) uint32 words per LUT entry).
+MAX_PAIR_SLOTS = 512
+# Keep at most this many (most selective) clauses per pattern.
+MAX_CLAUSES_PER_PATTERN = 16
+# Cap CNF size during Alt distribution.
+MAX_CLAUSES_PER_NODE = 32
+MAX_PAIRS_PER_CLAUSE = 8
+
+Pair = tuple[frozenset, frozenset]
+Clause = frozenset  # of Pair
+
+
+def _byte_weight(b: int) -> float:
+    """Rarity prior for ranking (smaller = rarer = more selective) on
+    log-like text: punctuation/control rarest, then digits/uppercase,
+    lowercase and space most common."""
+    c = chr(b)
+    if c.islower() or c == " ":
+        return 4.0
+    if c.isdigit() or c.isupper():
+        return 2.0
+    return 1.0
+
+
+def _pair_weight(p: Pair) -> float:
+    s1, s2 = p
+    return (sum(_byte_weight(b) for b in s1) *
+            sum(_byte_weight(b) for b in s2))
+
+
+def _clause_weight(c: Clause) -> float:
+    # OR of pairs: fires when any does — weakness adds up.
+    return sum(_pair_weight(p) for p in c)
+
+
+def _prune_clauses(clauses: set[Clause]) -> frozenset:
+    """Drop clauses with oversized sets, cap counts."""
+    ok = []
+    for c in clauses:
+        if len(c) > MAX_PAIRS_PER_CLAUSE:
+            continue
+        if any(len(a) > MAX_SET_BYTES or len(b) > MAX_SET_BYTES
+               for a, b in c):
+            continue
+        ok.append(c)
+    ok.sort(key=_clause_weight)
+    return frozenset(ok[:MAX_CLAUSES_PER_NODE])
+
+
+@dataclass(frozen=True)
+class _Summary:
+    """Per-node factor summary.
+
+    kind: 'empty'   — matches ONLY the empty byte string (Epsilon,
+                      sentinels): preserves adjacency, no first/last.
+          'definite'— every match is a non-empty byte string whose first
+                      byte is in `first` and last byte in `last`.
+          'loose'   — may be empty / unknown shape: breaks adjacency.
+    cnf: frozenset of clauses (each a frozenset of pairs); every matched
+         string satisfies every clause.
+    """
+
+    kind: str
+    first: frozenset = frozenset()
+    last: frozenset = frozenset()
+    cnf: frozenset = frozenset()
+
+
+def _alt_cnf(cnfs: list[frozenset]) -> frozenset:
+    """CNF of an alternation: fold pairwise distributions."""
+    acc = cnfs[0]
+    for nxt in cnfs[1:]:
+        if not acc or not nxt:
+            return frozenset()  # one side is 'true'
+        out = {a | b for a in acc for b in nxt}
+        acc = _prune_clauses(out)
+    return acc
+
+
+def _summarize(node) -> _Summary:
+    if isinstance(node, Epsilon):
+        return _Summary("empty")
+    if isinstance(node, Sym):
+        if node.sentinel is not None:
+            return _Summary("empty")
+        return _Summary("definite", first=node.bytes_, last=node.bytes_)
+    if isinstance(node, Star):
+        # Zero iterations possible: no mandatory content.
+        return _Summary("loose")
+    if isinstance(node, Alt):
+        subs = [_summarize(p) for p in node.parts]
+        cnf = _alt_cnf([s.cnf for s in subs])
+        if all(s.kind == "definite" for s in subs):
+            first = frozenset().union(*[s.first for s in subs])
+            last = frozenset().union(*[s.last for s in subs])
+            return _Summary("definite", first=first, last=last, cnf=cnf)
+        if all(s.kind == "empty" for s in subs):
+            return _Summary("empty", cnf=cnf)
+        return _Summary("loose", cnf=cnf)
+    if isinstance(node, Cat):
+        subs = [_summarize(p) for p in node.parts]
+        # Every part is traversed, so every part's clauses are mandatory.
+        clauses: set[Clause] = set().union(*[s.cnf for s in subs]) if subs else set()
+        # Boundary pairs: adjacent definite parts with only empty-only
+        # parts between them become singleton clauses.
+        pending_last: frozenset | None = None
+        for s in subs:
+            if s.kind == "definite":
+                if pending_last is not None:
+                    clauses.add(frozenset({(pending_last, s.first)}))
+                pending_last = s.last
+            elif s.kind == "loose":
+                pending_last = None
+            # 'empty': adjacency preserved, pending_last unchanged.
+        firsts = next((s for s in subs if s.kind != "empty"), None)
+        lasts = next((s for s in reversed(subs) if s.kind != "empty"), None)
+        if firsts is None:  # all parts empty-only
+            return _Summary("empty", cnf=_prune_clauses(clauses))
+        kind = "definite" if (firsts.kind == "definite"
+                              and lasts.kind == "definite") else "loose"
+        return _Summary(
+            kind,
+            first=firsts.first if firsts.kind == "definite" else frozenset(),
+            last=lasts.last if lasts.kind == "definite" else frozenset(),
+            cnf=_prune_clauses(clauses),
+        )
+    raise TypeError(node)
+
+
+def mandatory_clauses(pattern: str, ignore_case: bool = False
+                      ) -> list[Clause]:
+    """Mandatory pair-CNF of one pattern, most selective clause first."""
+    summary = _summarize(parse(pattern, ignore_case=ignore_case))
+    return sorted(summary.cnf, key=_clause_weight)
+
+
+@dataclass
+class PrefilterProgram:
+    """Packed LUTs for the device candidate test.
+
+    A line is a CANDIDATE for pattern p iff every clause slot k required
+    by p fires: some adjacent (x, y) in the line has
+    lut1[x,w] & lut2[y,w] bit set (slot k = word k//32, bit k%32).
+    candidate(line) = OR_p AND_k. `usable` is False when some pattern
+    yielded no clauses (its req mask would be all-zero =
+    always-candidate, making the phase pointless)."""
+
+    lut1: np.ndarray  # [256, W] uint32 — byte valid as a clause-pair first
+    lut2: np.ndarray  # [256, W] uint32 — byte valid as a clause-pair second
+    req: np.ndarray  # [P, W] uint32 — pattern p needs all these bits
+    usable: bool
+
+    @property
+    def n_words(self) -> int:
+        return self.lut1.shape[1]
+
+
+def compile_prefilter(patterns: list[str],
+                      ignore_case: bool = False) -> PrefilterProgram:
+    """Select up to MAX_PAIR_SLOTS clause slots across patterns
+    (deduplicated, most selective first per pattern) and pack the LUTs."""
+    per_pattern = [mandatory_clauses(p, ignore_case) for p in patterns]
+    slot_of: dict[Clause, int] = {}
+    chosen: list[list[int]] = []
+    usable = True
+    for clauses in per_pattern:
+        slots: list[int] = []
+        for clause in clauses:
+            if len(slots) >= MAX_CLAUSES_PER_PATTERN:
+                break
+            slot = slot_of.get(clause)
+            if slot is None:
+                if len(slot_of) >= MAX_PAIR_SLOTS:
+                    continue  # no slot left; weaker req for this pattern
+                slot = slot_of[clause] = len(slot_of)
+            slots.append(slot)
+        if not slots:
+            usable = False  # this pattern always passes -> no gating
+        chosen.append(slots)
+    W = max(1, -(-max(len(slot_of), 1) // 32))
+    lut1 = np.zeros((256, W), dtype=np.uint32)
+    lut2 = np.zeros((256, W), dtype=np.uint32)
+    req = np.zeros((len(patterns), W), dtype=np.uint32)
+    for clause, slot in slot_of.items():
+        w, bit = slot // 32, np.uint32(1 << (slot % 32))
+        for s1, s2 in clause:
+            for b in s1:
+                lut1[b, w] |= bit
+            for b in s2:
+                lut2[b, w] |= bit
+    for i, slots in enumerate(chosen):
+        for slot in slots:
+            req[i, slot // 32] |= np.uint32(1 << (slot % 32))
+    return PrefilterProgram(lut1=lut1, lut2=lut2, req=req, usable=usable)
+
+
+def candidates_host(pf: PrefilterProgram, lines: list[bytes]) -> list[bool]:
+    """Reference (numpy, host) candidate test — the oracle for the
+    device implementation and a quick selectivity probe."""
+    out = []
+    for line in lines:
+        arr = np.frombuffer(line, dtype=np.uint8)
+        if len(arr) < 2:
+            present = np.zeros(pf.n_words, dtype=np.uint32)
+        else:
+            present = np.bitwise_or.reduce(
+                pf.lut1[arr[:-1]] & pf.lut2[arr[1:]], axis=0)
+        out.append(bool(
+            ((present[None, :] & pf.req) == pf.req).all(axis=1).any()))
+    return out

@@ -120,6 +120,18 @@ class NFAEngineFilter(LogFilter):
             self._dp_aug = nfa.pack_program(aug, dtype=jnp.int8)
             self._live = self._prog.n_states
             self._acc = self._prog.n_states + 1
+            # Two-phase filter: a mandatory-pair candidate mask gates
+            # which kernel tiles run (ops/pallas_nfa skip-tiles path).
+            # Enabled when every pattern yields clauses; KLOGS_TPU_PREFILTER=0
+            # forces it off.
+            self._pf_tables = None
+            if os.environ.get("KLOGS_TPU_PREFILTER", "1") != "0":
+                from klogs_tpu.filters.compiler.prefilter import compile_prefilter
+                from klogs_tpu.ops.prefilter import device_tables
+
+                pf = compile_prefilter(patterns, ignore_case=ignore_case)
+                if pf.usable:
+                    self._pf_tables = device_tables(pf)
 
     def match_lines(self, lines: list[bytes]) -> list[bool]:
         return self.fetch(self.dispatch(lines))
@@ -170,6 +182,24 @@ class NFAEngineFilter(LogFilter):
         if self._kernel in ("pallas", "interpret"):
             from klogs_tpu.ops.tune import env_overrides
 
+            if self._pf_tables is not None:
+                try:
+                    return self._pallas.match_batch_grouped_pallas(
+                        self._dp_grouped, self._g_live, self._g_acc,
+                        batch, lengths,
+                        interpret=(self._kernel == "interpret"),
+                        prefilter_tables=self._pf_tables,
+                        **env_overrides(),
+                    )
+                except Exception as e:
+                    # Gated-kernel compile trouble (Mosaic) must degrade
+                    # to the plain NFA, not kill the streaming run.
+                    from klogs_tpu.ui import term
+
+                    term.warning(
+                        "prefiltered kernel unavailable (%s); "
+                        "falling back to plain NFA", str(e)[:120])
+                    self._pf_tables = None
             return self._pallas.match_batch_grouped_pallas(
                 self._dp_grouped, self._g_live, self._g_acc, batch, lengths,
                 interpret=(self._kernel == "interpret"),

@@ -128,9 +128,19 @@ DEFAULT_TILE_B_GROUPED = 4096
 def _grouped_kernel(cls_ref, char_mask_t_ref, follow_t_ref, out_ref,
                     *, T: int, C: int, live: int, acc: int,
                     unroll: int = 1, interleave: int = 1):
+    g = pl.program_id(1)
+    _grouped_kernel_body(g, cls_ref, char_mask_t_ref, follow_t_ref, out_ref,
+                         T=T, C=C, live=live, acc=acc,
+                         unroll=unroll, interleave=interleave)
+
+
+def _grouped_kernel_body(g, cls_ref, char_mask_t_ref, follow_t_ref, out_ref,
+                         *, T: int, C: int, live: int, acc: int,
+                         unroll: int = 1, interleave: int = 1):
     """One (batch-tile, group) grid cell. The grid iterates groups
     innermost, so out_ref (indexed by tile only) stays VMEM-resident and
-    accumulates the OR across groups.
+    accumulates the OR across groups. ``g`` is the group grid index,
+    passed in so gated callers can read program_id outside a pl.when.
 
     ``interleave=2`` splits the lane tile into two independent halves
     advanced in the same loop body — two dependency chains let the
@@ -140,7 +150,6 @@ def _grouped_kernel(cls_ref, char_mask_t_ref, follow_t_ref, out_ref,
     """
     TILE_B = cls_ref.shape[1]
     S = follow_t_ref.shape[1]
-    g = pl.program_id(1)
     H = TILE_B // interleave
 
     def make_step(lo):
@@ -178,6 +187,26 @@ def _grouped_kernel(cls_ref, char_mask_t_ref, follow_t_ref, out_ref,
         out_ref[:] = out_ref[:] | matched
 
 
+def _grouped_kernel_gated(flags_ref, cls_ref, char_mask_t_ref, follow_t_ref,
+                          out_ref, **kw):
+    """Tile-skipping wrapper: flags_ref (scalar-prefetched, [n_tiles])
+    marks tiles holding at least one prefilter candidate. Dead tiles
+    write zeros once and never run the scan loop — the two-phase
+    filter's payoff (compute scales with candidate tiles, not batch)."""
+    i = pl.program_id(0)
+    g = pl.program_id(1)
+    live_tile = flags_ref[i] > 0
+
+    @pl.when(jnp.logical_not(live_tile) & (g == 0))
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    @pl.when(live_tile)
+    def _():
+        _grouped_kernel_body(g, cls_ref, char_mask_t_ref, follow_t_ref,
+                             out_ref, **kw)
+
+
 @functools.partial(jax.jit, static_argnames=("live", "acc", "tile_b",
                                              "interpret", "unroll",
                                              "interleave"))
@@ -186,7 +215,8 @@ def match_batch_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
                                tile_b: int = DEFAULT_TILE_B_GROUPED,
                                interpret: bool = False,
                                unroll: int = 1,
-                               interleave: int = 1) -> jax.Array:
+                               interleave: int = 1,
+                               prefilter_tables=None) -> jax.Array:
     """Full-line match over a compile_grouped program ([G, ...] leaves,
     shared byte classifier): [B, L] u8 + [B] -> [B] bool.
 
@@ -194,7 +224,14 @@ def match_batch_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
     inside (zero-length pad rows can only hit via match_all, and they
     are sliced off before return), so callers — in particular MeshEngine
     shards whose local batch need not divide the tile — never trip a
-    divisibility error."""
+    divisibility error.
+
+    ``prefilter_tables`` (ops.prefilter.device_tables of a USABLE
+    PrefilterProgram for the same pattern set) enables the two-phase
+    path: a cheap per-line candidate mask, a stable sort clustering
+    candidates into the leading tiles, and a tile-skipping kernel —
+    non-candidate tiles never run the scan loop. Necessary-condition
+    semantics make the result identical to the plain path."""
     B = batch.shape[0]
     TILE_B = min(tile_b, B)
     Bp = -(-B // TILE_B) * TILE_B
@@ -213,25 +250,50 @@ def match_batch_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
     char_mask_t = jnp.swapaxes(dp.char_mask, 1, 2)
     follow_t = jnp.swapaxes(dp.follow, 1, 2)
 
-    out = pl.pallas_call(
-        functools.partial(_grouped_kernel, T=T, C=C, live=live, acc=acc,
-                          unroll=unroll, interleave=interleave),
-        grid=(Bp // TILE_B, G),  # groups innermost: out block revisited
+    kern_kw = dict(T=T, C=C, live=live, acc=acc,
+                   unroll=unroll, interleave=interleave)
+    if prefilter_tables is None:
+        out = pl.pallas_call(
+            functools.partial(_grouped_kernel, **kern_kw),
+            grid=(Bp // TILE_B, G),  # groups innermost: out block revisited
+            in_specs=[
+                pl.BlockSpec((T, TILE_B), lambda i, g: (0, i),
+                             memory_space=pltpu.VMEM),      # cls (transposed)
+                pl.BlockSpec((1, S, C), lambda i, g: (g, 0, 0),
+                             memory_space=pltpu.VMEM),      # char_mask^T
+                pl.BlockSpec((1, S, S), lambda i, g: (g, 0, 0),
+                             memory_space=pltpu.VMEM),      # follow^T
+            ],
+            out_specs=pl.BlockSpec((1, TILE_B), lambda i, g: (0, i),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((1, Bp), jnp.int8),
+            interpret=interpret,
+        )(cls.T, char_mask_t, follow_t)
+        return (out[0, :B] > 0) | jnp.asarray(dp.match_all)
+
+    from klogs_tpu.ops.prefilter import candidate_mask, cluster_candidates
+
+    cand = candidate_mask(prefilter_tables, batch, lengths)  # [Bp]
+    order, inv, tile_live = cluster_candidates(cand, TILE_B)
+    cls = cls[order]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(Bp // TILE_B, G),
         in_specs=[
-            pl.BlockSpec((T, TILE_B), lambda i, g: (0, i),
-                         memory_space=pltpu.VMEM),          # cls (transposed)
-            pl.BlockSpec((1, S, C), lambda i, g: (g, 0, 0),
-                         memory_space=pltpu.VMEM),          # char_mask^T
-            pl.BlockSpec((1, S, S), lambda i, g: (g, 0, 0),
-                         memory_space=pltpu.VMEM),          # follow^T
+            pl.BlockSpec((T, TILE_B), lambda i, g, flags: (0, i)),
+            pl.BlockSpec((1, S, C), lambda i, g, flags: (g, 0, 0)),
+            pl.BlockSpec((1, S, S), lambda i, g, flags: (g, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, TILE_B), lambda i, g: (0, i),
-                               memory_space=pltpu.VMEM),
+        out_specs=pl.BlockSpec((1, TILE_B), lambda i, g, flags: (0, i)),
+    )
+    out = pl.pallas_call(
+        functools.partial(_grouped_kernel_gated, **kern_kw),
+        grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((1, Bp), jnp.int8),
         interpret=interpret,
-    )(cls.T, char_mask_t, follow_t)
-
-    return (out[0, :B] > 0) | jnp.asarray(dp.match_all)
+    )(tile_live, cls.T, char_mask_t, follow_t)
+    matched = (out[0] > 0)[inv][:B]
+    return matched | jnp.asarray(dp.match_all)
 
 
 def initial_state_kernel(dp: DeviceProgram, live: int, batch_size: int):

@@ -1,0 +1,61 @@
+"""Device-side candidate mask for the two-phase filter.
+
+Evaluates the compiled pair-CNF (filters/compiler/prefilter.py) on a
+packed byte batch: per adjacent byte pair, two 256-entry LUT lookups and
+a bitwise AND; OR-reduce over positions; per pattern an all-bits check.
+Pure elementwise/VPU work that XLA fuses — no matmuls — costing a small
+fraction of one NFA kernel group pass. The resulting [B] bool mask
+drives tile skipping in the Pallas kernel (candidates are clustered to
+the front by a stable argsort and dead tiles never run the scan loop).
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from klogs_tpu.filters.compiler.prefilter import PrefilterProgram
+
+
+def device_tables(pf: PrefilterProgram):
+    """(lut1 [256,W], lut2 [256,W], req [P,W]) as device uint32 arrays —
+    a pytree suitable as a jit argument."""
+    return (jnp.asarray(pf.lut1), jnp.asarray(pf.lut2), jnp.asarray(pf.req))
+
+
+@jax.jit
+def candidate_mask(tables, batch: jax.Array, lengths: jax.Array) -> jax.Array:
+    """[B, L] u8 + [B] lengths -> [B] bool: True when the line satisfies
+    some pattern's full clause requirement (necessary condition for any
+    match; False rows can never match and may be skipped)."""
+    lut1, lut2, req = tables
+    x = batch.astype(jnp.int32)
+    hits = lut1[x[:, :-1]] & lut2[x[:, 1:]]  # [B, L-1, W]
+    # Pair (t, t+1) counts only when both bytes are inside the line.
+    pos = jnp.arange(batch.shape[1] - 1, dtype=jnp.int32)
+    valid = (pos[None, :] + 1) < lengths[:, None]
+    hits = jnp.where(valid[:, :, None], hits, jnp.uint32(0))
+    present = jax.lax.reduce(
+        hits, np.uint32(0), jax.lax.bitwise_or, (1,)
+    )  # [B, W]
+    ok = (present[:, None, :] & req[None]) == req[None]  # [B, P, W]
+    return jnp.all(ok, axis=-1).any(axis=-1)
+
+
+@partial(jax.jit, static_argnames=("tile_b",))
+def cluster_candidates(cand: jax.Array, tile_b: int):
+    """Order lines candidates-first (stable) and mark live tiles.
+
+    Returns (order [B] i32, inv [B] i32, tile_live [B//tile_b] i32):
+    ``x[order]`` clusters candidates into the leading tiles,
+    ``y[inv]`` undoes it, and tile_live[i] != 0 iff tile i holds at
+    least one candidate."""
+    order = jnp.argsort(jnp.logical_not(cand), stable=True)
+    inv = jnp.argsort(order)
+    n_cand = jnp.sum(cand.astype(jnp.int32))
+    n_tiles = cand.shape[0] // tile_b
+    tile_live = (
+        (jnp.arange(n_tiles, dtype=jnp.int32) * tile_b) < n_cand
+    ).astype(jnp.int32)
+    return order, inv, tile_live

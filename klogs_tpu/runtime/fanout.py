@@ -119,6 +119,11 @@ class FanoutRunner:
         )
         sink = self.sink_factory(job)
         attempt = 0
+        # Last moment data was actually received, persisted ACROSS
+        # reconnects: an unproductive reconnect must not advance it, or
+        # the still-unfetched gap would be silently skipped. None until
+        # the first stream opens.
+        last_data: float | None = None
         try:
             while True:
                 try:
@@ -147,7 +152,8 @@ class FanoutRunner:
                 # the stream open: a long-lived healthy follow stream that
                 # drops would otherwise re-fetch (and duplicate) its whole
                 # connection lifetime of logs.
-                last_data = opened_at
+                if last_data is None:
+                    last_data = opened_at
                 got_data = False
                 stream_err: StreamError | None = None
                 try:

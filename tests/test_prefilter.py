@@ -1,0 +1,198 @@
+"""Two-phase filter: mandatory pair-CNF extraction is a NECESSARY
+condition (no false negatives ever), the device candidate mask matches
+the host oracle, and the tile-skipping kernel is semantics-identical to
+the plain kernel."""
+
+import random
+import re
+
+import numpy as np
+import pytest
+
+from klogs_tpu.filters.compiler.prefilter import (
+    PrefilterProgram,
+    candidates_host,
+    compile_prefilter,
+    mandatory_clauses,
+)
+from klogs_tpu.filters.cpu import RegexFilter
+from klogs_tpu.filters.tpu import NFAEngineFilter, pack_lines
+from klogs_tpu.ops import nfa
+from klogs_tpu.ops.pallas_nfa import match_batch_grouped_pallas
+from klogs_tpu.ops.prefilter import candidate_mask, cluster_candidates, device_tables
+from tests.test_compiler import _rand_line, _rand_pattern, oracle
+
+
+def _pairs_of(pattern):
+    """Flatten singleton clauses to plain pairs for easy assertions."""
+    return {
+        next(iter(c)) for c in mandatory_clauses(pattern) if len(c) == 1
+    }
+
+
+def test_literal_pairs():
+    pairs = _pairs_of("panic:")
+    want = {(frozenset({a}), frozenset({b}))
+            for a, b in zip(b"panic:", b"anic:")}
+    assert want <= pairs
+
+
+def test_alternation_yields_clause():
+    clauses = mandatory_clauses("FATAL|CRIT")
+    assert clauses, "an alternation of literals must yield OR-clauses"
+    # Some clause must mix pairs from both branches.
+    fa = (frozenset({ord("F")}), frozenset({ord("A")}))
+    cr = (frozenset({ord("C")}), frozenset({ord("R")}))
+    assert any(fa in c and cr in c for c in clauses)
+
+
+def test_star_breaks_adjacency():
+    # "ab*c": b* may be empty and may repeat — no (a,c) or (a,b) pair is
+    # mandatory; the extraction must stay conservative.
+    assert (frozenset({ord("a")}), frozenset({ord("c")})) not in _pairs_of("ab*c")
+    assert (frozenset({ord("a")}), frozenset({ord("b")})) not in _pairs_of("ab*c")
+
+
+def test_anchors_are_transparent():
+    assert (frozenset({ord("a")}), frozenset({ord("b")})) in _pairs_of("^ab$")
+
+
+def test_single_byte_pattern_unusable():
+    pf = compile_prefilter(["x"])
+    assert not pf.usable
+
+
+def test_necessary_condition_property():
+    """candidate False must imply no match — over random pattern sets
+    and lines (the correctness contract of the whole phase)."""
+    rng = random.Random(77)
+    checked = 0
+    for _ in range(30):
+        k = rng.randrange(1, 5)
+        pats = [_rand_pattern(rng) for _ in range(k)]
+        try:
+            for p in pats:
+                re.compile(p.encode())
+            pf = compile_prefilter(pats)
+        except (ValueError, re.error):
+            continue
+        lines = [_rand_line(rng) for _ in range(24)]
+        cand = candidates_host(pf, lines)
+        for ln, c in zip(lines, cand):
+            if not c:
+                assert not oracle(pats, ln), (pats, ln)
+            checked += 1
+    assert checked > 200
+
+
+BENCH_PATTERNS = [
+    "panic:", "ERROR.*path=/api/v2/admin", r"code=50[34]",
+    "FATAL|CRIT", r"retry \d+/\d+", "broken pipe",
+]
+
+
+def _lines(n=512):
+    rng = random.Random(5)
+    out = []
+    for i in range(n):
+        r = rng.random()
+        if r < 0.1:
+            out.append(b"ERROR code=503 path=/api/v2/admin x%d" % i)
+        elif r < 0.15:
+            out.append(b"kernel panic: oops %d" % i)
+        elif r < 0.2:
+            out.append(b"CRIT retry 3/5 broken pipe")
+        else:
+            out.append(b"INFO all fine seq=%d latency=%dms" % (i, i % 500))
+    return out
+
+
+def test_device_mask_equals_host():
+    pf = compile_prefilter(BENCH_PATTERNS)
+    assert pf.usable
+    lines = _lines()
+    batch, lengths = pack_lines(lines, 64)
+    got = np.asarray(candidate_mask(device_tables(pf), batch, lengths))
+    exp = candidates_host(pf, lines)
+    assert got[: len(lines)].tolist() == exp
+
+
+def test_device_mask_short_lines():
+    pf = compile_prefilter(BENCH_PATTERNS)
+    lines = [b"", b"x", b"pa", b"panic: now"]
+    batch, lengths = pack_lines(lines, 16)
+    got = np.asarray(candidate_mask(device_tables(pf), batch, lengths))
+    assert got[: len(lines)].tolist() == candidates_host(pf, lines)
+
+
+def test_cluster_candidates_roundtrip():
+    cand = np.array([False, True, False, True, True, False, False, True])
+    import jax.numpy as jnp
+
+    order, inv, live = cluster_candidates(jnp.asarray(cand), 2)
+    order, inv, live = map(np.asarray, (order, inv, live))
+    assert cand[order][:4].all() and not cand[order][4:].any()
+    assert (np.arange(8)[order][inv] == np.arange(8)).all()
+    assert live.tolist() == [1, 1, 0, 0]
+
+
+@pytest.mark.parametrize("tile", [8, 64])
+def test_two_phase_kernel_equals_plain(tile):
+    pats = BENCH_PATTERNS
+    dp, live, acc = nfa.compile_grouped(pats)
+    pf = compile_prefilter(pats)
+    lines = _lines(300)  # non-power-of-two on purpose
+    batch, lengths = pack_lines(lines, 64)
+    batch, lengths = batch[: len(lines)], lengths[: len(lines)]
+    plain = np.asarray(match_batch_grouped_pallas(
+        dp, live, acc, batch, lengths, tile_b=tile, interpret=True))
+    two = np.asarray(match_batch_grouped_pallas(
+        dp, live, acc, batch, lengths, tile_b=tile, interpret=True,
+        prefilter_tables=device_tables(pf)))
+    assert plain.tolist() == two.tolist()
+    assert two.tolist() == RegexFilter(pats).match_lines(lines)
+
+
+def test_engine_filter_with_prefilter_matches_oracle(monkeypatch):
+    monkeypatch.setenv("KLOGS_TPU_PREFILTER", "1")
+    f = NFAEngineFilter(BENCH_PATTERNS, kernel="interpret")
+    assert f._pf_tables is not None, "bench-like patterns must be usable"
+    lines = _lines(200)
+    assert f.match_lines(lines) == RegexFilter(BENCH_PATTERNS).match_lines(lines)
+
+
+def test_engine_filter_prefilter_env_off(monkeypatch):
+    monkeypatch.setenv("KLOGS_TPU_PREFILTER", "0")
+    f = NFAEngineFilter(BENCH_PATTERNS, kernel="interpret")
+    assert f._pf_tables is None
+
+
+def test_property_two_phase_vs_oracle():
+    """Random patterns + random lines through the full two-phase kernel
+    (interpret): identical to the re oracle whenever usable."""
+    rng = random.Random(99)
+    tested = 0
+    words = ["err", "warn", "abc", "xyz", "io"]
+    for _ in range(20):
+        k = rng.randrange(2, 6)
+        # A literal prefix guarantees at least one mandatory pair per
+        # pattern (usable prefilter) while keeping the tail random.
+        pats = [rng.choice(words) + _rand_pattern(rng) for _ in range(k)]
+        try:
+            for p in pats:
+                re.compile(p.encode())
+            pf = compile_prefilter(pats)
+            dp, live, acc = nfa.compile_grouped(pats)
+        except (ValueError, re.error):
+            continue
+        if not pf.usable:
+            continue
+        lines = [_rand_line(rng) for _ in range(16)]
+        batch, lengths = pack_lines(lines, 16)
+        got = np.asarray(match_batch_grouped_pallas(
+            dp, live, acc, batch, lengths, tile_b=8, interpret=True,
+            prefilter_tables=device_tables(pf)))
+        exp = [oracle(pats, ln) for ln in lines]
+        assert got[: len(lines)].tolist() == exp, pats
+        tested += 1
+    assert tested >= 5

@@ -159,3 +159,69 @@ def test_gap_refetch_measured_from_last_chunk(tmp_path, monkeypatch):
     assert len(opened_opts) == 2
     # Gap = 5s since last chunk (+1 margin), NOT 605s since open.
     assert opened_opts[1].since_seconds <= 7, opened_opts[1]
+
+
+def test_gap_persists_across_unproductive_reconnect(tmp_path, monkeypatch):
+    """An unproductive reconnect (opened, delivered nothing, dropped)
+    must NOT advance the gap origin — the next `since` still covers from
+    the last actually-received chunk."""
+
+    class Clock:
+        def __init__(self):
+            self.value = 1000.0
+
+        def monotonic(self):
+            return self.value
+
+    clock = Clock()
+    monkeypatch.setattr(fanout, "time", clock)
+
+    from klogs_tpu.cluster.backend import StreamError
+    from klogs_tpu.runtime.fanout import StreamJob
+
+    opened_opts = []
+
+    class ChunkThenDrop:
+        def __init__(self, chunks, advance_s):
+            self._n = chunks
+            self._adv = advance_s
+
+        def __aiter__(self):
+            return self
+
+        async def __anext__(self):
+            clock.value += self._adv
+            if self._n > 0:
+                self._n -= 1
+                return b"line\n"
+            raise StopAsyncIteration
+
+        async def close(self):
+            pass
+
+    class Backend:
+        def __init__(self):
+            self.calls = 0
+
+        async def open_log_stream(self, namespace, pod, opts):
+            opened_opts.append(opts)
+            self.calls += 1
+            if self.calls == 1:
+                return ChunkThenDrop(chunks=1, advance_s=10.0)  # data at t+10
+            if self.calls == 2:
+                return ChunkThenDrop(chunks=0, advance_s=30.0)  # nothing, +30s
+            raise StreamError("done")
+
+        async def close(self):
+            pass
+
+    runner = FanoutRunner(Backend(), "default", LogOptions(follow=True),
+                          max_reconnects=3)
+    job = StreamJob("p", "c0", False, str(tmp_path / "p__c0.log"))
+    run(asyncio.wait_for(runner.run([job], stop=asyncio.Event()), timeout=10))
+    assert len(opened_opts) >= 3
+    # Reconnect 2: chunk at +10, drop at +20 -> since covers ~10s (+1).
+    assert opened_opts[1].since_seconds == 11
+    # Reconnect 3: the unproductive connection added 30s — since must
+    # cover all ~40s back to the chunk, not just since the last open.
+    assert opened_opts[2].since_seconds == 41, opened_opts[2]
