@@ -46,6 +46,11 @@ def main() -> None:
         lines_per_container=ns.backlog_lines,
         follow_interval_s=1.0 / rate,
     )
+    print(f"offered load: {ns.pods} streams x {rate:.0f} lines/s "
+          f"= {ns.pods * rate:,.0f} lines/s for {ns.seconds:.0f}s "
+          f"(+{ns.backlog_lines} backlog lines/stream); latency "
+          f"percentiles from FilterStats are end-to-end per batch, with "
+          f"queue vs device split printed when the async service runs")
     argv = ["-n", "default", "-a", "-f", "-p", out_dir,
             "--backend", ns.backend, "--stats"]
     for p in patterns:
