@@ -98,9 +98,12 @@ def device_lps(lines, repeats: int):
 
             best = tune_grouped(dp, live, acc, db, dl, quiet=False)
             kw = {"tile_b": best["tile_b"], "interleave": best["interleave"]}
-        # Production path: two-phase (prefilter candidate mask gates
-        # kernel tiles). KLOGS_TPU_PREFILTER=0 measures the plain NFA.
-        if os.environ.get("KLOGS_TPU_PREFILTER", "1") != "0":
+        # KLOGS_TPU_PREFILTER=1 opts into the two-phase path (prefilter
+        # candidate mask gates kernel tiles). Default OFF per the
+        # 2026-07-29 device A/B (BENCH_DEVICE.json): the candidate mask
+        # alone cost ~as much as the NFA kernel, so gating lost 413k vs
+        # 641k plain.
+        if os.environ.get("KLOGS_TPU_PREFILTER", "0") == "1":
             from klogs_tpu.filters.compiler.prefilter import compile_prefilter
             from klogs_tpu.ops.prefilter import device_tables
 

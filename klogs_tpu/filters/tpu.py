@@ -122,10 +122,13 @@ class NFAEngineFilter(LogFilter):
             self._acc = self._prog.n_states + 1
             # Two-phase filter: a mandatory-pair candidate mask gates
             # which kernel tiles run (ops/pallas_nfa skip-tiles path).
-            # Enabled when every pattern yields clauses; KLOGS_TPU_PREFILTER=0
-            # forces it off.
+            # Default OFF: the 2026-07-29 device A/B (BENCH_DEVICE.json)
+            # measured the byte-LUT candidate mask at ~684k lines/s —
+            # nearly the full NFA kernel's cost — so gating was a net
+            # loss (413k gated vs 641k plain). KLOGS_TPU_PREFILTER=1
+            # opts in; requires every pattern to yield clauses.
             self._pf_tables = None
-            if os.environ.get("KLOGS_TPU_PREFILTER", "1") != "0":
+            if os.environ.get("KLOGS_TPU_PREFILTER", "0") == "1":
                 from klogs_tpu.filters.compiler.prefilter import compile_prefilter
                 from klogs_tpu.ops.prefilter import device_tables
 
