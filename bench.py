@@ -75,42 +75,57 @@ def cpu_lps(lines, repeats: int) -> float:
 
 
 def device_lps(lines, repeats: int):
-    """Returns (pipelined_lps, e2e_lps). Pipelined: pre-packed batches on
-    device, N kernel dispatches in flight, one sync. E2E: the synchronous
-    NFAEngineFilter.match_lines path including pack/ship/fetch."""
+    """Returns {"pipelined", "e2e", "host_prep"} (lines/sec each).
+    Pipelined: host-classified batches resident on device, N kernel
+    dispatches in flight, one sync — the engine rate. host_prep: the
+    fused host pack+classify pass (pipelines with device work in the
+    async service, so sustained production rate ~ min(host_prep,
+    pipelined) when transfers aren't the bottleneck). E2E: the
+    synchronous NFAEngineFilter.match_lines path including
+    pack/classify/ship/fetch — tunnel-RTT-bound in this environment."""
     import jax
     import numpy as np
 
-    from klogs_tpu.filters.tpu import NFAEngineFilter, pack_lines
+    from klogs_tpu.filters.tpu import NFAEngineFilter, pack_classify, pack_lines
     from klogs_tpu.ops import nfa
-    from klogs_tpu.ops.pallas_nfa import match_batch_grouped_pallas
 
     use_kernel = jax.default_backend() != "cpu"
     bodies = [ln.rstrip(b"\n") for ln in lines]
-    batch, lengths = pack_lines(bodies, 128)
-    db, dl = jax.device_put(batch), jax.device_put(lengths)
+    host_prep = 0.0
 
     if use_kernel:
+        from klogs_tpu.ops.pallas_nfa import match_cls_grouped_pallas
+
         dp, live, acc = nfa.compile_grouped(PATTERNS)
+        table = np.asarray(dp.byte_class).astype(np.int8)
+        t0 = time.perf_counter()
+        cls = pack_classify(bodies, 128, table, dp.begin_class,
+                            dp.end_class, dp.pad_class)
+        host_prep = len(bodies) / (time.perf_counter() - t0)
+        dcls = jax.device_put(cls)
+        n_rows = cls.shape[0]
         kw = {}
         if os.environ.get("KLOGS_BENCH_TUNE") == "1":
             from klogs_tpu.ops.tune import tune_grouped
 
-            best = tune_grouped(dp, live, acc, db, dl, quiet=False)
+            best = tune_grouped(dp, live, acc, None, None, cls=dcls,
+                                quiet=False)
             kw = {"tile_b": best["tile_b"], "interleave": best["interleave"]}
-        # KLOGS_TPU_PREFILTER=1 opts into the two-phase path (prefilter
-        # candidate mask gates kernel tiles). Default OFF per the
-        # 2026-07-29 device A/B (BENCH_DEVICE.json): the candidate mask
-        # alone cost ~as much as the NFA kernel, so gating lost 413k vs
-        # 641k plain.
+        # KLOGS_TPU_PREFILTER=1 opts into the two-phase path (class-
+        # domain candidate mask gates kernel tiles). Default OFF per the
+        # 2026-07-29 device A/B (BENCH_DEVICE.json): with classification
+        # moved to the host, the NFA kernel is no longer the bottleneck
+        # and the mask cannot pay for itself.
         if os.environ.get("KLOGS_TPU_PREFILTER", "0") == "1":
             from klogs_tpu.filters.compiler.prefilter import compile_prefilter
-            from klogs_tpu.ops.prefilter import device_tables
+            from klogs_tpu.ops.prefilter import class_tables
 
             pf = compile_prefilter(PATTERNS)
             if pf.usable:
-                kw["prefilter_tables"] = device_tables(pf)
-        run = lambda: match_batch_grouped_pallas(dp, live, acc, db, dl, **kw)
+                ct = class_tables(pf, dp.byte_class, dp.n_classes)
+                if ct is not None:
+                    kw["prefilter_tables"] = ct
+        run = lambda: match_cls_grouped_pallas(dp, live, acc, dcls, **kw)
         if "prefilter_tables" in kw:
             try:
                 run().block_until_ready()
@@ -121,28 +136,31 @@ def device_lps(lines, repeats: int):
     else:
         from klogs_tpu.filters.compiler.glushkov import compile_patterns
 
+        batch, lengths = pack_lines(bodies, 128)
+        db, dl = jax.device_put(batch), jax.device_put(lengths)
+        n_rows = batch.shape[0]
         dpu = nfa.pack_program(compile_patterns(PATTERNS))
         run = lambda: nfa.match_batch(dpu, db, dl)
 
     np.asarray(run())  # warmup / compile
     pipelined = 0.0
-    n_flight = 8
+    n_flight = int(os.environ.get("KLOGS_BENCH_N_FLIGHT", "16"))
     for _ in range(repeats):
         t0 = time.perf_counter()
         outs = [run() for _ in range(n_flight)]
         outs[-1].block_until_ready()
-        np.asarray(outs[-1])  # one representative mask fetch (64 KB);
+        np.asarray(outs[-1])  # one representative mask fetch (128 KB);
         # fetching all would serialize n_flight tunnel round-trips and
         # measure the attach, not the engine (see module docstring).
         dt = time.perf_counter() - t0
-        pipelined = max(pipelined, n_flight * batch.shape[0] / dt)
+        pipelined = max(pipelined, n_flight * n_rows / dt)
 
     filt = NFAEngineFilter(PATTERNS)
     filt.match_lines(lines[:4096])  # warm the jit caches
     t0 = time.perf_counter()
     filt.match_lines(lines)
     e2e = len(lines) / (time.perf_counter() - t0)
-    return pipelined, e2e
+    return {"pipelined": pipelined, "e2e": e2e, "host_prep": host_prep}
 
 
 def _device_subprocess(timeout_s: float):
@@ -164,7 +182,7 @@ def _device_subprocess(timeout_s: float):
         "print('ATTACHED', flush=True);"
         "import bench;"
         "n=int(os.environ.get('KLOGS_BENCH_LINES','200000'));"
-        "b=int(os.environ.get('KLOGS_BENCH_DEVICE_BATCH','32768'));"
+        "b=int(os.environ.get('KLOGS_BENCH_DEVICE_BATCH','131072'));"
         "r=int(os.environ.get('KLOGS_BENCH_REPEATS','3'));"
         "lines=bench.make_lines(min(n,b));"
         "print('RESULT:'+json.dumps(bench.device_lps(lines,r)))"
@@ -250,7 +268,7 @@ def main() -> None:
     dev = _device_subprocess(timeout_s)
 
     if dev is not None:
-        pipelined, e2e = dev
+        pipelined, e2e = dev["pipelined"], dev["e2e"]
         print(json.dumps({
             "metric": "log-lines/sec filtered, 32 patterns x 256-pod batch (batch-NFA)",
             "value": round(pipelined, 1),
@@ -259,6 +277,7 @@ def main() -> None:
             "detail": {
                 "cpu_regex_lps": round(cpu, 1),
                 "device_pipelined_lps": round(pipelined, 1),
+                "host_pack_classify_lps": round(dev.get("host_prep", 0.0), 1),
                 "e2e_sync_lps": round(e2e, 1),
                 "n_patterns": len(PATTERNS),
                 "line_width_bytes": 128,

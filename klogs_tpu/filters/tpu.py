@@ -45,6 +45,35 @@ def _bucket_batch(n: int) -> int:
     return b
 
 
+def pack_classify(lines: list[bytes], width: int, table: np.ndarray,
+                  begin_c: int, end_c: int, pad_c: int) -> np.ndarray:
+    """[B] bytes -> [B', width+3] i8 class ids (B' batch-bucketed):
+    col 0 BEGIN, cols 1..len table[byte], col len+1 END, rest PAD (the
+    accept-latch column included). Fused pack + classification on the
+    host — the device-side classify gather measured as ~85% of hot-path
+    device time (BENCH_DEVICE.json "host_classify" probe), so the
+    byte->class mapping happens here, in the native packer when built,
+    else via vectorized numpy."""
+    B = len(lines)
+    rows = _bucket_batch(B)
+    from klogs_tpu.native import hostops
+
+    if hostops is not None and hasattr(hostops, "pack_classify"):
+        buf, _lens = hostops.pack_classify(
+            lines, width, rows, table.tobytes(), begin_c, end_c, pad_c)
+        return np.frombuffer(buf, dtype=np.int8).reshape(rows, width + 3)
+    batch, lengths = pack_lines(lines, width)
+    L = batch.shape[1]
+    pos = np.arange(L, dtype=np.int32)[None, :]
+    body = np.where(pos < lengths[:, None], table[batch], np.int8(pad_c))
+    cls = np.empty((rows, L + 3), dtype=np.int8)
+    cls[:, 0] = begin_c
+    cls[:, 1 : L + 1] = body
+    cls[:, L + 1 :] = pad_c
+    cls[np.arange(rows), lengths + 1] = end_c
+    return cls
+
+
 def pack_lines(lines: list[bytes], width: int) -> tuple[np.ndarray, np.ndarray]:
     """[B] bytes -> ([B', width] u8 zero-padded, [B'] i32 lengths) with
     B' = B rounded up to a batch bucket; pad rows are empty lines whose
@@ -120,6 +149,16 @@ class NFAEngineFilter(LogFilter):
             self._dp_aug = nfa.pack_program(aug, dtype=jnp.int8)
             self._live = self._prog.n_states
             self._acc = self._prog.n_states + 1
+            # Host-side classification table for the grouped hot path
+            # (pack_classify). Class ids ride int8, so a pattern set
+            # whose shared classifier exceeds 127 classes (hundreds of
+            # byte-set-diverse patterns) falls back to device-side
+            # classification rather than overflowing.
+            if self._dp_grouped.n_classes <= 127:
+                self._cls_table = np.asarray(
+                    self._dp_grouped.byte_class).astype(np.int8)
+            else:
+                self._cls_table = None
             # Two-phase filter: a mandatory-pair candidate mask gates
             # which kernel tiles run (ops/pallas_nfa skip-tiles path).
             # Default OFF: the 2026-07-29 device A/B (BENCH_DEVICE.json)
@@ -130,11 +169,18 @@ class NFAEngineFilter(LogFilter):
             self._pf_tables = None
             if os.environ.get("KLOGS_TPU_PREFILTER", "0") == "1":
                 from klogs_tpu.filters.compiler.prefilter import compile_prefilter
-                from klogs_tpu.ops.prefilter import device_tables
+                from klogs_tpu.ops.prefilter import class_tables, device_tables
 
                 pf = compile_prefilter(patterns, ignore_case=ignore_case)
                 if pf.usable:
-                    self._pf_tables = device_tables(pf)
+                    # Class-domain tables (MXU matmul mask over the
+                    # kernel's cls array); byte-LUT fallback only if the
+                    # classifier were ever non-uniform w.r.t. the LUTs.
+                    self._pf_tables = (
+                        class_tables(pf, self._dp_grouped.byte_class,
+                                     self._dp_grouped.n_classes)
+                        or device_tables(pf)
+                    )
 
     def match_lines(self, lines: list[bytes]) -> list[bool]:
         return self.fetch(self.dispatch(lines))
@@ -160,24 +206,86 @@ class NFAEngineFilter(LogFilter):
             buckets.setdefault(
                 _bucket_len(len(bodies[i]), self._chunk_bytes), []
             ).append(i)
+        use_cls = (self._engine is None
+                   and self._kernel in ("pallas", "interpret")
+                   and getattr(self, "_cls_table", None) is not None)
         for width, idxs in buckets.items():
-            batch, lengths = pack_lines([bodies[i] for i in idxs], width)
-            parts.append((idxs, self._match_full(batch, lengths)))
+            sub = [bodies[i] for i in idxs]
+            if use_cls:
+                parts.append((idxs, *self._match_cls_dispatch(sub, width)))
+            else:
+                batch, lengths = pack_lines(sub, width)
+                parts.append((idxs, self._match_full(batch, lengths), None))
         if long_idx:
-            parts.append((long_idx, self._match_long([bodies[i] for i in long_idx])))
+            parts.append(
+                (long_idx, self._match_long([bodies[i] for i in long_idx]),
+                 None))
         if huge_idx:
-            parts.append((huge_idx, self._match_huge([bodies[i] for i in huge_idx])))
+            parts.append(
+                (huge_idx, self._match_huge([bodies[i] for i in huge_idx]),
+                 None))
         return (len(lines), parts)
 
     def fetch(self, handle) -> list[bool]:
-        """Block until the dispatched batch's verdicts are on host."""
+        """Block until the dispatched batch's verdicts are on host.
+
+        An asynchronously-failing device batch (e.g. OOM at execution)
+        surfaces HERE, not at dispatch — when the failing part carries a
+        retry closure (the gated-kernel path), the failure degrades to
+        the plain kernel instead of killing the streaming run."""
         n, parts = handle
         if parts is None:
             return [True] * n
         out = np.zeros(n, dtype=bool)
-        for idxs, mask in parts:
-            out[idxs] = np.asarray(mask)[: len(idxs)]
+        for idxs, mask, retry in parts:
+            try:
+                vals = np.asarray(mask)
+            except Exception as e:
+                if retry is None:
+                    raise
+                from klogs_tpu.ui import term
+
+                term.warning(
+                    "prefiltered kernel failed at fetch (%s); "
+                    "falling back to plain NFA", str(e)[:120])
+                self._pf_tables = None
+                vals = np.asarray(retry())
+            out[idxs] = vals[: len(idxs)]
         return out.tolist()
+
+    def _match_cls_dispatch(self, bodies: list[bytes], width: int):
+        """Hot path: host-side fused pack+classify, device kernel on
+        class ids (no classify gather on device). Returns
+        (device_mask, retry_closure_or_None)."""
+        dpg = self._dp_grouped
+        cls = pack_classify(bodies, width, self._cls_table,
+                            dpg.begin_class, dpg.end_class, dpg.pad_class)
+        from klogs_tpu.ops.tune import env_overrides
+
+        interpret = self._kernel == "interpret"
+        kw = env_overrides()
+        if self._pf_tables is not None and len(self._pf_tables) == 4:
+            try:
+                mask = self._pallas.match_cls_grouped_pallas(
+                    dpg, self._g_live, self._g_acc, cls,
+                    interpret=interpret,
+                    prefilter_tables=self._pf_tables, **kw)
+                retry = lambda: self._pallas.match_cls_grouped_pallas(
+                    dpg, self._g_live, self._g_acc, cls,
+                    interpret=interpret, **kw)
+                return mask, retry
+            except Exception as e:
+                # Gated-kernel compile trouble (Mosaic) must degrade to
+                # the plain NFA, not kill the streaming run.
+                from klogs_tpu.ui import term
+
+                term.warning(
+                    "prefiltered kernel unavailable (%s); "
+                    "falling back to plain NFA", str(e)[:120])
+                self._pf_tables = None
+        return self._pallas.match_cls_grouped_pallas(
+            dpg, self._g_live, self._g_acc, cls,
+            interpret=interpret, **kw), None
 
     def _match_full(self, batch: np.ndarray, lengths: np.ndarray) -> np.ndarray:
         if self._engine is not None:
@@ -185,24 +293,6 @@ class NFAEngineFilter(LogFilter):
         if self._kernel in ("pallas", "interpret"):
             from klogs_tpu.ops.tune import env_overrides
 
-            if self._pf_tables is not None:
-                try:
-                    return self._pallas.match_batch_grouped_pallas(
-                        self._dp_grouped, self._g_live, self._g_acc,
-                        batch, lengths,
-                        interpret=(self._kernel == "interpret"),
-                        prefilter_tables=self._pf_tables,
-                        **env_overrides(),
-                    )
-                except Exception as e:
-                    # Gated-kernel compile trouble (Mosaic) must degrade
-                    # to the plain NFA, not kill the streaming run.
-                    from klogs_tpu.ui import term
-
-                    term.warning(
-                        "prefiltered kernel unavailable (%s); "
-                        "falling back to plain NFA", str(e)[:120])
-                    self._pf_tables = None
             return self._pallas.match_batch_grouped_pallas(
                 self._dp_grouped, self._g_live, self._g_acc, batch, lengths,
                 interpret=(self._kernel == "interpret"),

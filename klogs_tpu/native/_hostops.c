@@ -71,6 +71,83 @@ pack_lines(PyObject *self, PyObject *args)
     return Py_BuildValue("(NN)", buf, lens);
 }
 
+/* pack_classify(lines, width, rows, table[256] bytes, begin, end, pad)
+ *   -> (cls: bytes holding int8[rows, width+3], lengths: int32[rows])
+ *
+ * Fused pack + byte->class classification with the sentinel layout the
+ * grouped Pallas kernel consumes directly (klogs_tpu/ops/pallas_nfa.py):
+ *   col 0            BEGIN
+ *   cols 1..len      table[byte]
+ *   col len+1        END
+ *   cols len+2..     PAD (includes the accept-latch step)
+ * Device-side classify_chunk (a [B,T] gather) measured as ~85% of the
+ * single-chip hot-path device time (BENCH_DEVICE.json "host_classify"
+ * probe, 2026-07-29); one host pass removes it entirely. Excess rows
+ * (rows > len(lines)) are packed as empty lines (BEGIN,END,PAD...).
+ */
+static PyObject *
+pack_classify(PyObject *self, PyObject *args)
+{
+    PyObject *list;
+    Py_ssize_t width, rows;
+    Py_buffer table;
+    int begin_c, end_c, pad_c;
+    if (!PyArg_ParseTuple(args, "O!nny*iii", &PyList_Type, &list, &width,
+                          &rows, &table, &begin_c, &end_c, &pad_c))
+        return NULL;
+    if (table.len < 256) {
+        PyBuffer_Release(&table);
+        PyErr_SetString(PyExc_ValueError, "class table must have 256 entries");
+        return NULL;
+    }
+    Py_ssize_t n = PyList_GET_SIZE(list);
+    if (rows < n)
+        rows = n;
+    if (width <= 0) {
+        PyBuffer_Release(&table);
+        PyErr_SetString(PyExc_ValueError, "width must be positive");
+        return NULL;
+    }
+    const Py_ssize_t T = width + 3;
+    PyObject *buf = PyBytes_FromStringAndSize(NULL, rows * T);
+    PyObject *lens = PyBytes_FromStringAndSize(NULL, rows * 4);
+    if (!buf || !lens) {
+        PyBuffer_Release(&table);
+        Py_XDECREF(buf);
+        Py_XDECREF(lens);
+        return NULL;
+    }
+    const int8_t *tab = (const int8_t *)table.buf;
+    int8_t *out = (int8_t *)PyBytes_AS_STRING(buf);
+    int32_t *lengths = (int32_t *)PyBytes_AS_STRING(lens);
+    memset(out, (int8_t)pad_c, rows * T);
+    memset(lengths, 0, rows * 4);
+
+    for (Py_ssize_t i = 0; i < rows; i++) {
+        int8_t *row = out + i * T;
+        Py_ssize_t len = 0;
+        if (i < n) {
+            PyObject *item = PyList_GET_ITEM(list, i);
+            char *p;
+            if (PyBytes_AsStringAndSize(item, &p, &len) < 0) {
+                PyBuffer_Release(&table);
+                Py_DECREF(buf);
+                Py_DECREF(lens);
+                return NULL;
+            }
+            if (len > width)
+                len = width;
+            for (Py_ssize_t j = 0; j < len; j++)
+                row[1 + j] = tab[(uint8_t)p[j]];
+        }
+        row[0] = (int8_t)begin_c;
+        row[1 + len] = (int8_t)end_c;
+        lengths[i] = (int32_t)len;
+    }
+    PyBuffer_Release(&table);
+    return Py_BuildValue("(NN)", buf, lens);
+}
+
 static PyObject *
 join_kept(PyObject *self, PyObject *args)
 {
@@ -119,6 +196,9 @@ join_kept(PyObject *self, PyObject *args)
 static PyMethodDef Methods[] = {
     {"pack_lines", pack_lines, METH_VARARGS,
      "pack_lines(lines, width, rows) -> (bytes, int32-lengths-bytes)"},
+    {"pack_classify", pack_classify, METH_VARARGS,
+     "pack_classify(lines, width, rows, table, begin, end, pad)"
+     " -> (int8-cls-bytes, int32-lengths-bytes)"},
     {"join_kept", join_kept, METH_VARARGS,
      "join_kept(lines, mask) -> bytes of mask-selected lines"},
     {NULL, NULL, 0, NULL},

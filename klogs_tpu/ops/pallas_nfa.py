@@ -226,12 +226,19 @@ def match_batch_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
     shards whose local batch need not divide the tile — never trip a
     divisibility error.
 
-    ``prefilter_tables`` (ops.prefilter.device_tables of a USABLE
-    PrefilterProgram for the same pattern set) enables the two-phase
-    path: a cheap per-line candidate mask, a stable sort clustering
-    candidates into the leading tiles, and a tile-skipping kernel —
-    non-candidate tiles never run the scan loop. Necessary-condition
-    semantics make the result identical to the plain path."""
+    ``prefilter_tables`` enables the two-phase path: a cheap per-line
+    candidate mask, a stable partition clustering candidates into the
+    leading tiles, and a tile-skipping kernel — non-candidate tiles
+    never run the scan loop. Necessary-condition semantics make the
+    result identical to the plain path. Two table forms (both compiled
+    from a USABLE PrefilterProgram for the same pattern set):
+
+    - 4-tuple from ops.prefilter.class_tables: class-domain mask via
+      MXU one-hot matmuls over the ALREADY-computed cls array (the fast
+      form — no gathers).
+    - 3-tuple from ops.prefilter.device_tables: byte-domain LUT-gather
+      mask (fallback; measured ~NFA-kernel-cost on v5e, see
+      BENCH_DEVICE.json)."""
     B = batch.shape[0]
     TILE_B = min(tile_b, B)
     Bp = -(-B // TILE_B) * TILE_B
@@ -242,7 +249,53 @@ def match_batch_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
     cls = jnp.concatenate(
         [cls, jnp.full((Bp, 1), dp.pad_class, dtype=jnp.int32)], axis=1
     )  # acc latch step
-    T = cls.shape[1]
+    cand_input = None
+    if prefilter_tables is not None and len(prefilter_tables) != 4:
+        cand_input = (batch, lengths)  # byte-LUT tables need raw bytes
+    return _launch_grouped(dp, live, acc, cls, B, TILE_B,
+                           interpret, unroll, interleave,
+                           prefilter_tables, cand_input)
+
+
+@functools.partial(jax.jit, static_argnames=("live", "acc", "tile_b",
+                                             "interpret", "unroll",
+                                             "interleave"))
+def match_cls_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
+                             cls: jax.Array,
+                             tile_b: int = DEFAULT_TILE_B_GROUPED,
+                             interpret: bool = False,
+                             unroll: int = 1,
+                             interleave: int = 1,
+                             prefilter_tables=None) -> jax.Array:
+    """Full-line match over HOST-classified int8 class ids: [B, T] i8
+    (pack_classify layout: BEGIN, body classes, END, PAD latch columns)
+    -> [B] bool. The single-chip hot path: the device-side byte->class
+    gather (classify_chunk) measured as ~85% of hot-path device time
+    (BENCH_DEVICE.json), so classification happens on the host — fused
+    into the native packer — and the kernel consumes classes directly.
+
+    ``prefilter_tables`` must be the class-domain 4-tuple
+    (ops.prefilter.class_tables) when given."""
+    B = cls.shape[0]
+    TILE_B = min(tile_b, B)
+    Bp = -(-B // TILE_B) * TILE_B
+    if Bp != B:
+        # Pad rows are all-PAD: no state survives past step 0 except
+        # live/acc self-loops, so they can only "match" via match_all —
+        # and callers slice padded rows off anyway.
+        cls = jnp.pad(cls, ((0, Bp - B), (0, 0)),
+                      constant_values=dp.pad_class)
+    return _launch_grouped(dp, live, acc, cls.astype(jnp.int32), B, TILE_B,
+                           interpret, unroll, interleave,
+                           prefilter_tables, None)
+
+
+def _launch_grouped(dp, live, acc, cls, B, TILE_B,
+                    interpret, unroll, interleave,
+                    prefilter_tables, cand_input):
+    """Shared kernel launch over classified [Bp, T] i32 ids (padded to a
+    TILE_B multiple); B is the real row count to slice back to."""
+    Bp, T = cls.shape
     S, C = dp.n_states, dp.n_classes
     G = dp.follow.shape[0]
 
@@ -271,9 +324,16 @@ def match_batch_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
         )(cls.T, char_mask_t, follow_t)
         return (out[0, :B] > 0) | jnp.asarray(dp.match_all)
 
-    from klogs_tpu.ops.prefilter import candidate_mask, cluster_candidates
+    from klogs_tpu.ops.prefilter import (
+        candidate_mask,
+        candidate_mask_from_cls,
+        cluster_candidates,
+    )
 
-    cand = candidate_mask(prefilter_tables, batch, lengths)  # [Bp]
+    if len(prefilter_tables) == 4:  # class-domain tables (fast form)
+        cand = candidate_mask_from_cls(prefilter_tables, cls)  # [Bp]
+    else:
+        cand = candidate_mask(prefilter_tables, *cand_input)  # [Bp]
     order, inv, tile_live = cluster_candidates(cand, TILE_B)
     cls = cls[order]
     grid_spec = pltpu.PrefetchScalarGridSpec(

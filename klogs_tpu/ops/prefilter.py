@@ -1,12 +1,25 @@
 """Device-side candidate mask for the two-phase filter.
 
 Evaluates the compiled pair-CNF (filters/compiler/prefilter.py) on a
-packed byte batch: per adjacent byte pair, two 256-entry LUT lookups and
-a bitwise AND; OR-reduce over positions; per pattern an all-bits check.
-Pure elementwise/VPU work that XLA fuses — no matmuls — costing a small
-fraction of one NFA kernel group pass. The resulting [B] bool mask
-drives tile skipping in the Pallas kernel (candidates are clustered to
-the front by a stable argsort and dead tiles never run the scan loop).
+batch, producing the [B] bool mask that drives tile skipping in the
+Pallas kernel (candidates are clustered to the front by a stable
+partition and dead tiles never run the scan loop).
+
+Two formulations:
+
+- ``candidate_mask`` — byte-domain: per adjacent byte pair, two
+  256-entry LUT gathers and a bitwise AND, OR-reduced over positions.
+  Simple, but TPU gathers serialize: the 2026-07-29 device A/B
+  (BENCH_DEVICE.json) measured it at ~684k lines/s — nearly the full
+  NFA kernel's cost, making gating a net loss.
+- ``candidate_mask_from_cls`` — class-domain: the grouped program's
+  shared byte classifier partitions bytes so that membership in any
+  pattern byte-set (hence in any clause-pair side) is constant within a
+  class. Slot hits become two small one-hot **matmuls** per position
+  block ([B,TB,C] x [C,S] on the MXU, C ~ tens of classes, S = slot
+  count) — no gathers — at ~1/10 the NFA kernel's MAC count. The input
+  is the [B, T] class-id array the kernel wrapper already computes, so
+  the byte->class gather is not paid twice.
 """
 
 from functools import partial
@@ -16,6 +29,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from klogs_tpu.filters.compiler.prefilter import PrefilterProgram
+
+# Position-block size for the chunked OR-fold: bounds the per-step
+# intermediate to [B, PAIR_BLOCK, W] (byte path) / [B, PAIR_BLOCK, C]
+# (class path) instead of materializing all L-1 pairs at once.
+PAIR_BLOCK = 128
 
 
 def device_tables(pf: PrefilterProgram):
@@ -28,19 +46,161 @@ def device_tables(pf: PrefilterProgram):
 def candidate_mask(tables, batch: jax.Array, lengths: jax.Array) -> jax.Array:
     """[B, L] u8 + [B] lengths -> [B] bool: True when the line satisfies
     some pattern's full clause requirement (necessary condition for any
-    match; False rows can never match and may be skipped)."""
+    match; False rows can never match and may be skipped).
+
+    The OR over pair positions folds in PAIR_BLOCK-sized chunks via
+    lax.scan, so peak memory is [B, PAIR_BLOCK, W] regardless of L (a
+    4096-byte bucket at B=32k would otherwise materialize a multi-GB
+    [B, L-1, W] intermediate if XLA fails to fuse the reduce)."""
     lut1, lut2, req = tables
+    B, L = batch.shape
+    W = req.shape[1]
+    if L < 2:
+        return jnp.zeros((B,), dtype=bool) | _req_trivial(req)
     x = batch.astype(jnp.int32)
-    hits = lut1[x[:, :-1]] & lut2[x[:, 1:]]  # [B, L-1, W]
-    # Pair (t, t+1) counts only when both bytes are inside the line.
-    pos = jnp.arange(batch.shape[1] - 1, dtype=jnp.int32)
+    a, b = x[:, :-1], x[:, 1:]
+    pos = jnp.arange(L - 1, dtype=jnp.int32)
     valid = (pos[None, :] + 1) < lengths[:, None]
-    hits = jnp.where(valid[:, :, None], hits, jnp.uint32(0))
-    present = jax.lax.reduce(
-        hits, np.uint32(0), jax.lax.bitwise_or, (1,)
-    )  # [B, W]
+    n_pairs = L - 1
+    pad = -n_pairs % PAIR_BLOCK
+    if pad:
+        a = jnp.pad(a, ((0, 0), (0, pad)))
+        b = jnp.pad(b, ((0, 0), (0, pad)))
+        valid = jnp.pad(valid, ((0, 0), (0, pad)))
+    nb = a.shape[1] // PAIR_BLOCK
+    # Scan axis leading: [nb, B, PAIR_BLOCK].
+    a3 = a.reshape(B, nb, PAIR_BLOCK).swapaxes(0, 1)
+    b3 = b.reshape(B, nb, PAIR_BLOCK).swapaxes(0, 1)
+    v3 = valid.reshape(B, nb, PAIR_BLOCK).swapaxes(0, 1)
+
+    def step(present, xs):
+        ab, bb, vb = xs
+        hits = lut1[ab] & lut2[bb]  # [B, PAIR_BLOCK, W]
+        hits = jnp.where(vb[:, :, None], hits, jnp.uint32(0))
+        blk = jax.lax.reduce(hits, np.uint32(0), jax.lax.bitwise_or, (1,))
+        return present | blk, None
+
+    present0 = jnp.zeros((B, W), dtype=jnp.uint32)
+    present, _ = jax.lax.scan(step, present0, (a3, b3, v3))
     ok = (present[:, None, :] & req[None]) == req[None]  # [B, P, W]
     return jnp.all(ok, axis=-1).any(axis=-1)
+
+
+def _req_trivial(req) -> jax.Array:
+    # A pattern with an all-zero requirement row is always satisfied.
+    return jnp.any(jnp.all(req == 0, axis=-1))
+
+
+# ---------------------------------------------------------------------
+# Class-domain formulation (the fast path).
+# ---------------------------------------------------------------------
+
+
+def class_tables(pf: PrefilterProgram, byte_class, n_classes: int,
+                 slots_pad: int | None = None,
+                 patterns_pad: int | None = None):
+    """Re-express the byte LUTs over the grouped program's shared byte
+    classes: (member1 [C, S] i8, member2 [C, S] i8, req_t [S, P] i8,
+    req_count [P] i32) with S = slot count (W*32, optionally padded) and
+    C = n_classes. Sentinel classes (BEGIN/END/PAD and padding) have no
+    representative byte and get all-zero member rows, so pairs touching
+    them never fire — no explicit validity mask needed downstream.
+
+    Returns None when some byte class is NOT uniform w.r.t. the LUTs
+    (cannot happen when both were compiled from the same parse, but the
+    byte-LUT fallback stays correct if it ever does)."""
+    byte_class = np.asarray(byte_class)
+    lut1, lut2 = pf.lut1, pf.lut2
+    W = lut1.shape[1]
+    S = W * 32
+    if slots_pad is not None:
+        S = max(S, slots_pad)
+    P = pf.req.shape[0]
+    Pp = max(P, patterns_pad or 0)
+    member1 = np.zeros((n_classes, S), dtype=np.int8)
+    member2 = np.zeros((n_classes, S), dtype=np.int8)
+    for c in range(n_classes):
+        bs = np.nonzero(byte_class == c)[0]
+        if len(bs) == 0:
+            continue
+        r1, r2 = lut1[bs[0]], lut2[bs[0]]
+        if (lut1[bs] != r1).any() or (lut2[bs] != r2).any():
+            return None  # class not LUT-uniform; caller falls back
+        for w in range(W):
+            for bit in range(32):
+                s = w * 32 + bit
+                one = np.uint32(1 << bit)
+                member1[c, s] = 1 if (r1[w] & one) else 0
+                member2[c, s] = 1 if (r2[w] & one) else 0
+    req_t = np.zeros((S, Pp), dtype=np.int8)
+    req_count = np.zeros((Pp,), dtype=np.int32)
+    for p in range(P):
+        for w in range(W):
+            for bit in range(32):
+                if pf.req[p, w] & np.uint32(1 << bit):
+                    req_t[w * 32 + bit, p] = 1
+                    req_count[p] += 1
+    # Padded pattern columns keep req_count 0 => always "satisfied";
+    # guard: a zero-requirement pattern makes gating pointless, which
+    # compile_prefilter already reports via `usable` — padded columns
+    # are only used for shard-uniform stacking where the real pattern
+    # count masks them out via req_count == 0 rows being ignored by the
+    # candidate OR only when ALL patterns are padded (never happens).
+    return (jnp.asarray(member1), jnp.asarray(member2),
+            jnp.asarray(req_t), jnp.asarray(req_count))
+
+
+@jax.jit
+def candidate_mask_from_cls(tables, cls: jax.Array) -> jax.Array:
+    """[B, T] class ids (classify_chunk output, sentinels included) ->
+    [B] bool candidate mask, via MXU one-hot matmuls per position block.
+
+    Pairs touching BEGIN/END/PAD columns self-suppress (all-zero member
+    rows), so the full cls array — exactly what the kernel wrapper
+    already computed — is passed as-is."""
+    m1t, m2t, req_t, req_count = tables
+    B, T = cls.shape
+    C, S = m1t.shape
+    if T < 2:
+        return jnp.any(req_count == 0) & jnp.ones((B,), dtype=bool)
+    c1, c2 = cls[:, :-1], cls[:, 1:]
+    n_pairs = T - 1
+    pad = -n_pairs % PAIR_BLOCK
+    if pad:
+        # Pad with class C-1: grouped programs place pad_class last and
+        # its member rows are zero; even if not, c2's matching pad rows
+        # come from the same padding so only (pad,pad) pairs are added,
+        # which fire nothing because sentinel rows are zero.
+        c1 = jnp.pad(c1, ((0, 0), (0, pad)), constant_values=C - 1)
+        c2 = jnp.pad(c2, ((0, 0), (0, pad)), constant_values=C - 1)
+    nb = c1.shape[1] // PAIR_BLOCK
+    c13 = c1.reshape(B, nb, PAIR_BLOCK).swapaxes(0, 1)
+    c23 = c2.reshape(B, nb, PAIR_BLOCK).swapaxes(0, 1)
+
+    def step(acc, xs):
+        cb1, cb2 = xs  # [B, PAIR_BLOCK]
+        oh1 = jax.nn.one_hot(cb1, C, dtype=jnp.int8)  # [B, TB, C]
+        oh2 = jax.nn.one_hot(cb2, C, dtype=jnp.int8)
+        m1 = jnp.einsum("btc,cs->bts", oh1, m1t,
+                        preferred_element_type=jnp.int32).astype(jnp.int8)
+        m2 = jnp.einsum("btc,cs->bts", oh2, m2t,
+                        preferred_element_type=jnp.int32).astype(jnp.int8)
+        # hit iff both sides fire at the same position: AND then OR over
+        # the block, expressed as a multiply-accumulate contraction.
+        blk = jnp.einsum("bts,bts->bs", m1, m2,
+                         preferred_element_type=jnp.int32)
+        return acc + blk, None
+
+    acc0 = jnp.zeros((B, S), dtype=jnp.int32)
+    acc, _ = jax.lax.scan(step, acc0, (c13, c23))
+    hits = (acc > 0).astype(jnp.int8)  # [B, S]
+    got = jnp.einsum("bs,sp->bp", hits, req_t,
+                     preferred_element_type=jnp.int32)
+    # Padded pattern columns have req_count 0 and would trivially pass;
+    # they are masked out (a real pattern always has >= 1 slot when the
+    # prefilter is usable).
+    ok = (got >= req_count[None, :]) & (req_count[None, :] > 0)
+    return jnp.any(ok, axis=1)
 
 
 @partial(jax.jit, static_argnames=("tile_b",))
@@ -50,12 +210,22 @@ def cluster_candidates(cand: jax.Array, tile_b: int):
     Returns (order [B] i32, inv [B] i32, tile_live [B//tile_b] i32):
     ``x[order]`` clusters candidates into the leading tiles,
     ``y[inv]`` undoes it, and tile_live[i] != 0 iff tile i holds at
-    least one candidate."""
-    order = jnp.argsort(jnp.logical_not(cand), stable=True)
-    inv = jnp.argsort(order)
-    n_cand = jnp.sum(cand.astype(jnp.int32))
-    n_tiles = cand.shape[0] // tile_b
+    least one candidate.
+
+    Implemented as a cumsum-based stable two-way partition (destination
+    position = rank within own class) plus one scatter — a device
+    argsort (radix, ~10 passes) measured as part of the gating overhead
+    that sank the two-phase path in BENCH_DEVICE.json."""
+    B = cand.shape[0]
+    c = cand.astype(jnp.int32)
+    n_cand = jnp.sum(c)
+    pos = jnp.where(cand,
+                    jnp.cumsum(c) - 1,
+                    n_cand + jnp.cumsum(1 - c) - 1)  # [B] destination slot
+    order = jnp.zeros((B,), dtype=jnp.int32).at[pos].set(
+        jnp.arange(B, dtype=jnp.int32))
+    n_tiles = B // tile_b
     tile_live = (
         (jnp.arange(n_tiles, dtype=jnp.int32) * tile_b) < n_cand
     ).astype(jnp.int32)
-    return order, inv, tile_live
+    return order, pos, tile_live

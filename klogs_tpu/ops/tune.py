@@ -54,28 +54,39 @@ def _store(dp, batch_shape, device_kind: str, cfg: dict) -> None:
 
 def tune_grouped(dp, live: int, acc: int, batch, lengths,
                  repeats: int = 3, n_flight: int = 6,
-                 runner=None, quiet: bool = False) -> dict:
+                 runner=None, quiet: bool = False, cls=None) -> dict:
     """Sweep the candidate grid on the live device; returns the winning
     {"tile_b", "interleave", "lines_per_s"} and caches it.
 
     ``runner(tile_b, interleave) -> lines_per_s`` is injectable for
-    tests; the default measures match_batch_grouped_pallas pipelined
+    tests; the default measures the grouped kernel pipelined
     (N dispatches in flight, one sync — per-call blocking would measure
-    the attach round trip, not the kernel).
+    the attach round trip, not the kernel). When ``cls`` (host-classified
+    [B, T] i8 ids) is given, the hot-path entry match_cls_grouped_pallas
+    is swept instead of the byte-consuming one.
     """
     import jax
 
-    from klogs_tpu.ops.pallas_nfa import match_batch_grouped_pallas
+    from klogs_tpu.ops.pallas_nfa import (
+        match_batch_grouped_pallas,
+        match_cls_grouped_pallas,
+    )
 
-    B = batch.shape[0]
+    B = batch.shape[0] if cls is None else cls.shape[0]
 
     def default_runner(tile_b: int, interleave: int) -> float:
         # Non-divisor tiles are fine: the kernel wrapper pads the batch
         # up to a tile multiple internally.
-        run = lambda: match_batch_grouped_pallas(
-            dp, live, acc, batch, lengths,
-            tile_b=tile_b, interleave=interleave,
-        )
+        if cls is not None:
+            run = lambda: match_cls_grouped_pallas(
+                dp, live, acc, cls,
+                tile_b=tile_b, interleave=interleave,
+            )
+        else:
+            run = lambda: match_batch_grouped_pallas(
+                dp, live, acc, batch, lengths,
+                tile_b=tile_b, interleave=interleave,
+            )
         run().block_until_ready()  # compile
         best = 0.0
         for _ in range(repeats):
@@ -115,7 +126,7 @@ def tune_grouped(dp, live: int, acc: int, batch, lengths,
         device_kind = jax.devices()[0].device_kind
     except Exception:
         device_kind = "unknown"
-    _store(dp, batch.shape, device_kind, best)
+    _store(dp, batch.shape if cls is None else cls.shape, device_kind, best)
     return best
 
 

@@ -92,3 +92,58 @@ def test_non_divisible_batch_pads_inside_kernel():
             dp, live, acc, batch, lengths, tile_b=tile, interpret=True))
         assert m.shape == (21,)
         assert m.tolist() == RegexFilter(pats).match_lines(lines)
+
+
+def test_match_cls_equals_match_batch():
+    """The host-classified kernel entry (the hot path) must agree with
+    the byte-consuming entry and the re oracle, across tiles/paddings."""
+    import numpy as np
+
+    from klogs_tpu.filters.cpu import RegexFilter
+    from klogs_tpu.filters.tpu import pack_classify, pack_lines
+    from klogs_tpu.ops import nfa
+    from klogs_tpu.ops.pallas_nfa import (
+        match_batch_grouped_pallas,
+        match_cls_grouped_pallas,
+    )
+
+    pats = ["panic:", "code=50[34]", "FATAL|CRIT", r"retry \d+/\d+", "^start"]
+    dp, live, acc = nfa.compile_grouped(pats)
+    table = np.asarray(dp.byte_class).astype(np.int8)
+    lines = [b"panic: oops", b"nothing", b"code=503 here", b"CRIT",
+             b"retry 3/5", b"start of line", b"not start", b""] * 37  # 296
+    batch, lengths = pack_lines(lines, 64)
+    batch, lengths = batch[: len(lines)], lengths[: len(lines)]
+    cls = pack_classify(lines, 64, table, dp.begin_class, dp.end_class,
+                        dp.pad_class)[: len(lines)]
+    exp = RegexFilter(pats).match_lines(lines)
+    for tile in (8, 64):
+        a = np.asarray(match_batch_grouped_pallas(
+            dp, live, acc, batch, lengths, tile_b=tile, interpret=True))
+        b = np.asarray(match_cls_grouped_pallas(
+            dp, live, acc, cls, tile_b=tile, interpret=True))
+        assert a.tolist() == exp
+        assert b.tolist() == exp
+
+
+def test_match_cls_with_class_prefilter():
+    import numpy as np
+
+    from klogs_tpu.filters.compiler.prefilter import compile_prefilter
+    from klogs_tpu.filters.cpu import RegexFilter
+    from klogs_tpu.filters.tpu import pack_classify
+    from klogs_tpu.ops import nfa
+    from klogs_tpu.ops.pallas_nfa import match_cls_grouped_pallas
+    from klogs_tpu.ops.prefilter import class_tables
+
+    pats = ["panic:", "code=50[34]", "FATAL|CRIT"]
+    dp, live, acc = nfa.compile_grouped(pats)
+    pf = compile_prefilter(pats)
+    ct = class_tables(pf, dp.byte_class, dp.n_classes)
+    table = np.asarray(dp.byte_class).astype(np.int8)
+    lines = [b"panic: x", b"fine", b"code=504", b"FATAL boom", b"meh"] * 20
+    cls = pack_classify(lines, 32, table, dp.begin_class, dp.end_class,
+                        dp.pad_class)[: len(lines)]
+    got = np.asarray(match_cls_grouped_pallas(
+        dp, live, acc, cls, tile_b=8, interpret=True, prefilter_tables=ct))
+    assert got.tolist() == RegexFilter(pats).match_lines(lines)

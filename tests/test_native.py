@@ -57,3 +57,46 @@ def test_engine_pack_uses_same_layout(monkeypatch):
     without = tpu.pack_lines(lines, 128)
     assert np.array_equal(with_native[0], without[0])
     assert np.array_equal(with_native[1], without[1])
+
+
+def test_pack_classify_matches_python(monkeypatch):
+    """C pack_classify must produce byte-identical cls rows to the
+    numpy fallback, including sentinel placement and row bucketing."""
+    require_native()
+    from klogs_tpu.filters import tpu as ftpu
+    from klogs_tpu.ops import nfa
+
+    dp, live, acc = nfa.compile_grouped(["err.r", "panic:", "x[0-9]+y"])
+    table = np.asarray(dp.byte_class).astype(np.int8)
+    lines = [b"", b"a", b"error here", b"panic: x12y", b"z" * 64,
+             bytes(range(256))[:50]]
+    got = ftpu.pack_classify(lines, 64, table, dp.begin_class,
+                             dp.end_class, dp.pad_class)
+    monkeypatch.setattr("klogs_tpu.native.hostops", None)
+    exp = ftpu.pack_classify(lines, 64, table, dp.begin_class,
+                             dp.end_class, dp.pad_class)
+    assert got.dtype == exp.dtype == np.int8
+    assert got.shape == exp.shape == (8, 67)
+    assert (got == exp).all()
+
+
+def test_pack_classify_matches_device_classify():
+    """Host classification must equal classify_chunk + latch column on
+    the same batch (the hot-path invariant)."""
+    from klogs_tpu.filters import tpu as ftpu
+    from klogs_tpu.ops import nfa
+    from klogs_tpu.ops.nfa import classify_chunk
+
+    import jax.numpy as jnp
+
+    dp, live, acc = nfa.compile_grouped(["err.r", "code=50[34]", "^x$"])
+    table = np.asarray(dp.byte_class).astype(np.int8)
+    lines = [b"", b"x", b"error code=503", b"a" * 32]
+    cls_host = ftpu.pack_classify(lines, 32, table, dp.begin_class,
+                                  dp.end_class, dp.pad_class)
+    batch, lengths = ftpu.pack_lines(lines, 32)
+    dev = classify_chunk(dp, batch, lengths, first=True, final=True)
+    dev = np.asarray(jnp.concatenate(
+        [dev, jnp.full((batch.shape[0], 1), dp.pad_class, dtype=jnp.int32)],
+        axis=1))
+    assert (cls_host.astype(np.int32) == dev).all()

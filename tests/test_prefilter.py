@@ -196,3 +196,121 @@ def test_property_two_phase_vs_oracle():
         assert got[: len(lines)].tolist() == exp, pats
         tested += 1
     assert tested >= 5
+
+
+# ---------------------------------------------------------------------
+# Class-domain tables (candidate_mask_from_cls): the fast MXU-matmul
+# formulation must agree with the host oracle and gate identically.
+# ---------------------------------------------------------------------
+
+
+def _cls_for(dp, batch, lengths):
+    import jax.numpy as jnp
+
+    from klogs_tpu.ops.nfa import classify_chunk
+
+    cls = classify_chunk(dp, batch, lengths, first=True, final=True)
+    B = batch.shape[0]
+    return jnp.concatenate(
+        [cls, jnp.full((B, 1), dp.pad_class, dtype=jnp.int32)], axis=1)
+
+
+def test_class_mask_equals_host():
+    from klogs_tpu.ops.prefilter import candidate_mask_from_cls, class_tables
+
+    pf = compile_prefilter(BENCH_PATTERNS)
+    dp, live, acc = nfa.compile_grouped(BENCH_PATTERNS)
+    ct = class_tables(pf, dp.byte_class, dp.n_classes)
+    assert ct is not None, "grouped classifier must be LUT-uniform"
+    lines = _lines()
+    batch, lengths = pack_lines(lines, 64)
+    got = np.asarray(candidate_mask_from_cls(ct, _cls_for(dp, batch, lengths)))
+    assert got[: len(lines)].tolist() == candidates_host(pf, lines)
+
+
+def test_class_mask_short_lines():
+    from klogs_tpu.ops.prefilter import candidate_mask_from_cls, class_tables
+
+    pf = compile_prefilter(BENCH_PATTERNS)
+    dp, live, acc = nfa.compile_grouped(BENCH_PATTERNS)
+    ct = class_tables(pf, dp.byte_class, dp.n_classes)
+    lines = [b"", b"x", b"pa", b"panic: now"]
+    batch, lengths = pack_lines(lines, 16)
+    got = np.asarray(candidate_mask_from_cls(ct, _cls_for(dp, batch, lengths)))
+    assert got[: len(lines)].tolist() == candidates_host(pf, lines)
+
+
+def test_class_mask_long_bucket():
+    """A wide bucket exercises the chunked position fold (several
+    PAIR_BLOCK blocks)."""
+    from klogs_tpu.ops.prefilter import candidate_mask_from_cls, class_tables
+
+    pf = compile_prefilter(BENCH_PATTERNS)
+    dp, live, acc = nfa.compile_grouped(BENCH_PATTERNS)
+    ct = class_tables(pf, dp.byte_class, dp.n_classes)
+    rng = random.Random(3)
+    lines = [(b"x" * rng.randrange(0, 500))
+             + (b"CRIT retry 3/5" if rng.random() < 0.4 else b"nothing here")
+             + (b"y" * rng.randrange(0, 100)) for _ in range(32)]
+    batch, lengths = pack_lines(lines, 640)
+    got = np.asarray(candidate_mask_from_cls(ct, _cls_for(dp, batch, lengths)))
+    assert got[: len(lines)].tolist() == candidates_host(pf, lines)
+
+
+@pytest.mark.parametrize("tile", [8, 64])
+def test_two_phase_kernel_class_tables_equals_plain(tile):
+    from klogs_tpu.ops.prefilter import class_tables
+
+    pats = BENCH_PATTERNS
+    dp, live, acc = nfa.compile_grouped(pats)
+    pf = compile_prefilter(pats)
+    ct = class_tables(pf, dp.byte_class, dp.n_classes)
+    lines = _lines(300)
+    batch, lengths = pack_lines(lines, 64)
+    batch, lengths = batch[: len(lines)], lengths[: len(lines)]
+    plain = np.asarray(match_batch_grouped_pallas(
+        dp, live, acc, batch, lengths, tile_b=tile, interpret=True))
+    two = np.asarray(match_batch_grouped_pallas(
+        dp, live, acc, batch, lengths, tile_b=tile, interpret=True,
+        prefilter_tables=ct))
+    assert plain.tolist() == two.tolist()
+    assert two.tolist() == RegexFilter(pats).match_lines(lines)
+
+
+def test_property_class_tables_vs_oracle():
+    from klogs_tpu.ops.prefilter import class_tables
+
+    rng = random.Random(42)
+    tested = 0
+    words = ["err", "warn", "abc", "xyz", "io"]
+    for _ in range(20):
+        k = rng.randrange(2, 6)
+        pats = [rng.choice(words) + _rand_pattern(rng) for _ in range(k)]
+        try:
+            for p in pats:
+                re.compile(p.encode())
+            pf = compile_prefilter(pats)
+            dp, live, acc = nfa.compile_grouped(pats)
+        except (ValueError, re.error):
+            continue
+        if not pf.usable:
+            continue
+        ct = class_tables(pf, dp.byte_class, dp.n_classes)
+        assert ct is not None, pats
+        lines = [_rand_line(rng) for _ in range(16)]
+        batch, lengths = pack_lines(lines, 16)
+        got = np.asarray(match_batch_grouped_pallas(
+            dp, live, acc, batch, lengths, tile_b=8, interpret=True,
+            prefilter_tables=ct))
+        exp = [oracle(pats, ln) for ln in lines]
+        assert got[: len(lines)].tolist() == exp, pats
+        tested += 1
+    assert tested >= 5
+
+
+def test_engine_filter_uses_class_tables(monkeypatch):
+    monkeypatch.setenv("KLOGS_TPU_PREFILTER", "1")
+    f = NFAEngineFilter(BENCH_PATTERNS, kernel="interpret")
+    assert f._pf_tables is not None and len(f._pf_tables) == 4
+    lines = _lines(200)
+    assert f.match_lines(lines) == RegexFilter(BENCH_PATTERNS).match_lines(lines)

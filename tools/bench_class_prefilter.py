@@ -1,0 +1,125 @@
+"""Device measurement of the class-domain prefilter (follow-up to
+bench_device_ab.py): class mask alone, clustering alone, and the gated
+kernel with class tables at several tile sizes — appended into
+BENCH_DEVICE.json under "class_prefilter"."""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def pipelined_lps(run, n_lines, repeats=3, n_flight=8):
+    import numpy as np
+
+    np.asarray(run())
+    best = 0.0
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        outs = [run() for _ in range(n_flight)]
+        outs[-1].block_until_ready()
+        np.asarray(outs[-1])
+        best = max(best, n_flight * n_lines / (time.perf_counter() - t0))
+    return best
+
+
+def main():
+    B = int(os.environ.get("KLOGS_BENCH_DEVICE_BATCH", "32768"))
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    print(f"attached: {jax.devices()[0].device_kind}", flush=True)
+
+    from klogs_tpu.filters.compiler.prefilter import compile_prefilter
+    from klogs_tpu.filters.tpu import pack_lines
+    from klogs_tpu.ops import nfa
+    from klogs_tpu.ops.nfa import classify_chunk
+    from klogs_tpu.ops.pallas_nfa import match_batch_grouped_pallas
+    from klogs_tpu.ops.prefilter import (
+        candidate_mask_from_cls,
+        class_tables,
+        cluster_candidates,
+    )
+
+    lines = bench.make_lines(B)
+    bodies = [ln.rstrip(b"\n") for ln in lines]
+    batch, lengths = pack_lines(bodies, 128)
+    db, dl = jax.device_put(batch), jax.device_put(lengths)
+    n = batch.shape[0]
+
+    cpu = bench.cpu_lps(lines[:30000], 3)
+    print(f"cpu_regex_lps: {cpu:,.0f}", flush=True)
+
+    dp, live, acc = nfa.compile_grouped(bench.PATTERNS)
+    pf = compile_prefilter(bench.PATTERNS)
+    ct = class_tables(pf, dp.byte_class, dp.n_classes)
+    assert ct is not None
+    print(f"slots={ct[0].shape[1]} classes={ct[0].shape[0]}", flush=True)
+
+    res = {}
+
+    @jax.jit
+    def mask_only(db, dl):
+        cls = classify_chunk(dp, db, dl, first=True, final=True)
+        cls = jnp.concatenate(
+            [cls, jnp.full((n, 1), dp.pad_class, dtype=jnp.int32)], axis=1)
+        return candidate_mask_from_cls(ct, cls)
+
+    lps = pipelined_lps(lambda: mask_only(db, dl), n)
+    cand = np.asarray(mask_only(db, dl))
+    res["class_mask_only_lps"] = round(lps, 1)
+    res["candidate_fraction"] = round(float(cand.mean()), 4)
+    print(f"class mask alone: {lps:,.0f} lines/s, "
+          f"fraction {cand.mean():.4f}", flush=True)
+
+    @jax.jit
+    def mask_and_cluster(db, dl):
+        cls = classify_chunk(dp, db, dl, first=True, final=True)
+        cls = jnp.concatenate(
+            [cls, jnp.full((n, 1), dp.pad_class, dtype=jnp.int32)], axis=1)
+        cand = candidate_mask_from_cls(ct, cls)
+        order, inv, tl = cluster_candidates(cand, 1024)
+        return cls[order].sum() + inv.sum() + tl.sum()
+
+    lps = pipelined_lps(lambda: mask_and_cluster(db, dl), n)
+    res["mask_cluster_reorder_lps"] = round(lps, 1)
+    print(f"mask+cluster+reorder: {lps:,.0f} lines/s", flush=True)
+
+    for tile in (512, 1024, 2048, 4096):
+        try:
+            lps = pipelined_lps(
+                lambda: match_batch_grouped_pallas(
+                    dp, live, acc, db, dl, tile_b=tile,
+                    prefilter_tables=ct),
+                n)
+        except Exception as e:
+            print(f"gated_class tile={tile} FAILED: {str(e)[:120]}", flush=True)
+            continue
+        res[f"gated_class_tile{tile}"] = {
+            "lps": round(lps, 1), "vs_cpu": round(lps / cpu, 3)}
+        print(f"gated class tile={tile}: {lps:,.0f} lines/s "
+              f"({lps / cpu:.2f}x)", flush=True)
+
+    res["cpu_regex_lps_session"] = round(cpu, 1)
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_DEVICE.json")
+    with open(path) as f:
+        doc = json.load(f)
+    doc["class_prefilter"] = res
+    best = max((v["lps"] for k, v in res.items()
+                if k.startswith("gated_class")), default=0.0)
+    doc["class_prefilter"]["decision"] = (
+        f"best gated-class {best:.0f} vs best plain "
+        f"{doc['best_plain']['lps']:.0f}")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print("DECISION:", doc["class_prefilter"]["decision"], flush=True)
+
+
+if __name__ == "__main__":
+    main()
