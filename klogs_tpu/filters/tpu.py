@@ -45,6 +45,23 @@ def _bucket_batch(n: int) -> int:
     return b
 
 
+def classify_batch(batch: np.ndarray, lengths: np.ndarray, table: np.ndarray,
+                   begin_c: int, end_c: int, pad_c: int) -> np.ndarray:
+    """Vectorized host classification of an ALREADY-packed [B, L] u8
+    batch into the [B, L+3] sentinel cls layout (see pack_classify).
+    Shared by the numpy pack_classify fallback and MeshEngine's
+    batch->cls adapter so the sentinel layout lives in one place."""
+    B, L = batch.shape
+    pos = np.arange(L, dtype=np.int32)[None, :]
+    body = np.where(pos < lengths[:, None], table[batch], table.dtype.type(pad_c))
+    cls = np.empty((B, L + 3), dtype=table.dtype)
+    cls[:, 0] = begin_c
+    cls[:, 1 : L + 1] = body
+    cls[:, L + 1 :] = pad_c
+    cls[np.arange(B), lengths + 1] = end_c
+    return cls
+
+
 def pack_classify(lines: list[bytes], width: int, table: np.ndarray,
                   begin_c: int, end_c: int, pad_c: int) -> np.ndarray:
     """[B] bytes -> [B', width+3] i8 class ids (B' batch-bucketed):
@@ -63,15 +80,7 @@ def pack_classify(lines: list[bytes], width: int, table: np.ndarray,
             lines, width, rows, table.tobytes(), begin_c, end_c, pad_c)
         return np.frombuffer(buf, dtype=np.int8).reshape(rows, width + 3)
     batch, lengths = pack_lines(lines, width)
-    L = batch.shape[1]
-    pos = np.arange(L, dtype=np.int32)[None, :]
-    body = np.where(pos < lengths[:, None], table[batch], np.int8(pad_c))
-    cls = np.empty((rows, L + 3), dtype=np.int8)
-    cls[:, 0] = begin_c
-    cls[:, 1 : L + 1] = body
-    cls[:, L + 1 :] = pad_c
-    cls[np.arange(rows), lengths + 1] = end_c
-    return cls
+    return classify_batch(batch, lengths, table, begin_c, end_c, pad_c)
 
 
 def pack_lines(lines: list[bytes], width: int) -> tuple[np.ndarray, np.ndarray]:
@@ -206,9 +215,13 @@ class NFAEngineFilter(LogFilter):
             buckets.setdefault(
                 _bucket_len(len(bodies[i]), self._chunk_bytes), []
             ).append(i)
-        use_cls = (self._engine is None
-                   and self._kernel in ("pallas", "interpret")
-                   and getattr(self, "_cls_table", None) is not None)
+        if self._engine is not None:
+            # MeshEngine exposes its global classifier when class ids
+            # fit int8 — the multi-chip hot path takes cls directly.
+            use_cls = getattr(self._engine, "cls_table", None) is not None
+        else:
+            use_cls = (self._kernel in ("pallas", "interpret")
+                       and getattr(self, "_cls_table", None) is not None)
         for width, idxs in buckets.items():
             sub = [bodies[i] for i in idxs]
             if use_cls:
@@ -257,6 +270,29 @@ class NFAEngineFilter(LogFilter):
         """Hot path: host-side fused pack+classify, device kernel on
         class ids (no classify gather on device). Returns
         (device_mask, retry_closure_or_None)."""
+        if self._engine is not None:
+            eng = self._engine
+            cls = pack_classify(bodies, width, eng.cls_table,
+                                eng.begin_class, eng.end_class,
+                                eng.pad_class)
+            retry = None
+            if getattr(eng, "gated", False):
+                # Degrade path for an opt-in gated kernel that fails
+                # asynchronously: fetch() retries on the plain fn.
+                def retry(cls=cls):
+                    eng.disable_prefilter()
+                    return eng.match_cls(cls, plain=True)
+            try:
+                return eng.match_cls(cls), retry
+            except Exception as e:
+                if retry is None:
+                    raise
+                from klogs_tpu.ui import term
+
+                term.warning(
+                    "gated mesh kernel unavailable (%s); "
+                    "falling back to plain NFA", str(e)[:120])
+                return retry(), None
         dpg = self._dp_grouped
         cls = pack_classify(bodies, width, self._cls_table,
                             dpg.begin_class, dpg.end_class, dpg.pad_class)

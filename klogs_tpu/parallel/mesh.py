@@ -155,43 +155,91 @@ class MeshEngine:
     def _init_pallas(self, groups: list[list[str]], ignore_case: bool,
                      impl: str) -> None:
         """shard_map with the grouped Pallas kernel as per-shard compute
-        — the production multi-chip hot path. Shards must be
-        shape-uniform, so each shard's pattern set compiles twice: once
-        to learn its natural (G, S, C), then with forced pads to the
-        maxima (dead filler groups can never match)."""
-        from klogs_tpu.ops.pallas_nfa import match_batch_grouped_pallas
+        — the production multi-chip hot path, running the SAME
+        architecture as single-chip: host-side fused pack+classify (the
+        device classify gather measured as ~85% of device time,
+        BENCH_DEVICE.json), int8 class ids sharded over `data`, kernel
+        consuming classes directly, pmax OR across `pattern` shards.
+
+        Shards must be shape-uniform, so each shard's pattern set
+        compiles twice: once to learn its natural (G, S, C), then with
+        forced pads to the maxima (dead filler groups can never match).
+        Because every shard must classify a line identically for ONE
+        host-side cls array to serve all pattern shards, the per-shard
+        classifiers are refined into a GLOBAL one (unique rows of the
+        stacked byte->class signatures) and each shard's char_mask rows
+        are re-laid-out onto the global classes.
+
+        KLOGS_TPU_PREFILTER=1 additionally stacks per-shard class-domain
+        prefilter tables so each shard tile-skips on its own patterns'
+        candidate mask (all-or-nothing across shards, matching the
+        single-chip usability rule)."""
+        import dataclasses
+        import os
+
+        from klogs_tpu.ops.nfa import _pad_to
+        from klogs_tpu.ops.pallas_nfa import match_cls_grouped_pallas
 
         probe = [nfa.compile_grouped(ps, ignore_case=ignore_case)[0]
                  for ps in groups]
         G = max(p.follow.shape[0] for p in probe)
         S = max(p.n_states for p in probe)
-        C = max(p.n_classes for p in probe)
+        # No classes_pad: the whole class axis (char_mask rows,
+        # byte_class, sentinels, n_classes) is rebuilt onto the global
+        # classifier below, so only group/state shapes need forcing.
         dps = [nfa.compile_grouped(ps, ignore_case=ignore_case,
-                                   n_groups=G, states_pad=S, classes_pad=C)[0]
+                                   n_groups=G, states_pad=S)[0]
                for ps in groups]
         live, acc = S - 2, S - 1
-        # match_all is pytree AUX data and may differ across shards (a
-        # nullable pattern in one group only); tree_map stacking requires
-        # identical aux, so force the any() verdict uniformly — the OR
-        # across shards is what the engine computes anyway.
-        import dataclasses
 
-        any_match_all = any(d.match_all for d in dps)
-        dps = [dataclasses.replace(d, match_all=any_match_all) for d in dps]
-        stacked = jax.tree_util.tree_map(
-            lambda *xs: jnp.stack(xs), *dps
-        )  # leaves [n_shards, ...]; aux uniform by construction
+        # Global classifier: bytes equivalent in EVERY shard collapse.
+        sig = np.stack([np.asarray(d.byte_class) for d in dps], axis=1)
+        uniq, glob = np.unique(sig, axis=0, return_inverse=True)
+        n_glob = uniq.shape[0]
+        C = _pad_to(n_glob + 3, 8)
+        begin_c, end_c, pad_c = C - 3, C - 2, C - 1
+        redps = []
+        for k, d in enumerate(dps):
+            cm = np.asarray(d.char_mask)  # [G, C_loc, S]
+            ncm = np.zeros((G, C, S), dtype=cm.dtype)
+            ncm[:, :n_glob, :] = cm[:, uniq[:, k], :]
+            ncm[:, begin_c] = cm[:, d.begin_class]
+            ncm[:, end_c] = cm[:, d.end_class]
+            ncm[:, pad_c] = cm[:, d.pad_class]
+            redps.append(dataclasses.replace(
+                d,
+                char_mask=jnp.asarray(ncm),
+                byte_class=jnp.asarray(glob.astype(np.int32)),
+                begin_class=begin_c, end_class=end_c, pad_class=pad_c,
+                n_classes=C,
+                # match_all is pytree AUX and may differ across shards;
+                # stacking requires identical aux, so force the any()
+                # verdict uniformly — the OR across shards is what the
+                # engine computes anyway.
+                match_all=any(x.match_all for x in dps),
+            ))
+        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *redps)
         self.dp = stacked
         self.match_all = stacked.match_all
+        self.cls_table = glob.astype(np.int8) if C <= 127 else None
+        self._glob = glob.astype(np.int32)
+        self.begin_class, self.end_class, self.pad_class = begin_c, end_c, pad_c
         interpret = impl == "pallas_interpret"
 
-        def per_shard(dp_shard, batch_local, lengths_local):
+        pf_stacked = None
+        if os.environ.get("KLOGS_TPU_PREFILTER", "0") == "1" \
+                and self.cls_table is not None:
+            pf_stacked = self._stack_prefilters(groups, ignore_case, glob, C)
+
+        def per_shard(dp_shard, cls_local, *pf_shard):
             local = jax.tree_util.tree_map(lambda x: x[0], dp_shard)
+            pf = tuple(x[0] for x in pf_shard) if pf_shard else None
             # tile_b is a cap; the kernel wrapper pads any local batch up
             # to a tile multiple, so non-power-of-two shard sizes work.
-            matched = match_batch_grouped_pallas(
-                local, live, acc, batch_local, lengths_local,
+            matched = match_cls_grouped_pallas(
+                local, live, acc, cls_local,
                 tile_b=2048, interpret=interpret,
+                prefilter_tables=pf,
             )
             return jax.lax.pmax(matched.astype(jnp.int32), "pattern") > 0
 
@@ -200,21 +248,61 @@ class MeshEngine:
         except ImportError:
             from jax.experimental.shard_map import shard_map
 
-        specs = dict(
-            mesh=self.mesh,
-            in_specs=(
+        def build(with_pf: bool):
+            in_specs = [
                 jax.tree_util.tree_map(lambda _: P("pattern"), stacked),
                 P("data", None),
-                P("data"),
-            ),
-            out_specs=P("data"),
-        )
-        try:
-            smapped = shard_map(per_shard, check_vma=False, **specs)
-        except TypeError:
-            smapped = shard_map(per_shard, check_rep=False, **specs)
-        self._fn = jax.jit(smapped)
+            ]
+            if with_pf:
+                in_specs.extend(P("pattern") for _ in pf_stacked)
+            specs = dict(mesh=self.mesh, in_specs=tuple(in_specs),
+                         out_specs=P("data"))
+            try:
+                smapped = shard_map(per_shard, check_vma=False, **specs)
+            except TypeError:
+                smapped = shard_map(per_shard, check_rep=False, **specs)
+            if with_pf:
+                return jax.jit(
+                    lambda dp, cls, pf=pf_stacked: smapped(dp, cls, *pf))
+            return jax.jit(smapped)
+
+        # The plain fn always exists: it is both the default path and
+        # the degrade target when the opt-in gated kernel fails (same
+        # contract as the single-chip fetch-time fallback).
+        self._fn = build(False)
+        self._fn_gated = build(True) if pf_stacked is not None else None
         self.impl = impl
+
+    def disable_prefilter(self) -> None:
+        """Degrade to the plain kernel (e.g. after a gated-kernel
+        compile/execution failure surfaced at fetch)."""
+        self._fn_gated = None
+
+    @property
+    def gated(self) -> bool:
+        return getattr(self, "_fn_gated", None) is not None
+
+    @staticmethod
+    def _stack_prefilters(groups, ignore_case, glob, C):
+        """Per-shard class-domain prefilter tables over the GLOBAL
+        classifier, padded shape-uniform and stacked [n_shards, ...].
+        Returns None (gating off everywhere) unless every shard's
+        pattern set is usable — a shard that cannot gate must still
+        scan all its tiles, and shard_map runs one program."""
+        from klogs_tpu.filters.compiler.prefilter import compile_prefilter
+        from klogs_tpu.ops.prefilter import class_tables
+
+        pfs = [compile_prefilter(ps, ignore_case=ignore_case)
+               for ps in groups]
+        if not all(pf.usable for pf in pfs):
+            return None
+        slots = max(pf.lut1.shape[1] * 32 for pf in pfs)
+        pats = max(pf.req.shape[0] for pf in pfs)
+        tabs = [class_tables(pf, glob, C, slots_pad=slots,
+                             patterns_pad=pats) for pf in pfs]
+        if any(t is None for t in tabs):
+            return None
+        return tuple(jnp.stack(xs) for xs in zip(*tabs))
 
     @property
     def data_parallelism(self) -> int:
@@ -224,7 +312,19 @@ class MeshEngine:
         """[B, L] u8 + [B] i32 -> [>=B] bool mask, returned as a DEVICE
         array (padded rows at the tail; callers slice after np.asarray —
         keeps dispatch non-blocking for the async pipeline). B is padded
-        up to a multiple of the data axis so every shard gets equal rows."""
+        up to a multiple of the data axis so every shard gets equal rows.
+
+        The pallas impls consume class ids, so this entry classifies on
+        the host (vectorized numpy over the global table) and routes to
+        match_cls — same verdicts, one extra host pass; filters that can
+        produce cls directly (pack_classify) should call match_cls."""
+        if self.impl in ("pallas", "pallas_interpret"):
+            from klogs_tpu.filters.tpu import classify_batch
+
+            cls = classify_batch(batch, lengths, self._glob,
+                                 self.begin_class, self.end_class,
+                                 self.pad_class)
+            return self.match_cls(cls)
         B = batch.shape[0]
         d = self.grid[0]
         Bp = math.ceil(B / d) * d
@@ -236,6 +336,23 @@ class MeshEngine:
                 [lengths, np.zeros((Bp - B,), dtype=lengths.dtype)]
             )
         return self._fn(self.dp, batch, lengths)
+
+    def match_cls(self, cls: np.ndarray, plain: bool = False):
+        """Hot-path entry for pallas impls: [B, T] int8/int32 class ids
+        (pack_classify layout) -> [>=B] bool device mask. Rows are
+        padded (all-PAD: cannot match) to a data-axis multiple. The
+        gated fn is used when built (KLOGS_TPU_PREFILTER=1) unless
+        ``plain`` forces the fallback."""
+        B = cls.shape[0]
+        d = self.grid[0]
+        Bp = math.ceil(B / d) * d
+        if Bp != B:
+            cls = np.concatenate(
+                [cls, np.full((Bp - B, cls.shape[1]), self.pad_class,
+                              dtype=cls.dtype)]
+            )
+        fn = self._fn if (plain or not self.gated) else self._fn_gated
+        return fn(self.dp, cls)
 
     def close(self) -> None:
         pass

@@ -111,3 +111,45 @@ def test_pallas_shard_non_divisible_local_batch():
     lines = [(b"needle %d" % i) if i % 3 == 0 else (b"hay %d" % i)
              for i in range(24)]
     assert f.match_lines(lines) == [i % 3 == 0 for i in range(24)]
+
+
+def test_pallas_mesh_with_prefilter_optin(monkeypatch):
+    """Opt-in two-phase gating inside shard_map (per-shard class
+    tables): verdicts identical to the host oracle on the virtual
+    mesh — the gated kernel now runs under dryrun conditions too."""
+    monkeypatch.setenv("KLOGS_TPU_PREFILTER", "1")
+    import numpy as np
+
+    from klogs_tpu.filters.cpu import RegexFilter
+
+    pats = ["panic:", "code=50[34]", "FATAL|CRIT", r"retry \d+/\d+",
+            "broken pipe", "oom-killer"]
+    devices = jax.devices()[:4]
+    eng = MeshEngine(pats, devices=devices, grid=(2, 2),
+                     impl="pallas_interpret")
+    from klogs_tpu.filters.tpu import pack_lines
+
+    lines = [b"panic: x", b"fine here", b"code=504 y", b"CRIT",
+             b"retry 9/9", b"a broken pipe", b"oom-killer hit", b""] * 5
+    batch, lengths = pack_lines(lines, 32)
+    batch, lengths = batch[: len(lines)], lengths[: len(lines)]
+    got = np.asarray(eng.match_batch(batch, lengths))[: len(lines)]
+    assert got.tolist() == RegexFilter(pats).match_lines(lines)
+
+
+def test_engine_filter_routes_cls_to_mesh():
+    """NFAEngineFilter with a pallas MeshEngine ships host-classified
+    ids straight to match_cls (the multi-chip hot path)."""
+    import numpy as np
+
+    from klogs_tpu.filters.cpu import RegexFilter
+    from klogs_tpu.filters.tpu import NFAEngineFilter
+
+    pats = ["ERROR", r"WARN.*\d", "panic:"]
+    devices = jax.devices()[:4]
+    eng = MeshEngine(pats, devices=devices, grid=(2, 2),
+                     impl="pallas_interpret")
+    assert eng.cls_table is not None
+    f = NFAEngineFilter(pats, engine=eng, kernel="interpret")
+    lines = [b"ERROR x", b"ok", b"WARN q 7", b"panic: z", b"WARN but none"] * 8
+    assert f.match_lines(lines) == RegexFilter(pats).match_lines(lines)
