@@ -57,16 +57,30 @@ class KubeLogStream(LogStream):
 class KubeBackend(ClusterBackend):
     def __init__(self, creds: ClusterCreds):
         self._creds = creds
-        headers = {}
-        if creds.token:
-            headers["Authorization"] = f"Bearer {creds.token}"
+        # Auth is resolved PER REQUEST (not baked into session headers):
+        # exec-plugin tokens rotate (~1h on GKE/EKS), and a --follow run
+        # outliving its token would otherwise 401 until restart. The
+        # provider caches until expiry, so the per-request call is a
+        # dict lookup in the common case (client-go transport behavior,
+        # /root/reference/cmd/root.go:76-86).
         self._session = aiohttp.ClientSession(
             base_url=creds.server,
-            headers=headers,
             connector=aiohttp.TCPConnector(
                 limit=BURST, ssl=creds.ssl_context
             ),
         )
+
+    async def _auth_headers(self, force_refresh: bool = False) -> dict:
+        if self._creds.token_provider is None:
+            token = self._creds.token
+        else:
+            # The exec helper is a blocking subprocess (up to 60s on a
+            # cold cloud-auth path); running it on the event loop would
+            # stall every stream, so it goes through a worker thread.
+            # Cache hits return in microseconds either way.
+            token = await asyncio.to_thread(
+                self._creds.current_token, force_refresh)
+        return {"Authorization": f"Bearer {token}"} if token else {}
 
     @classmethod
     def from_kubeconfig(cls, kubeconfig: str) -> "KubeBackend":
@@ -86,22 +100,35 @@ class KubeBackend(ClusterBackend):
         ≙ the reference's pterm panic, cmd/root.go:110,130) instead of a
         raw aiohttp traceback."""
         try:
-            async with self._session.get(path, params=params or {}) as resp:
-                if resp.status == 404:
-                    return None
-                if resp.status in (401, 403):
-                    word = "Unauthorized" if resp.status == 401 else "Forbidden"
-                    raise ClusterError(
-                        f"{word} (HTTP {resp.status}) from "
-                        f"{self._creds.server}{path} — check your kubeconfig "
-                        f"credentials (context {self._creds.context_name!r})"
-                    )
-                if resp.status >= 400:
-                    body = (await resp.text())[:200]
-                    raise ClusterError(
-                        f"apiserver error HTTP {resp.status} on {path}: {body}"
-                    )
-                return await resp.json()
+            for attempt in (0, 1):
+                async with self._session.get(
+                    path, params=params or {},
+                    headers=await self._auth_headers(force_refresh=attempt > 0),
+                ) as resp:
+                    if resp.status == 404:
+                        return None
+                    if (resp.status == 401 and attempt == 0
+                            and self._creds.token_provider is not None):
+                        # Token rejected before its cached expiry (e.g.
+                        # revoked/rotated server-side): force the helper
+                        # once and retry, like client-go's transport.
+                        continue
+                    if resp.status in (401, 403):
+                        word = ("Unauthorized" if resp.status == 401
+                                else "Forbidden")
+                        raise ClusterError(
+                            f"{word} (HTTP {resp.status}) from "
+                            f"{self._creds.server}{path} — check your "
+                            f"kubeconfig credentials (context "
+                            f"{self._creds.context_name!r})"
+                        )
+                    if resp.status >= 400:
+                        body = (await resp.text())[:200]
+                        raise ClusterError(
+                            f"apiserver error HTTP {resp.status} on {path}: "
+                            f"{body}"
+                        )
+                    return await resp.json()
         except (aiohttp.ClientError, asyncio.TimeoutError) as e:
             # asyncio.TimeoutError: aiohttp's total-timeout is not a
             # ClientError subclass but is the same "can't reach it" UX.
@@ -139,11 +166,21 @@ class KubeBackend(ClusterBackend):
         if opts.tail_lines is not None:
             params["tailLines"] = str(opts.tail_lines)
         try:
-            resp = await self._session.get(
-                f"/api/v1/namespaces/{namespace}/pods/{pod}/log",
-                params=params,
-                timeout=aiohttp.ClientTimeout(total=None, sock_connect=30),
-            )
+            resp = None
+            for attempt in (0, 1):
+                resp = await self._session.get(
+                    f"/api/v1/namespaces/{namespace}/pods/{pod}/log",
+                    params=params,
+                    headers=await self._auth_headers(force_refresh=attempt > 0),
+                    timeout=aiohttp.ClientTimeout(total=None, sock_connect=30),
+                )
+                if (resp.status == 401 and attempt == 0
+                        and self._creds.token_provider is not None):
+                    # Mid-run token rotation: a reconnecting follow
+                    # stream must not burn its backoff budget on 401s.
+                    resp.close()
+                    continue
+                break
             if resp.status != 200:
                 body = (await resp.text())[:300]
                 resp.close()

@@ -38,6 +38,30 @@ class ClusterCreds:
     server: str  # https://host:port
     ssl_context: ssl.SSLContext
     token: str | None  # Authorization: Bearer
+    # Re-resolves the bearer token on demand (exec-plugin helpers cache
+    # until expirationTimestamp, so calling per request is cheap and a
+    # --follow run outliving the token picks up the rotation — client-go
+    # behavior, /root/reference/cmd/root.go:76-86). None for static auth.
+    token_provider: "callable | None" = None
+
+    def current_token(self, force: bool = False) -> str | None:
+        """The bearer token to use NOW. ``force`` bypasses the helper's
+        expiry cache (after a 401 on a supposedly-fresh token)."""
+        if self.token_provider is not None:
+            try:
+                tok = self.token_provider(force=force)
+            except KubeconfigError as e:
+                # Keep the last-known token (it may still work), but
+                # surface the helper's real failure — a later 401 would
+                # otherwise misdiagnose as "check your kubeconfig".
+                from klogs_tpu.ui import term
+
+                term.warning("credential helper failed: %s", e)
+                return self.token
+            if tok:
+                self.token = tok  # last-known-good for helper hiccups
+                return tok
+        return self.token
 
 
 def kubeconfig_paths() -> list[str]:
@@ -100,11 +124,18 @@ def _write_temp(data: bytes, label: str) -> str:
     return tmp
 
 
-def _materialize(inline_b64: str | None, path: str | None, label: str) -> str | None:
+def _materialize(inline_b64: str | None, path: str | None, label: str,
+                 tmps: list | None = None) -> str | None:
     """Inline base64 data wins over file paths (kubectl precedence);
-    inline data lands in a private temp file for ssl's file-based API."""
+    inline data lands in a private temp file for ssl's file-based API.
+    Temp paths are appended to ``tmps`` so the caller can delete them
+    once ssl has read them (the ssl file APIs read eagerly) — inline key
+    material must not linger in /tmp."""
     if inline_b64:
-        return _write_temp(base64.b64decode(inline_b64), label)
+        tmp = _write_temp(base64.b64decode(inline_b64), label)
+        if tmps is not None:
+            tmps.append(tmp)
+        return tmp
     return path
 
 
@@ -137,13 +168,17 @@ def _parse_rfc3339(ts: str) -> datetime:
     return dt
 
 
-def exec_credential(spec: dict) -> dict:
+def exec_credential(spec: dict, force: bool = False) -> dict:
     """Run a kubeconfig exec credential helper and return the
     ExecCredential ``status`` dict (token and/or client cert). Results
     cache until status.expirationTimestamp (no expiry -> cached for the
-    process lifetime, per client-go). Never prompts: the helper runs
-    with interactive=false."""
+    process lifetime, per client-go). ``force`` drops the cache entry
+    first — used after the apiserver rejects a cached token (401) that
+    the expiry said was still good. Never prompts: the helper runs with
+    interactive=false."""
     key = json.dumps(spec, sort_keys=True, default=str)
+    if force:
+        _EXEC_CACHE.pop(key, None)
     hit = _EXEC_CACHE.get(key)
     if hit is not None:
         expiry, status = hit
@@ -224,21 +259,30 @@ def load_creds(kubeconfig: str = "") -> ClusterCreds:
     if not server:
         raise KubeconfigError(f"cluster for context {ctx_name!r} has no server")
 
-    if cluster.get("insecure-skip-tls-verify"):
-        ssl_ctx = ssl._create_unverified_context()
-    else:
-        ca = _materialize(cluster.get("certificate-authority-data"),
-                          cluster.get("certificate-authority"), "ca")
-        ssl_ctx = ssl.create_default_context(cafile=ca)
+    tmps: list[str] = []
+    try:
+        if cluster.get("insecure-skip-tls-verify"):
+            ssl_ctx = ssl._create_unverified_context()
+        else:
+            ca = _materialize(cluster.get("certificate-authority-data"),
+                              cluster.get("certificate-authority"), "ca", tmps)
+            ssl_ctx = ssl.create_default_context(cafile=ca)
 
-    cert = _materialize(user.get("client-certificate-data"),
-                        user.get("client-certificate"), "cert")
-    key = _materialize(user.get("client-key-data"),
-                       user.get("client-key"), "key")
-    if cert and key:
-        ssl_ctx.load_cert_chain(cert, key)
+        cert = _materialize(user.get("client-certificate-data"),
+                            user.get("client-certificate"), "cert", tmps)
+        key = _materialize(user.get("client-key-data"),
+                           user.get("client-key"), "key", tmps)
+        if cert and key:
+            ssl_ctx.load_cert_chain(cert, key)
+    finally:
+        for p in tmps:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
 
     token = user.get("token")
+    token_provider = None
     if not token and user.get("tokenFile"):
         with open(user["tokenFile"]) as f:
             token = f.read().strip()
@@ -250,7 +294,23 @@ def load_creds(kubeconfig: str = "") -> ClusterCreds:
             ec = _write_temp(status["clientCertificateData"].encode(),
                              "exec-cert")
             ek = _write_temp(status["clientKeyData"].encode(), "exec-key")
-            ssl_ctx.load_cert_chain(ec, ek)
+            try:
+                ssl_ctx.load_cert_chain(ec, ek)
+            finally:
+                # load_cert_chain reads eagerly; the key material must
+                # not linger in /tmp.
+                for p in (ec, ek):
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
+        else:
+            # Token-mode helper: re-run (cache honors expiry) so long
+            # follows survive token rotation.
+            spec = user["exec"]
+            token_provider = (
+                lambda force=False: exec_credential(spec, force=force)
+                .get("token"))
 
     return ClusterCreds(
         context_name=ctx_name,
@@ -258,4 +318,5 @@ def load_creds(kubeconfig: str = "") -> ClusterCreds:
         server=server.rstrip("/"),
         ssl_context=ssl_ctx,
         token=token,
+        token_provider=token_provider,
     )

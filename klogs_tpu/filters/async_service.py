@@ -108,6 +108,7 @@ class AsyncFilterService:
             async with self._sem:
                 t_dispatch = time.perf_counter()
                 if self._stats is not None:
+                    self._stats.mark_batch_started(t_dispatch)
                     for _, _, enq in group:
                         self._stats.record_queue_wait(t_dispatch - enq)
                 handle = self._filter.dispatch(all_lines)

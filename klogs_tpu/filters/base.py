@@ -73,6 +73,13 @@ class FilterStats:
     bytes_in: int = 0
     bytes_out: int = 0
     batches: int = 0
+    # Two-phase (prefilter) visibility: without these a user cannot
+    # tell whether gating is engaged, let alone winning.
+    pf_lines: int = 0  # lines that went through the gated kernel
+    pf_candidates: int = 0  # of those, prefilter candidates
+    pf_tiles_total: int = 0
+    pf_tiles_live: int = 0  # tiles that actually ran the scan loop
+    pf_disabled_reason: str | None = None
     started_at: float = field(default_factory=time.perf_counter)
     # Warmup boundary: timestamp when the FIRST batch started filtering.
     # lines_per_sec measures from here, not from pipeline construction —
@@ -82,9 +89,20 @@ class FilterStats:
     _queue: _Reservoir = field(default_factory=_Reservoir)
     _device: _Reservoir = field(default_factory=_Reservoir)
 
+    def mark_batch_started(self, t: float | None = None) -> None:
+        """Record the true start of the first filtered batch. Called at
+        DISPATCH time (AsyncFilterService), so lines/sec on short runs
+        is not overstated by back-computing the start from the first
+        completion (which credits the whole first-batch latency as
+        warmup)."""
+        if self.first_batch_started_at is None:
+            self.first_batch_started_at = (
+                t if t is not None else time.perf_counter())
+
     def record_batch(self, n_lines: int, n_matched: int, n_bytes_in: int,
                      n_bytes_out: int, latency_s: float) -> None:
         if self.first_batch_started_at is None:
+            # Fallback for synchronous paths that never mark dispatch.
             self.first_batch_started_at = time.perf_counter() - latency_s
         self.lines_in += n_lines
         self.lines_matched += n_matched
@@ -92,6 +110,13 @@ class FilterStats:
         self.bytes_out += n_bytes_out
         self.batches += 1
         self._batch.add(latency_s)
+
+    def record_prefilter(self, n_lines: int, n_candidates: int,
+                         n_tiles: int, n_tiles_live: int) -> None:
+        self.pf_lines += n_lines
+        self.pf_candidates += n_candidates
+        self.pf_tiles_total += n_tiles
+        self.pf_tiles_live += n_tiles_live
 
     def record_queue_wait(self, wait_s: float) -> None:
         self._queue.add(wait_s)

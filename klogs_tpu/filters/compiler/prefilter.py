@@ -201,6 +201,9 @@ class PrefilterProgram:
     lut2: np.ndarray  # [256, W] uint32 — byte valid as a clause-pair second
     req: np.ndarray  # [P, W] uint32 — pattern p needs all these bits
     usable: bool
+    # Clause count retained per pattern (observability: a zero here is
+    # WHY gating is disabled for the whole set).
+    clause_counts: "list[int] | None" = None
 
     @property
     def n_words(self) -> int:
@@ -243,7 +246,8 @@ def compile_prefilter(patterns: list[str],
     for i, slots in enumerate(chosen):
         for slot in slots:
             req[i, slot // 32] |= np.uint32(1 << (slot % 32))
-    return PrefilterProgram(lut1=lut1, lut2=lut2, req=req, usable=usable)
+    return PrefilterProgram(lut1=lut1, lut2=lut2, req=req, usable=usable,
+                            clause_counts=[len(s) for s in chosen])
 
 
 def candidates_host(pf: PrefilterProgram, lines: list[bytes]) -> list[bool]:

@@ -215,6 +215,16 @@ class FilterPipeline:
                 s.percentile_device_s(50) * 1e3,
                 s.percentile_device_s(99) * 1e3,
             )
+        if s.pf_lines:
+            term.info(
+                "  prefilter: %.1f%% candidates (%d/%d lines), "
+                "%d/%d tiles skipped",
+                100.0 * s.pf_candidates / s.pf_lines,
+                s.pf_candidates, s.pf_lines,
+                s.pf_tiles_total - s.pf_tiles_live, s.pf_tiles_total,
+            )
+        elif s.pf_disabled_reason:
+            term.info("  %s", s.pf_disabled_reason)
 
 
 def make_pipeline(patterns: list[str], backend: str,
@@ -255,7 +265,7 @@ def make_pipeline(patterns: list[str], backend: str,
             # GSPMD over the jnp path (kernel needs Mosaic or interpret).
             impl = "pallas" if jax.default_backend() != "cpu" else "gspmd"
             engine = MeshEngine(patterns, impl=impl)
-        log_filter = NFAEngineFilter(patterns, engine=engine)
+        log_filter = NFAEngineFilter(patterns, engine=engine, stats=stats)
         # Device batches are cheap per line but each round trip has fixed
         # latency: bigger batches + the async pipeline hide it.
         batch_lines = batch_lines or 8192

@@ -121,7 +121,8 @@ class NFAEngineFilter(LogFilter):
     SEQ_SCAN_BYTES = 128 * 1024
 
     def __init__(self, patterns: list[str], ignore_case: bool = False,
-                 chunk_bytes: int = 4096, engine=None, kernel: str | None = None):
+                 chunk_bytes: int = 4096, engine=None, kernel: str | None = None,
+                 stats=None):
         import jax
 
         from klogs_tpu.ops import nfa  # deferred: --backend=cpu must not need jax
@@ -131,6 +132,7 @@ class NFAEngineFilter(LogFilter):
         self._dp = nfa.pack_program(self._prog)
         self._chunk_bytes = chunk_bytes
         self._engine = engine  # optional parallel engine (klogs_tpu.parallel)
+        self._stats = stats  # optional FilterStats for prefilter visibility
 
         # Execution path for the hot op: the Pallas kernel on real TPU,
         # the jnp/lax.scan path elsewhere (identical semantics; the
@@ -190,6 +192,21 @@ class NFAEngineFilter(LogFilter):
                                      self._dp_grouped.n_classes)
                         or device_tables(pf)
                     )
+                else:
+                    # One clause-less pattern disables gating for the
+                    # whole set (its candidate mask would be all-True);
+                    # say so instead of failing silently.
+                    from klogs_tpu.ui import term
+
+                    culprits = [p for p, n in zip(patterns,
+                                                  pf.clause_counts or [])
+                                if n == 0]
+                    reason = ("prefilter disabled: no mandatory byte "
+                              "pairs for pattern(s) %s" %
+                              ", ".join(repr(p) for p in culprits[:4]))
+                    term.info("%s", reason)
+                    if self._stats is not None:
+                        self._stats.pf_disabled_reason = reason
 
     def match_lines(self, lines: list[bytes]) -> list[bool]:
         return self.fetch(self.dispatch(lines))
@@ -228,15 +245,16 @@ class NFAEngineFilter(LogFilter):
                 parts.append((idxs, *self._match_cls_dispatch(sub, width)))
             else:
                 batch, lengths = pack_lines(sub, width)
-                parts.append((idxs, self._match_full(batch, lengths), None))
+                parts.append((idxs, self._match_full(batch, lengths),
+                              None, None))
         if long_idx:
             parts.append(
                 (long_idx, self._match_long([bodies[i] for i in long_idx]),
-                 None))
+                 None, None))
         if huge_idx:
             parts.append(
                 (huge_idx, self._match_huge([bodies[i] for i in huge_idx]),
-                 None))
+                 None, None))
         return (len(lines), parts)
 
     def fetch(self, handle) -> list[bool]:
@@ -250,7 +268,7 @@ class NFAEngineFilter(LogFilter):
         if parts is None:
             return [True] * n
         out = np.zeros(n, dtype=bool)
-        for idxs, mask, retry in parts:
+        for idxs, mask, retry, pf in parts:
             try:
                 vals = np.asarray(mask)
             except Exception as e:
@@ -263,13 +281,18 @@ class NFAEngineFilter(LogFilter):
                     "falling back to plain NFA", str(e)[:120])
                 self._pf_tables = None
                 vals = np.asarray(retry())
+                pf = None
             out[idxs] = vals[: len(idxs)]
+            if pf is not None and self._stats is not None:
+                n_cand, n_live, n_tiles = (int(np.asarray(x)) for x in pf)
+                self._stats.record_prefilter(
+                    len(idxs), min(n_cand, len(idxs)), n_tiles, n_live)
         return out.tolist()
 
     def _match_cls_dispatch(self, bodies: list[bytes], width: int):
         """Hot path: host-side fused pack+classify, device kernel on
         class ids (no classify gather on device). Returns
-        (device_mask, retry_closure_or_None)."""
+        (device_mask, retry_closure_or_None, pf_stats_or_None)."""
         if self._engine is not None:
             eng = self._engine
             cls = pack_classify(bodies, width, eng.cls_table,
@@ -283,7 +306,7 @@ class NFAEngineFilter(LogFilter):
                     eng.disable_prefilter()
                     return eng.match_cls(cls, plain=True)
             try:
-                return eng.match_cls(cls), retry
+                return eng.match_cls(cls), retry, None
             except Exception as e:
                 if retry is None:
                     raise
@@ -292,7 +315,7 @@ class NFAEngineFilter(LogFilter):
                 term.warning(
                     "gated mesh kernel unavailable (%s); "
                     "falling back to plain NFA", str(e)[:120])
-                return retry(), None
+                return retry(), None, None
         dpg = self._dp_grouped
         cls = pack_classify(bodies, width, self._cls_table,
                             dpg.begin_class, dpg.end_class, dpg.pad_class)
@@ -301,15 +324,18 @@ class NFAEngineFilter(LogFilter):
         interpret = self._kernel == "interpret"
         kw = env_overrides()
         if self._pf_tables is not None and len(self._pf_tables) == 4:
+            want_stats = self._stats is not None
             try:
-                mask = self._pallas.match_cls_grouped_pallas(
+                res = self._pallas.match_cls_grouped_pallas(
                     dpg, self._g_live, self._g_acc, cls,
                     interpret=interpret,
-                    prefilter_tables=self._pf_tables, **kw)
+                    prefilter_tables=self._pf_tables,
+                    return_stats=want_stats, **kw)
+                mask, pf = res if want_stats else (res, None)
                 retry = lambda: self._pallas.match_cls_grouped_pallas(
                     dpg, self._g_live, self._g_acc, cls,
                     interpret=interpret, **kw)
-                return mask, retry
+                return mask, retry, pf
             except Exception as e:
                 # Gated-kernel compile trouble (Mosaic) must degrade to
                 # the plain NFA, not kill the streaming run.
@@ -321,7 +347,7 @@ class NFAEngineFilter(LogFilter):
                 self._pf_tables = None
         return self._pallas.match_cls_grouped_pallas(
             dpg, self._g_live, self._g_acc, cls,
-            interpret=interpret, **kw), None
+            interpret=interpret, **kw), None, None
 
     def _match_full(self, batch: np.ndarray, lengths: np.ndarray) -> np.ndarray:
         if self._engine is not None:
@@ -370,9 +396,11 @@ class NFAEngineFilter(LogFilter):
         return matched  # device array (padded); fetch() slices on host
 
     def _match_huge(self, bodies: list[bytes]) -> np.ndarray:
-        """Sequence-parallel scan per line (ops/seqscan): log-depth
-        batched transfer-matrix composition instead of len/chunk
-        sequential dispatches."""
+        """Sequence-parallel scan (ops/seqscan): log-depth batched
+        transfer-matrix composition instead of len/chunk sequential
+        dispatches. Concurrent jumbo lines advance together in one
+        vmapped program per chunk-count bucket — no per-line dispatch
+        or recompilation."""
         import jax.numpy as jnp
 
         from klogs_tpu.ops import seqscan
@@ -382,11 +410,10 @@ class NFAEngineFilter(LogFilter):
             self._dp_seq = self._nfa.pack_program(aug, dtype=jnp.int8)
             self._seq_live = self._prog.n_states
             self._seq_acc = self._prog.n_states + 1
-        return np.array([
-            seqscan.match_line_scan(self._dp_seq, self._seq_live,
-                                    self._seq_acc, b)
-            for b in bodies
-        ], dtype=bool)
+        return np.array(
+            seqscan.match_lines_scan(self._dp_seq, self._seq_live,
+                                     self._seq_acc, bodies),
+            dtype=bool)
 
     def close(self) -> None:
         if self._engine is not None:

@@ -76,23 +76,31 @@ def match_chunk_pallas(dp: DeviceProgram, acc: int,
     """Kernel-path chunk matcher over an AUGMENTED program (nfa.augment,
     packed with dtype=jnp.int8). ``acc`` is the absorbing accept-state
     index; ``v0`` is the [B, S] i8 carry (from initial_state_kernel or a
-    previous chunk). Returns (v [B, S] i8, matched [B] bool)."""
+    previous chunk). Returns (v [B, S] i8, matched [B] bool).
+
+    Any batch size works: like the grouped sibling, B pads up to a tile
+    multiple internally (pad rows carry a dead all-zero state and are
+    sliced off before return), so long-line batches need not be
+    tile-aligned."""
     B = chunk.shape[0]
+    TILE_B = min(tile_b, B)
+    Bp = -(-B // TILE_B) * TILE_B
+    if Bp != B:
+        chunk = jnp.pad(chunk, ((0, Bp - B), (0, 0)))
+        rem = jnp.pad(rem, (0, Bp - B))  # pad rows: already-ended lines
+        v0 = jnp.pad(v0, ((0, Bp - B), (0, 0)))  # dead state: stays dead
     cls = classify_chunk(dp, chunk, rem, first=first, final=final)
     if final:
         # One pad step after END so `acc` latches the last transition.
         cls = jnp.concatenate(
-            [cls, jnp.full((B, 1), dp.pad_class, dtype=jnp.int32)], axis=1
+            [cls, jnp.full((Bp, 1), dp.pad_class, dtype=jnp.int32)], axis=1
         )
     T = cls.shape[1]
     S, C = dp.n_states, dp.n_classes
-    TILE_B = min(tile_b, B)
-    if B % TILE_B:
-        raise ValueError(f"batch {B} not divisible by tile {TILE_B}")
 
     out, vout = pl.pallas_call(
         functools.partial(_kernel, T=T, C=C, acc=acc),
-        grid=(B // TILE_B,),
+        grid=(Bp // TILE_B,),
         in_specs=[
             pl.BlockSpec((T, TILE_B), lambda i: (0, i),
                          memory_space=pltpu.VMEM),          # cls (transposed)
@@ -110,16 +118,16 @@ def match_chunk_pallas(dp: DeviceProgram, acc: int,
                          memory_space=pltpu.VMEM),          # v carry-out
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((1, B), jnp.int8),
-            jax.ShapeDtypeStruct((S, B), jnp.int8),
+            jax.ShapeDtypeStruct((1, Bp), jnp.int8),
+            jax.ShapeDtypeStruct((S, Bp), jnp.int8),
         ],
         interpret=interpret,
     )(cls.T, dp.char_mask.T, dp.follow.T, v0.T)
 
-    matched = out[0, :] > 0
+    matched = out[0, :B] > 0
     if final:
         matched = matched | jnp.asarray(dp.match_all)
-    return vout.T, matched
+    return vout.T[:B], matched
 
 
 DEFAULT_TILE_B_GROUPED = 4096
@@ -259,14 +267,15 @@ def match_batch_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
 
 @functools.partial(jax.jit, static_argnames=("live", "acc", "tile_b",
                                              "interpret", "unroll",
-                                             "interleave"))
+                                             "interleave", "return_stats"))
 def match_cls_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
                              cls: jax.Array,
                              tile_b: int = DEFAULT_TILE_B_GROUPED,
                              interpret: bool = False,
                              unroll: int = 1,
                              interleave: int = 1,
-                             prefilter_tables=None) -> jax.Array:
+                             prefilter_tables=None,
+                             return_stats: bool = False):
     """Full-line match over HOST-classified int8 class ids: [B, T] i8
     (pack_classify layout: BEGIN, body classes, END, PAD latch columns)
     -> [B] bool. The single-chip hot path: the device-side byte->class
@@ -275,7 +284,10 @@ def match_cls_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
     into the native packer — and the kernel consumes classes directly.
 
     ``prefilter_tables`` must be the class-domain 4-tuple
-    (ops.prefilter.class_tables) when given."""
+    (ops.prefilter.class_tables) when given. With ``return_stats`` (and
+    gating active) returns (matched, (n_candidates, n_tiles_live,
+    n_tiles)) — three device scalars fetched with the mask, feeding the
+    --stats prefilter line."""
     B = cls.shape[0]
     TILE_B = min(tile_b, B)
     Bp = -(-B // TILE_B) * TILE_B
@@ -287,12 +299,14 @@ def match_cls_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
                       constant_values=dp.pad_class)
     return _launch_grouped(dp, live, acc, cls.astype(jnp.int32), B, TILE_B,
                            interpret, unroll, interleave,
-                           prefilter_tables, None)
+                           prefilter_tables, None,
+                           return_stats=return_stats)
 
 
 def _launch_grouped(dp, live, acc, cls, B, TILE_B,
                     interpret, unroll, interleave,
-                    prefilter_tables, cand_input):
+                    prefilter_tables, cand_input,
+                    return_stats: bool = False):
     """Shared kernel launch over classified [Bp, T] i32 ids (padded to a
     TILE_B multiple); B is the real row count to slice back to."""
     Bp, T = cls.shape
@@ -322,7 +336,8 @@ def _launch_grouped(dp, live, acc, cls, B, TILE_B,
             out_shape=jax.ShapeDtypeStruct((1, Bp), jnp.int8),
             interpret=interpret,
         )(cls.T, char_mask_t, follow_t)
-        return (out[0, :B] > 0) | jnp.asarray(dp.match_all)
+        matched = (out[0, :B] > 0) | jnp.asarray(dp.match_all)
+        return (matched, None) if return_stats else matched
 
     from klogs_tpu.ops.prefilter import (
         candidate_mask,
@@ -353,7 +368,13 @@ def _launch_grouped(dp, live, acc, cls, B, TILE_B,
         interpret=interpret,
     )(tile_live, cls.T, char_mask_t, follow_t)
     matched = (out[0] > 0)[inv][:B]
-    return matched | jnp.asarray(dp.match_all)
+    matched = matched | jnp.asarray(dp.match_all)
+    if return_stats:
+        stats = (jnp.sum(cand.astype(jnp.int32)),
+                 jnp.sum(tile_live),
+                 jnp.asarray(tile_live.shape[0], jnp.int32))
+        return matched, stats
+    return matched
 
 
 def initial_state_kernel(dp: DeviceProgram, live: int, batch_size: int):

@@ -86,6 +86,13 @@ def _pad_pow2(n: int) -> int:
     return p
 
 
+def _pow2_floor(n: int) -> int:
+    p = 1
+    while p * 2 <= n:
+        p *= 2
+    return p
+
+
 def classify_line(dp: DeviceProgram, line: bytes, tile_t: int) -> np.ndarray:
     """Class ids for one line incl. BEGIN/END/latch, padded to a
     multiple of tile_t (tile_t must be a power of two)."""
@@ -150,12 +157,59 @@ def match_line_scan(dp: DeviceProgram, live: int, acc: int, line: bytes,
     vector-matrix fold across tiles (S^2 per tile_t bytes). Peak device
     memory is bounded by ``step_bytes_budget`` regardless of line size —
     tiles are processed in fixed-size chunks folded into the carry."""
+    return match_lines_scan(dp, live, acc, [line], tile_t,
+                            step_bytes_budget)[0]
+
+
+@functools.partial(jax.jit, static_argnames=("live",))
+def _scan_chunked_batch(dp: DeviceProgram, cls4: jax.Array,
+                        live: int) -> jax.Array:
+    """[N, n_chunks, tpc, tile_t] -> [N, S] final state vectors — N
+    jumbo lines advancing together (vmap of the chunked fold)."""
+    return jax.vmap(lambda c: _scan_chunked(dp, c, live))(cls4)
+
+
+def match_lines_scan(dp: DeviceProgram, live: int, acc: int,
+                     lines: list[bytes],
+                     tile_t: int = DEFAULT_TILE_T,
+                     step_bytes_budget: int = DEFAULT_STEP_BYTES_BUDGET,
+                     ) -> list[bool]:
+    """Batched sequence-parallel matching of N jumbo lines: lines are
+    grouped by padded chunk-count (a power of two, so the jit cache
+    sees O(log max-length) shapes — no recompilation per line) and each
+    group runs as ONE vmapped device program. The step-matrix budget is
+    split across the lines scanned together, keeping peak memory at
+    ``step_bytes_budget`` for the whole call."""
     assert tile_t & (tile_t - 1) == 0, "tile_t must be a power of two"
-    cls = classify_line(dp, line, tile_t).reshape(-1, tile_t)
-    tpc = _tiles_per_chunk(tile_t, dp.n_states, step_bytes_budget)
-    cls3 = _chunk_classes(dp, cls, tile_t, tpc)
-    v = _scan_chunked(dp, jnp.asarray(cls3), live)
-    return bool(np.asarray(v)[acc]) or dp.match_all
+    if not lines:
+        return []
+    S = dp.n_states
+    # Every shape knob is quantized to a power of two — line count for
+    # the budget split, tiles-per-chunk, group batch dim — so the jit
+    # cache stays O(log^2), not one entry per concurrent-line count.
+    per_line = max(step_bytes_budget // _pad_pow2(len(lines)),
+                   tile_t * S * S)
+    tpc = _pow2_floor(_tiles_per_chunk(tile_t, S, per_line))
+    groups: dict[int, list[int]] = {}
+    cls3s: list[np.ndarray] = []
+    for i, line in enumerate(lines):
+        cls = classify_line(dp, line, tile_t).reshape(-1, tile_t)
+        cls3 = _chunk_classes(dp, cls, tile_t, tpc)
+        cls3s.append(cls3)
+        groups.setdefault(cls3.shape[0], []).append(i)
+    out = [bool(dp.match_all)] * len(lines)
+    for idxs in groups.values():
+        rows = [cls3s[i] for i in idxs]
+        # Pad the batch dim with all-PAD pseudo-lines (identity folds,
+        # never match) up to a power of two.
+        pad_n = _pad_pow2(len(rows)) - len(rows)
+        if pad_n:
+            rows.extend([np.full_like(rows[0], dp.pad_class)] * pad_n)
+        stacked = jnp.asarray(np.stack(rows))
+        v = np.asarray(_scan_chunked_batch(dp, stacked, live))
+        for i, hit in zip(idxs, v[:, acc]):
+            out[i] = bool(hit) or dp.match_all
+    return out
 
 
 def _sharded_fn(mesh, n_states: int):
@@ -203,7 +257,13 @@ def _sharded_fn(mesh, n_states: int):
     return jax.jit(fn)
 
 
-_SHARDED_CACHE: dict = {}
+# shard_map'd fold programs, keyed by (device ids, axis name, S) — NOT
+# by the Mesh object: two Meshes over the same devices are functionally
+# identical, and keying on the object would leak one jitted closure per
+# ad-hoc Mesh. Bounded LRU so even pathological device-set churn cannot
+# grow it without limit.
+_SHARDED_CACHE: "dict" = {}
+_SHARDED_CACHE_MAX = 8
 
 
 def match_line_sharded(dp: DeviceProgram, live: int, acc: int, line: bytes,
@@ -229,10 +289,14 @@ def match_line_sharded(dp: DeviceProgram, live: int, acc: int, line: bytes,
     # Chunk count a power of two AND a multiple of D -> equal spans.
     cls3 = _chunk_classes(dp, cls, tile_t, tpc, round_to=D)
 
-    key = (mesh, dp.n_states)
-    fn = _SHARDED_CACHE.get(key)
+    key = (tuple(d.id for d in mesh.devices.flat), mesh.axis_names,
+           dp.n_states)
+    fn = _SHARDED_CACHE.pop(key, None)
     if fn is None:
-        fn = _SHARDED_CACHE[key] = _sharded_fn(mesh, dp.n_states)
+        fn = _sharded_fn(mesh, dp.n_states)
+    _SHARDED_CACHE[key] = fn  # re-insert: dict order gives LRU
+    while len(_SHARDED_CACHE) > _SHARDED_CACHE_MAX:
+        _SHARDED_CACHE.pop(next(iter(_SHARDED_CACHE)))
     m_total = np.asarray(fn(dp, jnp.asarray(cls3)))[0]  # replicated
     v0 = np.zeros(dp.n_states, dtype=np.int64)
     v0[live] = 1

@@ -469,3 +469,115 @@ def test_kubeconfig_multipath_skips_empty_file(tmp_path, monkeypatch):
     monkeypatch.setenv("KUBECONFIG", f"{empty}{os.pathsep}{path}")
     creds = load_creds()
     assert creds.server == "https://solo:6443"
+
+
+# ---------------------------------------------------------------------
+# Mid-run credential refresh (client-go transport parity): a 401 on a
+# token-provider-backed session forces one helper re-run and retries.
+# ---------------------------------------------------------------------
+
+
+def _rotating_creds(server, tokens):
+    """ClusterCreds whose provider yields tokens[0] until forced, then
+    tokens[1] onward (recording force flags)."""
+    import ssl as _ssl
+
+    from klogs_tpu.cluster.kubeconfig import ClusterCreds
+
+    calls = []
+
+    def provider(force=False):
+        calls.append(force)
+        return tokens[1] if force or len(calls) > len(tokens) else tokens[0]
+
+    creds = ClusterCreds(
+        context_name="testctx", namespace="kube-system", server=server,
+        ssl_context=_ssl.create_default_context(), token=tokens[0],
+        token_provider=provider,
+    )
+    return creds, calls
+
+
+async def _with_rotating_backend(fn, accepted_token="tok2"):
+    import klogs_tpu.cluster.kube as kube_mod
+
+    # Server accepts ONLY the rotated token: any request with the stale
+    # one sees 401, which must trigger exactly one forced refresh.
+    app = make_app()
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    creds, calls = _rotating_creds(f"http://127.0.0.1:{port}",
+                                   ["stale-token", TOKEN])
+    backend = kube_mod.KubeBackend(creds)
+    try:
+        return await fn(backend, calls)
+    finally:
+        await backend.close()
+        await runner.cleanup()
+
+
+def test_get_refreshes_token_on_401(tmp_path):
+    async def fn(b, calls):
+        names = await b.list_namespaces()
+        assert "kube-system" in names
+        assert True in calls, "401 must force a helper re-run"
+
+    asyncio.run(_with_rotating_backend(fn))
+
+
+def test_log_stream_refreshes_token_on_401(tmp_path):
+    async def fn(b, calls):
+        from klogs_tpu.cluster.types import LogOptions
+
+        stream = await b.open_log_stream(
+            "kube-system", "api-1", LogOptions(container="srv"))
+        chunks = [c async for c in stream]
+        await stream.close()
+        assert b"".join(chunks)
+        assert True in calls
+
+    asyncio.run(_with_rotating_backend(fn))
+
+
+def test_static_token_401_is_friendly_error(tmp_path):
+    """Without a provider, a 401 surfaces as the friendly ClusterError
+    (no silent retry loop)."""
+    async def fn(b):
+        from klogs_tpu.cluster.backend import ClusterError
+
+        with pytest.raises(ClusterError, match="Unauthorized"):
+            await b.list_namespaces()
+
+    asyncio.run(with_backend(tmp_path, fn, token="wrong-token"))
+
+
+def test_inline_tls_material_deleted(tmp_path, monkeypatch):
+    """Inline CA/cert/key land in temp files for ssl's file API; they
+    must be deleted once loaded (key material must not linger)."""
+    import yaml
+
+    monkeypatch.setenv("TMPDIR", str(tmp_path / "tmp"))
+    (tmp_path / "tmp").mkdir()
+    import tempfile as _tf
+
+    _tf.tempdir = None  # re-resolve TMPDIR
+    try:
+        p = tmp_path / "kc"
+        p.write_text(yaml.safe_dump({
+            "current-context": "c",
+            "contexts": [{"name": "c", "context": {"cluster": "cl", "user": "u"}}],
+            "clusters": [{"name": "cl", "cluster": {
+                "server": "https://example:6443",
+                "certificate-authority-data": base64.b64encode(
+                    _self_signed_ca()).decode()}}],
+            "users": [{"name": "u", "user": {"token": "t"}}],
+        }))
+        load_creds(str(p))
+        leftovers = [f for f in (tmp_path / "tmp").iterdir()
+                     if f.name.startswith("klogs-")]
+        assert leftovers == []
+    finally:
+        _tf.tempdir = None

@@ -69,3 +69,42 @@ def test_huge_lines_route_to_seqscan(kernel, monkeypatch):
     lines = [b"short needle", huge_hit, b"q" * 5000, huge_tail, huge_miss]
     f = NFAEngineFilter(pats, chunk_bytes=2048, kernel=kernel)
     assert f.match_lines(lines) == RegexFilter(pats).match_lines(lines)
+
+
+def test_chunk_kernel_non_divisible_batch():
+    """match_chunk_pallas pads internally: a non-power-of-two long-line
+    batch that doesn't divide the tile must work end to end."""
+    import numpy as np
+
+    from klogs_tpu.filters.compiler.glushkov import compile_patterns
+    from klogs_tpu.filters.cpu import RegexFilter
+    from klogs_tpu.ops import nfa
+    from klogs_tpu.ops.pallas_nfa import initial_state_kernel, match_chunk_pallas
+
+    import jax.numpy as jnp
+
+    pats = ["mark[0-9]+x"]
+    prog = compile_patterns(pats)
+    dp = nfa.pack_program(nfa.augment(prog), dtype=jnp.int8)
+    live, acc = prog.n_states, prog.n_states + 1
+    L = 256
+    rng = random.Random(9)
+    bodies = []
+    for i in range(5):  # 5 rows, tile 4 -> pad to 8
+        n = rng.randrange(300, 700)
+        b = bytes(rng.choice(b"qrs tuv") for _ in range(n))
+        if i % 2:
+            cut = rng.randrange(0, n)
+            b = b[:cut] + b"mark33x" + b[cut:]
+        bodies.append(b)
+    total = np.array([len(b) for b in bodies], dtype=np.int32)
+    n_chunks = int(np.ceil(total.max() / L))
+    v = initial_state_kernel(dp, live, len(bodies))
+    for k in range(n_chunks):
+        seg = [b[k * L : (k + 1) * L].ljust(L, b"\0") for b in bodies]
+        chunk = np.frombuffer(b"".join(seg), dtype=np.uint8).reshape(-1, L)
+        v, matched = match_chunk_pallas(
+            dp, acc, chunk, total - k * L, v,
+            first=(k == 0), final=(k == n_chunks - 1),
+            tile_b=4, interpret=True)
+    assert np.asarray(matched).tolist() == RegexFilter(pats).match_lines(bodies)

@@ -314,3 +314,31 @@ def test_engine_filter_uses_class_tables(monkeypatch):
     assert f._pf_tables is not None and len(f._pf_tables) == 4
     lines = _lines(200)
     assert f.match_lines(lines) == RegexFilter(BENCH_PATTERNS).match_lines(lines)
+
+
+def test_stats_record_prefilter(monkeypatch):
+    """Opt-in gating with a stats object: candidate fraction and tile
+    skips are observable after a match."""
+    from klogs_tpu.filters.base import FilterStats
+
+    monkeypatch.setenv("KLOGS_TPU_PREFILTER", "1")
+    stats = FilterStats()
+    f = NFAEngineFilter(BENCH_PATTERNS, kernel="interpret", stats=stats)
+    assert f._pf_tables is not None
+    lines = _lines(200)
+    f.match_lines(lines)
+    assert stats.pf_lines >= 200
+    assert 0 < stats.pf_candidates < stats.pf_lines
+    assert stats.pf_tiles_total > 0
+    assert stats.pf_tiles_live <= stats.pf_tiles_total
+
+
+def test_stats_disabled_reason(monkeypatch):
+    """A clause-less pattern (single byte) disables gating and says why."""
+    from klogs_tpu.filters.base import FilterStats
+
+    monkeypatch.setenv("KLOGS_TPU_PREFILTER", "1")
+    stats = FilterStats()
+    f = NFAEngineFilter(["panic:", "x"], kernel="interpret", stats=stats)
+    assert f._pf_tables is None
+    assert stats.pf_disabled_reason and "'x'" in stats.pf_disabled_reason

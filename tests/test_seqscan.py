@@ -94,3 +94,68 @@ def test_sharded_chunked_budget():
     line = b"x" * 40000
     assert match_line_sharded(dp, live, acc, line, tile_t=128,
                               step_bytes_budget=1 << 16) is False
+
+
+def test_match_lines_scan_batched_vs_oracle():
+    """Concurrent jumbo lines of mixed sizes: one vmapped program per
+    chunk-count bucket, verdicts equal to re."""
+    import re
+
+    from klogs_tpu.ops.seqscan import match_lines_scan
+
+    pats = ["needle[0-9]", "END$"]
+    dp, live, acc = compile_aug(pats)
+    rng = random.Random(11)
+    lines = []
+    for i in range(9):
+        n = rng.randrange(2000, 30000)
+        body = bytes(rng.choice(b"abcdef gh") for _ in range(n))
+        if i % 3 == 0:
+            cut = rng.randrange(0, n)
+            body = body[:cut] + b"needle7" + body[cut:]
+        if i % 4 == 0:
+            body += b"END"
+        lines.append(body)
+    got = match_lines_scan(dp, live, acc, lines)
+    exp = [any(re.search(p.encode(), ln) for p in pats) for ln in lines]
+    assert got == exp
+
+
+def test_match_lines_scan_single_program_per_bucket(monkeypatch):
+    """>=8 concurrent jumbo lines in one size bucket must produce ONE
+    device program invocation (no per-line dispatch/recompile)."""
+    from klogs_tpu.ops import seqscan
+
+    pats = ["zz9"]
+    dp, live, acc = compile_aug(pats)
+    calls = []
+    real = seqscan._scan_chunked_batch
+
+    def spy(dp_, cls4, live_):
+        calls.append(cls4.shape)
+        return real(dp_, cls4, live_)
+
+    monkeypatch.setattr(seqscan, "_scan_chunked_batch", spy)
+    rng = random.Random(3)
+    lines = [bytes(rng.choice(b"abc def!") for _ in range(20_000)) + b"zz9"
+             for _ in range(8)]
+    got = seqscan.match_lines_scan(dp, live, acc, lines)
+    assert got == [True] * 8
+    assert len(calls) == 1, f"expected one vmapped call, got {calls}"
+    assert calls[0][0] == 8
+
+
+def test_engine_filter_concurrent_huge_lines(monkeypatch):
+    """NFAEngineFilter routes concurrent huge lines through the batched
+    scan — correctness across the size-class boundary in one dispatch."""
+    from klogs_tpu.filters.cpu import RegexFilter
+    from klogs_tpu.filters.tpu import NFAEngineFilter
+
+    f = NFAEngineFilter(["boom!", "ok$"], kernel="interpret")
+    monkeypatch.setattr(f, "SEQ_SCAN_BYTES", 8192)  # jumbo at 8KB for test speed
+    rng = random.Random(5)
+    huge = [bytes(rng.choice(b"qwerty ") for _ in range(12_000))
+            for _ in range(4)]
+    huge[1] = huge[1][:6000] + b"boom!" + huge[1][6000:]
+    lines = [b"small boom!", b"tiny ok"] + huge
+    assert f.match_lines(lines) == RegexFilter(["boom!", "ok$"]).match_lines(lines)
