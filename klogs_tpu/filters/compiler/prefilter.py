@@ -201,8 +201,10 @@ class PrefilterProgram:
     lut2: np.ndarray  # [256, W] uint32 — byte valid as a clause-pair second
     req: np.ndarray  # [P, W] uint32 — pattern p needs all these bits
     usable: bool
-    # Clause count retained per pattern (observability: a zero here is
-    # WHY gating is disabled for the whole set).
+    # Clauses FOUND per pattern, before slot allocation (observability:
+    # a zero here means the pattern truly has no mandatory pairs; a
+    # nonzero count on an unusable program means the shared slot table
+    # ran out — different user guidance).
     clause_counts: "list[int] | None" = None
 
     @property
@@ -247,7 +249,7 @@ def compile_prefilter(patterns: list[str],
         for slot in slots:
             req[i, slot // 32] |= np.uint32(1 << (slot % 32))
     return PrefilterProgram(lut1=lut1, lut2=lut2, req=req, usable=usable,
-                            clause_counts=[len(s) for s in chosen])
+                            clause_counts=[len(c) for c in per_pattern])
 
 
 def candidates_host(pf: PrefilterProgram, lines: list[bytes]) -> list[bool]:

@@ -201,9 +201,15 @@ class NFAEngineFilter(LogFilter):
                     culprits = [p for p, n in zip(patterns,
                                                   pf.clause_counts or [])
                                 if n == 0]
-                    reason = ("prefilter disabled: no mandatory byte "
-                              "pairs for pattern(s) %s" %
-                              ", ".join(repr(p) for p in culprits[:4]))
+                    if culprits:
+                        reason = ("prefilter disabled: no mandatory byte "
+                                  "pairs for pattern(s) %s" %
+                                  ", ".join(repr(p) for p in culprits[:4]))
+                    else:
+                        # Every pattern HAS clauses; the shared slot
+                        # table filled up before some pattern got one.
+                        reason = ("prefilter disabled: clause slot table "
+                                  "exhausted (pattern set too diverse)")
                     term.info("%s", reason)
                     if self._stats is not None:
                         self._stats.pf_disabled_reason = reason

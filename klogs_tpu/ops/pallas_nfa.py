@@ -83,7 +83,7 @@ def match_chunk_pallas(dp: DeviceProgram, acc: int,
     sliced off before return), so long-line batches need not be
     tile-aligned."""
     B = chunk.shape[0]
-    TILE_B = min(tile_b, B)
+    TILE_B = _cap_tile(tile_b, B, chunk.shape[1] + 2)
     Bp = -(-B // TILE_B) * TILE_B
     if Bp != B:
         chunk = jnp.pad(chunk, ((0, Bp - B), (0, 0)))
@@ -130,7 +130,27 @@ def match_chunk_pallas(dp: DeviceProgram, acc: int,
     return vout.T[:B], matched
 
 
-DEFAULT_TILE_B_GROUPED = 4096
+DEFAULT_TILE_B_GROUPED = 8192  # tune sweep 2026-07-29 (BENCH_DEVICE.json
+# host_classify_rework.tune_cls): 5.62M lines/s vs 5.48M at 4096 on v5e,
+# batch 131k; smaller batches are capped by min(tile_b, B) anyway.
+
+# The cls block ([T, TILE_B] i32) must fit VMEM alongside tables and the
+# state tile; cap its footprint so wide width-buckets (long lines) shrink
+# the batch tile instead of overflowing VMEM — the non-gated hot path has
+# no fallback, so an overflow would kill the run, not degrade it.
+_CLS_BLOCK_BYTES = 32 << 20
+
+
+def _pow2_floor(n: int) -> int:
+    p = 1
+    while p * 2 <= n:
+        p *= 2
+    return p
+
+
+def _cap_tile(tile_b: int, B: int, T: int) -> int:
+    cap = max(8, _pow2_floor(_CLS_BLOCK_BYTES // (4 * T)))
+    return max(1, min(tile_b, B, cap))
 
 
 def _grouped_kernel(cls_ref, char_mask_t_ref, follow_t_ref, out_ref,
@@ -248,7 +268,7 @@ def match_batch_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
       mask (fallback; measured ~NFA-kernel-cost on v5e, see
       BENCH_DEVICE.json)."""
     B = batch.shape[0]
-    TILE_B = min(tile_b, B)
+    TILE_B = _cap_tile(tile_b, B, batch.shape[1] + 3)
     Bp = -(-B // TILE_B) * TILE_B
     if Bp != B:
         batch = jnp.pad(batch, ((0, Bp - B), (0, 0)))
@@ -289,7 +309,7 @@ def match_cls_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
     n_tiles)) — three device scalars fetched with the mask, feeding the
     --stats prefilter line."""
     B = cls.shape[0]
-    TILE_B = min(tile_b, B)
+    TILE_B = _cap_tile(tile_b, B, cls.shape[1])
     Bp = -(-B // TILE_B) * TILE_B
     if Bp != B:
         # Pad rows are all-PAD: no state survives past step 0 except

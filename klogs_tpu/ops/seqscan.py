@@ -198,17 +198,24 @@ def match_lines_scan(dp: DeviceProgram, live: int, acc: int,
         cls3s.append(cls3)
         groups.setdefault(cls3.shape[0], []).append(i)
     out = [bool(dp.match_all)] * len(lines)
+    # Peak memory = vmap-width x one chunk's step matrices; cap the
+    # width so N concurrent jumbo lines can never multiply past the
+    # budget (the batch dim is as real a memory axis as the chunk dim).
+    max_n = max(1, _pow2_floor(
+        step_bytes_budget // (tpc * tile_t * S * S)))
     for idxs in groups.values():
-        rows = [cls3s[i] for i in idxs]
-        # Pad the batch dim with all-PAD pseudo-lines (identity folds,
-        # never match) up to a power of two.
-        pad_n = _pad_pow2(len(rows)) - len(rows)
-        if pad_n:
-            rows.extend([np.full_like(rows[0], dp.pad_class)] * pad_n)
-        stacked = jnp.asarray(np.stack(rows))
-        v = np.asarray(_scan_chunked_batch(dp, stacked, live))
-        for i, hit in zip(idxs, v[:, acc]):
-            out[i] = bool(hit) or dp.match_all
+        for lo in range(0, len(idxs), max_n):
+            sub = idxs[lo : lo + max_n]
+            rows = [cls3s[i] for i in sub]
+            # Pad the batch dim with all-PAD pseudo-lines (identity
+            # folds, never match) up to a power of two.
+            pad_n = _pad_pow2(len(rows)) - len(rows)
+            if pad_n:
+                rows.extend([np.full_like(rows[0], dp.pad_class)] * pad_n)
+            stacked = jnp.asarray(np.stack(rows))
+            v = np.asarray(_scan_chunked_batch(dp, stacked, live))
+            for i, hit in zip(sub, v[:, acc]):
+                out[i] = bool(hit) or dp.match_all
     return out
 
 
