@@ -120,9 +120,10 @@ pack_classify(PyObject *self, PyObject *args)
     const int8_t *tab = (const int8_t *)table.buf;
     int8_t *out = (int8_t *)PyBytes_AS_STRING(buf);
     int32_t *lengths = (int32_t *)PyBytes_AS_STRING(lens);
-    memset(out, (int8_t)pad_c, rows * T);
-    memset(lengths, 0, rows * 4);
-
+    /* No up-front whole-buffer memset: each row writes BEGIN + body +
+     * END and pads only its own tail — for near-full rows (the common
+     * bucket) that is a handful of bytes instead of touching the 30+ MB
+     * buffer twice. */
     for (Py_ssize_t i = 0; i < rows; i++) {
         int8_t *row = out + i * T;
         Py_ssize_t len = 0;
@@ -142,6 +143,7 @@ pack_classify(PyObject *self, PyObject *args)
         }
         row[0] = (int8_t)begin_c;
         row[1 + len] = (int8_t)end_c;
+        memset(row + 2 + len, (int8_t)pad_c, T - 2 - len);
         lengths[i] = (int32_t)len;
     }
     PyBuffer_Release(&table);

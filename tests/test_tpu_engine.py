@@ -180,3 +180,24 @@ def test_binary_lines_and_nul_bytes():
     for kernel in KERNELS:
         f = NFAEngineFilter(pats, kernel=kernel)
         assert f.match_lines(lines) == RegexFilter(pats).match_lines(lines)
+
+
+def test_many_class_fallback_to_device_classify(monkeypatch):
+    """A shared classifier wider than int8 (>127 classes) must fall back
+    to the device-classify path, not overflow the host cls table."""
+    from klogs_tpu.filters.cpu import RegexFilter
+    from klogs_tpu.filters.tpu import NFAEngineFilter
+    from klogs_tpu.ops import nfa as nfa_mod
+
+    real = nfa_mod.compile_grouped
+
+    def wide(pats, **kw):
+        kw["classes_pad"] = 136  # force past the int8 id ceiling
+        return real(pats, **kw)
+
+    monkeypatch.setattr(nfa_mod, "compile_grouped", wide)
+    pats = ["ERROR", "panic:", r"code=\d+"]
+    f = NFAEngineFilter(pats, kernel="interpret")
+    assert f._cls_table is None  # host classification declined
+    lines = [b"ERROR x", b"fine", b"panic: y", b"code=77", b"code=x"] * 10
+    assert f.match_lines(lines) == RegexFilter(pats).match_lines(lines)
