@@ -1,0 +1,108 @@
+"""Remote filter service throughput (the host<->TPU gRPC transport —
+the framework's DCN-boundary analog, SURVEY.md §5 "Distributed
+communication backend").
+
+Spawns filterd in a subprocess (owns the device), then drives it from
+this process with N concurrent Match RPCs over one HTTP/2 channel —
+the collector-side shape (many FilteredSink flushes pipelining through
+RemoteFilterClient). Reports sustained lines/s at several concurrency
+levels and batch sizes; appends SERVICE_BENCH.json at the repo root.
+
+    python tools/bench_service.py --backend cpu   # transport-only
+    python tools/bench_service.py --backend tpu   # server owns the TPU
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+from klogs_tpu.service.client import RemoteFilterClient  # noqa: E402
+
+PORT = 50917
+
+
+async def run_bench(backend: str, seconds: float) -> dict:
+    client = RemoteFilterClient(f"127.0.0.1:{PORT}")
+    # Wait for the server to come up (TPU attach can take ~20-40s).
+    deadline = time.monotonic() + 120
+    while True:
+        try:
+            await client.verify_patterns(bench.PATTERNS)
+            break
+        except Exception:
+            if time.monotonic() > deadline:
+                raise
+            await asyncio.sleep(1.0)
+
+    lines = [ln.rstrip(b"\n") for ln in bench.make_lines(65536)]
+    results = []
+    for batch_lines, conc in ((1024, 4), (8192, 8), (8192, 16)):
+        batches = [lines[i : i + batch_lines]
+                   for i in range(0, len(lines), batch_lines)]
+        await client.match(batches[0])  # warm the server's jit caches
+        done = 0
+        stop_at = time.monotonic() + seconds
+
+        async def worker():
+            nonlocal done
+            k = 0
+            while time.monotonic() < stop_at:
+                await client.match(batches[k % len(batches)])
+                done += batch_lines
+                k += 1
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*[worker() for _ in range(conc)])
+        lps = done / (time.perf_counter() - t0)
+        results.append({"batch_lines": batch_lines, "concurrency": conc,
+                        "lines_per_s": round(lps, 1)})
+        print(f"batch={batch_lines} conc={conc}: {lps:,.0f} lines/s",
+              flush=True)
+    await client.aclose()
+    return {"backend": backend, "runs": results}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--backend", choices=["cpu", "tpu"], default="tpu")
+    ap.add_argument("--seconds", type=float, default=10.0)
+    ns = ap.parse_args()
+
+    argv = [sys.executable, "-m", "klogs_tpu.service",
+            "--port", str(PORT), "--backend", ns.backend]
+    for p in bench.PATTERNS:
+        argv += ["--match", p]
+    env = dict(os.environ)
+    if ns.backend == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+    server = subprocess.Popen(argv, env=env,
+                              stdout=subprocess.DEVNULL,
+                              stderr=subprocess.DEVNULL)
+    try:
+        res = asyncio.run(run_bench(ns.backend, ns.seconds))
+    finally:
+        server.terminate()
+        server.wait()
+    res["date"] = "2026-07-29"
+    res["n_patterns"] = len(bench.PATTERNS)
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "SERVICE_BENCH.json")
+    doc = []
+    if os.path.exists(path):
+        with open(path) as f:
+            doc = json.load(f)
+    doc.append(res)
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"wrote {path}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
