@@ -48,17 +48,45 @@ def _bucket_batch(n: int) -> int:
 def classify_batch(batch: np.ndarray, lengths: np.ndarray, table: np.ndarray,
                    begin_c: int, end_c: int, pad_c: int) -> np.ndarray:
     """Vectorized host classification of an ALREADY-packed [B, L] u8
-    batch into the [B, L+3] sentinel cls layout (see pack_classify).
+    batch into the [B, L+3] sentinel cls layout (see pack_classify) —
+    exactly the first=True/final=True case of the chunk protocol, so
+    the sentinel layout lives in one place (classify_chunk_host).
     Shared by the numpy pack_classify fallback and MeshEngine's
-    batch->cls adapter so the sentinel layout lives in one place."""
-    B, L = batch.shape
+    batch->cls adapter."""
+    return classify_chunk_host(batch, lengths, table, begin_c, end_c, pad_c,
+                               first=True, final=True)
+
+
+def classify_chunk_host(chunk: np.ndarray, rem: np.ndarray, table: np.ndarray,
+                        begin_c: int, end_c: int, pad_c: int,
+                        first: bool, final: bool) -> np.ndarray:
+    """Host mirror of ops.nfa.classify_chunk (+ the final accept-latch
+    column) for the carried-state long-line protocol: [B, L] u8 chunk +
+    remaining-lengths -> [B, T] class ids. Same END-deferral semantics:
+    END is emitted at chunk-local position ``rem`` when it falls inside
+    this chunk's window (the final chunk gets one extra column so END
+    can land at L), positions past END are PAD."""
+    B, L = chunk.shape
+    Lb = L + (1 if final else 0)
+    T = Lb + (1 if first else 0) + (1 if final else 0)
+    off = 1 if first else 0
+    cls = np.empty((B, T), dtype=table.dtype)
+    if first:
+        cls[:, 0] = begin_c
+    if final:
+        cls[:, off + L :] = pad_c  # extra END window col + latch col
+    body = cls[:, off : off + L]
+    # All-i8 operations (a nested where promotes to int64 and triples
+    # the passes — measured 70 MB/s vs GB/s for this form).
     pos = np.arange(L, dtype=np.int32)[None, :]
-    body = np.where(pos < lengths[:, None], table[batch], table.dtype.type(pad_c))
-    cls = np.empty((B, L + 3), dtype=table.dtype)
-    cls[:, 0] = begin_c
-    cls[:, 1 : L + 1] = body
-    cls[:, L + 1 :] = pad_c
-    cls[np.arange(B), lengths + 1] = end_c
+    remc = rem.astype(np.int32)
+    body[:] = table[chunk]
+    body[pos >= remc[:, None]] = pad_c
+    # END lands at chunk-local position rem when inside this chunk's
+    # window (the final chunk's window includes position L).
+    inside = (remc >= 0) & (remc < Lb)
+    rows = np.nonzero(inside)[0]
+    cls[rows, off + remc[rows]] = end_c
     return cls
 
 
@@ -170,6 +198,12 @@ class NFAEngineFilter(LogFilter):
                     self._dp_grouped.byte_class).astype(np.int8)
             else:
                 self._cls_table = None
+            # Same for the augmented union program (long-line chunks).
+            if self._dp_aug.n_classes <= 127:
+                self._aug_cls_table = np.asarray(
+                    self._dp_aug.byte_class).astype(np.int8)
+            else:
+                self._aug_cls_table = None
             # Two-phase filter: a mandatory-pair candidate mask gates
             # which kernel tiles run (ops/pallas_nfa skip-tiles path).
             # Default OFF: the 2026-07-29 device A/B (BENCH_DEVICE.json)
@@ -382,13 +416,26 @@ class NFAEngineFilter(LogFilter):
             v = self._pallas.initial_state_kernel(self._dp_aug, self._live, B)
         else:
             v, matched = self._nfa.initial_state(self._dp, B)
+        host_cls = use_pallas and getattr(self, "_aug_cls_table", None) is not None
         for k in range(n_chunks):
             seg = [b[k * L : (k + 1) * L].ljust(L, b"\0") for b in bodies]
             seg += [b"\0" * L] * pad_rows
             chunk = np.frombuffer(b"".join(seg), dtype=np.uint8).reshape(B, L)
             rem = total - k * L
             first, final = (k == 0), (k == n_chunks - 1)
-            if use_pallas:
+            if host_cls:
+                # Host-side classification, like the full-line hot path
+                # (the device classify gather is ~85% of device time).
+                dpa = self._dp_aug
+                cls = classify_chunk_host(
+                    chunk, rem, self._aug_cls_table,
+                    dpa.begin_class, dpa.end_class, dpa.pad_class,
+                    first=first, final=final)
+                v, matched = self._pallas.match_chunk_cls_pallas(
+                    dpa, self._acc, cls, v, final=final,
+                    interpret=(self._kernel == "interpret"),
+                )
+            elif use_pallas:
                 v, matched = self._pallas.match_chunk_pallas(
                     self._dp_aug, self._acc, chunk, rem, v,
                     first=first, final=final,

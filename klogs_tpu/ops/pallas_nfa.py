@@ -83,7 +83,8 @@ def match_chunk_pallas(dp: DeviceProgram, acc: int,
     sliced off before return), so long-line batches need not be
     tile-aligned."""
     B = chunk.shape[0]
-    TILE_B = _cap_tile(tile_b, B, chunk.shape[1] + 2)
+    TILE_B = _cap_tile(tile_b, B, chunk.shape[1] + 2, dp.n_states,
+                       cls_weight=8, state_weight=8)
     Bp = -(-B // TILE_B) * TILE_B
     if Bp != B:
         chunk = jnp.pad(chunk, ((0, Bp - B), (0, 0)))
@@ -95,7 +96,35 @@ def match_chunk_pallas(dp: DeviceProgram, acc: int,
         cls = jnp.concatenate(
             [cls, jnp.full((Bp, 1), dp.pad_class, dtype=jnp.int32)], axis=1
         )
-    T = cls.shape[1]
+    return _launch_chunk(dp, acc, cls, v0, B, TILE_B, final, interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("acc", "final", "tile_b",
+                                             "interpret"))
+def match_chunk_cls_pallas(dp: DeviceProgram, acc: int,
+                           cls: jax.Array, v0: jax.Array,
+                           final: bool = True,
+                           tile_b: int = DEFAULT_TILE_B,
+                           interpret: bool = False):
+    """Chunk matcher over HOST-classified ids ([B, T] i8/i32 —
+    classify_chunk_host layout, latch column included on final chunks):
+    the long-line analog of match_cls_grouped_pallas, skipping the
+    device-side classify gather (~85% of device time, BENCH_DEVICE.json).
+    Returns (v [B, S] i8, matched [B] bool)."""
+    B = cls.shape[0]
+    TILE_B = _cap_tile(tile_b, B, cls.shape[1], dp.n_states, cls_weight=8, state_weight=8)
+    Bp = -(-B // TILE_B) * TILE_B
+    if Bp != B:
+        cls = jnp.pad(cls, ((0, Bp - B), (0, 0)),
+                      constant_values=dp.pad_class)
+        v0 = jnp.pad(v0, ((0, Bp - B), (0, 0)))
+    return _launch_chunk(dp, acc, cls.astype(jnp.int32), v0, B, TILE_B,
+                         final, interpret)
+
+
+def _launch_chunk(dp, acc, cls, v0, B, TILE_B, final, interpret):
+    """Shared carried-state kernel launch over classified [Bp, T] i32."""
+    Bp, T = cls.shape
     S, C = dp.n_states, dp.n_classes
 
     out, vout = pl.pallas_call(
@@ -134,11 +163,15 @@ DEFAULT_TILE_B_GROUPED = 8192  # tune sweep 2026-07-29 (BENCH_DEVICE.json
 # host_classify_rework.tune_cls): 5.62M lines/s vs 5.48M at 4096 on v5e,
 # batch 131k; smaller batches are capped by min(tile_b, B) anyway.
 
-# The cls block ([T, TILE_B] i32) must fit VMEM alongside tables and the
-# state tile; cap its footprint so wide width-buckets (long lines) shrink
-# the batch tile instead of overflowing VMEM — the non-gated hot path has
-# no fallback, so an overflow would kill the run, not degrade it.
-_CLS_BLOCK_BYTES = 32 << 20
+# The per-grid-cell working set must fit the ~16MB scoped-VMEM limit:
+# cls block [T, TILE_B] i32 plus the state tile (v i8 + reach i32 ≈ 5
+# bytes x S per lane). Cap the tile so wide width-buckets / big-S
+# augmented programs shrink the batch tile instead of overflowing VMEM —
+# the non-gated hot path has no fallback, so an overflow would kill the
+# run, not degrade it. (Budget measured: a 34MB scoped alloc was
+# rejected with "limit 16.00M" on v5e; 12MB leaves room for tables and
+# double-buffering.)
+_VMEM_TILE_BUDGET = 12 << 20
 
 
 def _pow2_floor(n: int) -> int:
@@ -148,8 +181,17 @@ def _pow2_floor(n: int) -> int:
     return p
 
 
-def _cap_tile(tile_b: int, B: int, T: int) -> int:
-    cap = max(8, _pow2_floor(_CLS_BLOCK_BYTES // (4 * T)))
+def _cap_tile(tile_b: int, B: int, T: int, S: int,
+              cls_weight: int = 4, state_weight: int = 5) -> int:
+    """Per-lane byte charges, calibrated against what Mosaic actually
+    accepts/rejects on v5e: the grouped kernel's (cls_weight=4,
+    state_weight=5) admits the 8192-lane T=131 config that is proven on
+    hardware (5.62M lines/s, BENCH_DEVICE.json); the carried-state chunk
+    kernel double-buffers its cls block and carries v0/vout tiles, so it
+    charges (8, 8) — a 17MB scoped alloc was rejected at what 4x
+    accounting predicted to be 8.5MB."""
+    per_lane = cls_weight * T + state_weight * S
+    cap = max(8, _pow2_floor(_VMEM_TILE_BUDGET // per_lane))
     return max(1, min(tile_b, B, cap))
 
 
@@ -268,7 +310,7 @@ def match_batch_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
       mask (fallback; measured ~NFA-kernel-cost on v5e, see
       BENCH_DEVICE.json)."""
     B = batch.shape[0]
-    TILE_B = _cap_tile(tile_b, B, batch.shape[1] + 3)
+    TILE_B = _cap_tile(tile_b, B, batch.shape[1] + 3, dp.n_states)
     Bp = -(-B // TILE_B) * TILE_B
     if Bp != B:
         batch = jnp.pad(batch, ((0, Bp - B), (0, 0)))
@@ -309,7 +351,7 @@ def match_cls_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
     n_tiles)) — three device scalars fetched with the mask, feeding the
     --stats prefilter line."""
     B = cls.shape[0]
-    TILE_B = _cap_tile(tile_b, B, cls.shape[1])
+    TILE_B = _cap_tile(tile_b, B, cls.shape[1], dp.n_states)
     Bp = -(-B // TILE_B) * TILE_B
     if Bp != B:
         # Pad rows are all-PAD: no state survives past step 0 except

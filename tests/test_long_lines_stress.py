@@ -108,3 +108,65 @@ def test_chunk_kernel_non_divisible_batch():
             first=(k == 0), final=(k == n_chunks - 1),
             tile_b=4, interpret=True)
     assert np.asarray(matched).tolist() == RegexFilter(pats).match_lines(bodies)
+
+
+def test_host_chunk_classify_equals_device():
+    """classify_chunk_host must be byte-identical to the device
+    classify_chunk + latch across first/mid/final chunks, including
+    END deferral at rem == L and already-ended (rem < 0) rows."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from klogs_tpu.filters.compiler.glushkov import compile_patterns
+    from klogs_tpu.filters.tpu import classify_chunk_host
+    from klogs_tpu.ops import nfa
+    from klogs_tpu.ops.nfa import classify_chunk
+
+    prog = compile_patterns(["needle", "x$"])
+    dp = nfa.pack_program(nfa.augment(prog), dtype=jnp.int8)
+    table = np.asarray(dp.byte_class).astype(np.int8)
+    L = 16
+    rng = random.Random(21)
+    chunk = np.frombuffer(
+        bytes(rng.choice(b"nedlx qz") for _ in range(6 * L)),
+        dtype=np.uint8).reshape(6, L)
+    # rem covers: already ended, ends mid-chunk, ends at L (deferral),
+    # continues past, exactly 0 (END at position 0), and negative big.
+    rem = np.array([-5, 7, L, L + 9, 0, -1], dtype=np.int32)
+    for first in (True, False):
+        for final in (True, False):
+            host = classify_chunk_host(chunk, rem, table,
+                                       dp.begin_class, dp.end_class,
+                                       dp.pad_class, first=first, final=final)
+            dev = np.asarray(classify_chunk(dp, chunk, rem,
+                                            first=first, final=final))
+            if final:  # host includes the accept-latch column
+                assert (host[:, -1] == dp.pad_class).all()
+                host_cmp = host[:, :-1]
+            else:
+                host_cmp = host
+            assert (host_cmp.astype(np.int32) == dev).all(), (first, final)
+
+
+def test_long_lines_host_cls_path_vs_oracle():
+    """NFAEngineFilter long-line path now runs host-classified chunks;
+    verdicts must match the regex oracle across many chunk boundaries."""
+    from klogs_tpu.filters.cpu import RegexFilter
+    from klogs_tpu.filters.tpu import NFAEngineFilter
+
+    pats = ["needle[0-9]x", "END$"]
+    f = NFAEngineFilter(pats, chunk_bytes=256, kernel="interpret")
+    assert f._aug_cls_table is not None
+    rng = random.Random(13)
+    lines = []
+    for i in range(7):
+        n = rng.randrange(300, 2500)
+        b = bytes(rng.choice(b"abc defg") for _ in range(n))
+        if i % 2:
+            cut = rng.randrange(0, n)
+            b = b[:cut] + b"needle7x" + b[cut:]
+        if i % 3 == 0:
+            b += b"END"
+        lines.append(b)
+    assert f.match_lines(lines) == RegexFilter(pats).match_lines(lines)
