@@ -70,6 +70,14 @@ def classify_chunk_host(chunk: np.ndarray, rem: np.ndarray, table: np.ndarray,
     Lb = L + (1 if final else 0)
     T = Lb + (1 if first else 0) + (1 if final else 0)
     off = 1 if first else 0
+    from klogs_tpu.native import hostops
+
+    if (hostops is not None and hasattr(hostops, "classify_chunk")
+            and table.dtype == np.int8 and chunk.flags.c_contiguous):
+        buf = hostops.classify_chunk(
+            chunk, B, L, rem.astype(np.int32).tobytes(), table.tobytes(),
+            begin_c, end_c, pad_c, int(first), int(final))
+        return np.frombuffer(buf, dtype=np.int8).reshape(B, T)
     cls = np.empty((B, T), dtype=table.dtype)
     if first:
         cls[:, 0] = begin_c

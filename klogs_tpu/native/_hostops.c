@@ -150,6 +150,64 @@ pack_classify(PyObject *self, PyObject *args)
     return Py_BuildValue("(NN)", buf, lens);
 }
 
+/* classify_chunk(data[B*L] bytes, B, L, rem int32[B] bytes, table[256]
+ * bytes, begin, end, pad, first, final)
+ *   -> bytes holding int8[B, T], the carried-state chunk layout of
+ * klogs_tpu.filters.tpu.classify_chunk_host (BEGIN column when first;
+ * END at chunk-local position rem when it falls inside this chunk's
+ * window — the final chunk gets an extra column so END can land at L —
+ * plus the accept-latch PAD column when final). One C pass instead of
+ * several numpy passes over multi-MB chunk batches. */
+static PyObject *
+classify_chunk_c(PyObject *self, PyObject *args)
+{
+    Py_buffer data, rembuf, table;
+    Py_ssize_t B, L;
+    int begin_c, end_c, pad_c, first, final;
+    if (!PyArg_ParseTuple(args, "y*nny*y*iiiii", &data, &B, &L, &rembuf,
+                          &table, &begin_c, &end_c, &pad_c, &first, &final))
+        return NULL;
+    if (B < 0 || L <= 0 || data.len < B * L || rembuf.len < B * 4
+        || table.len < 256) {
+        PyBuffer_Release(&data);
+        PyBuffer_Release(&rembuf);
+        PyBuffer_Release(&table);
+        PyErr_SetString(PyExc_ValueError, "classify_chunk: bad buffer sizes");
+        return NULL;
+    }
+    const Py_ssize_t off = first ? 1 : 0;
+    const Py_ssize_t Lb = L + (final ? 1 : 0);
+    const Py_ssize_t T = off + Lb + (final ? 1 : 0);
+    PyObject *buf = PyBytes_FromStringAndSize(NULL, B * T);
+    if (!buf) {
+        PyBuffer_Release(&data);
+        PyBuffer_Release(&rembuf);
+        PyBuffer_Release(&table);
+        return NULL;
+    }
+    const uint8_t *src0 = (const uint8_t *)data.buf;
+    const int32_t *remv = (const int32_t *)rembuf.buf;
+    const int8_t *tab = (const int8_t *)table.buf;
+    int8_t *out = (int8_t *)PyBytes_AS_STRING(buf);
+    for (Py_ssize_t i = 0; i < B; i++) {
+        int8_t *row = out + i * T;
+        const uint8_t *src = src0 + i * L;
+        int32_t rem = remv[i];
+        Py_ssize_t n = rem < 0 ? 0 : (rem > L ? L : (Py_ssize_t)rem);
+        if (first)
+            row[0] = (int8_t)begin_c;
+        for (Py_ssize_t j = 0; j < n; j++)
+            row[off + j] = tab[src[j]];
+        memset(row + off + n, (int8_t)pad_c, T - off - n);
+        if (rem >= 0 && rem < Lb)
+            row[off + rem] = (int8_t)end_c;
+    }
+    PyBuffer_Release(&data);
+    PyBuffer_Release(&rembuf);
+    PyBuffer_Release(&table);
+    return buf;
+}
+
 static PyObject *
 join_kept(PyObject *self, PyObject *args)
 {
@@ -201,6 +259,9 @@ static PyMethodDef Methods[] = {
     {"pack_classify", pack_classify, METH_VARARGS,
      "pack_classify(lines, width, rows, table, begin, end, pad)"
      " -> (int8-cls-bytes, int32-lengths-bytes)"},
+    {"classify_chunk", classify_chunk_c, METH_VARARGS,
+     "classify_chunk(data, B, L, rem, table, begin, end, pad, first,"
+     " final) -> int8-cls-bytes"},
     {"join_kept", join_kept, METH_VARARGS,
      "join_kept(lines, mask) -> bytes of mask-selected lines"},
     {NULL, NULL, 0, NULL},

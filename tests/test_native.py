@@ -100,3 +100,40 @@ def test_pack_classify_matches_device_classify():
         [dev, jnp.full((batch.shape[0], 1), dp.pad_class, dtype=jnp.int32)],
         axis=1))
     assert (cls_host.astype(np.int32) == dev).all()
+
+
+def test_classify_chunk_c_matches_python(monkeypatch):
+    """C classify_chunk must be byte-identical to the numpy fallback
+    across first/final combinations and all rem cases."""
+    require_native()
+    import random as _random
+
+    import jax.numpy as jnp
+
+    from klogs_tpu.filters import tpu as ftpu
+    from klogs_tpu.filters.compiler.glushkov import compile_patterns
+    from klogs_tpu.ops import nfa
+
+    prog = compile_patterns(["needle", "x$"])
+    dp = nfa.pack_program(nfa.augment(prog), dtype=jnp.int8)
+    table = np.asarray(dp.byte_class).astype(np.int8)
+    rng = _random.Random(4)
+    L = 24
+    chunk = np.frombuffer(
+        bytes(rng.choice(b"nedlx qz") for _ in range(7 * L)),
+        dtype=np.uint8).reshape(7, L)
+    rem = np.array([-3, 0, 5, L, L + 2, 11, -1], dtype=np.int32)
+    for first in (True, False):
+        for final in (True, False):
+            got = ftpu.classify_chunk_host(chunk, rem, table,
+                                           dp.begin_class, dp.end_class,
+                                           dp.pad_class, first=first,
+                                           final=final)
+            monkeypatch.setattr("klogs_tpu.native.hostops", None)
+            exp = ftpu.classify_chunk_host(chunk, rem, table,
+                                           dp.begin_class, dp.end_class,
+                                           dp.pad_class, first=first,
+                                           final=final)
+            monkeypatch.undo()
+            assert got.dtype == exp.dtype == np.int8
+            assert (got == exp).all(), (first, final)
