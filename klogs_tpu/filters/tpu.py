@@ -73,7 +73,8 @@ def classify_chunk_host(chunk: np.ndarray, rem: np.ndarray, table: np.ndarray,
     from klogs_tpu.native import hostops
 
     if (hostops is not None and hasattr(hostops, "classify_chunk")
-            and table.dtype == np.int8 and chunk.flags.c_contiguous):
+            and table.dtype == np.int8 and chunk.dtype == np.uint8
+            and chunk.flags.c_contiguous):
         buf = hostops.classify_chunk(
             chunk, B, L, rem.astype(np.int32).tobytes(), table.tobytes(),
             begin_c, end_c, pad_c, int(first), int(final))

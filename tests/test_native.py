@@ -106,6 +106,9 @@ def test_classify_chunk_c_matches_python(monkeypatch):
     """C classify_chunk must be byte-identical to the numpy fallback
     across first/final combinations and all rem cases."""
     require_native()
+    # Guard against vacuous comparison: the fast path must exist, or
+    # both sides below would silently run the same numpy fallback.
+    assert hasattr(native.hostops, "classify_chunk")
     import random as _random
 
     import jax.numpy as jnp
