@@ -182,14 +182,16 @@ def _pow2_floor(n: int) -> int:
 
 
 def _cap_tile(tile_b: int, B: int, T: int, S: int,
-              cls_weight: int = 4, state_weight: int = 5) -> int:
+              cls_weight: int = 8, state_weight: int = 3) -> int:
     """Per-lane byte charges, calibrated against what Mosaic actually
-    accepts/rejects on v5e: the grouped kernel's (cls_weight=4,
-    state_weight=5) admits the 8192-lane T=131 config that is proven on
-    hardware (5.62M lines/s, BENCH_DEVICE.json); the carried-state chunk
-    kernel double-buffers its cls block and carries v0/vout tiles, so it
-    charges (8, 8) — a 17MB scoped alloc was rejected at what 4x
-    accounting predicted to be 8.5MB."""
+    accepts/rejects on v5e. Both kernels double-buffer the [T, TILE] i32
+    cls block (observed: 16.29M scoped alloc at T=515/TILE=4096, i.e.
+    2 x 4 x T x TILE), hence cls_weight=8. The grouped kernel's state
+    charge of 3 admits the 8192-lane T=131 config proven on hardware
+    (5.62M lines/s, BENCH_DEVICE.json); the carried-state chunk kernel
+    additionally carries v0/vout tiles, so it charges state_weight=8 —
+    a 17MB scoped alloc was rejected at what lighter accounting
+    predicted to fit."""
     per_lane = cls_weight * T + state_weight * S
     cap = max(8, _pow2_floor(_VMEM_TILE_BUDGET // per_lane))
     return max(1, min(tile_b, B, cap))
