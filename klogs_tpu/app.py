@@ -197,7 +197,8 @@ def make_pipeline_for(opts: Options):
     from klogs_tpu.filters.sink import make_pipeline
 
     try:
-        return make_pipeline(opts.match, opts.backend, remote=opts.remote)
+        return make_pipeline(opts.match, opts.backend, remote=opts.remote,
+                             ignore_case=opts.ignore_case)
     except _re.error as e:
         term.fatal("invalid --match pattern %r: %s", e.pattern, e)
     except ImportError as e:

@@ -19,6 +19,7 @@ semantics:
 New (north-star) flags, absent from the reference:
 
   --match           repeatable regex; only matching lines are written
+  -I/--ignore-case  case-insensitive --match patterns
   --backend         filter engine: cpu (host regex) | tpu (batch NFA)
   --remote          gate writes via a klogs-filterd service (gRPC)
   --profile         write a JAX profiler trace of the run to DIR
@@ -49,6 +50,7 @@ class Options:
     init_containers: bool = False
     # North-star extensions
     match: list[str] = field(default_factory=list)
+    ignore_case: bool = False
     backend: str = "cpu"
     remote: str | None = None
     stats: bool = False
@@ -131,6 +133,13 @@ def build_parser() -> argparse.ArgumentParser:
         "is kept if ANY pattern matches)",
     )
     p.add_argument(
+        "-I",
+        "--ignore-case",
+        action="store_true",
+        dest="ignore_case",
+        help="Case-insensitive --match patterns (all engines)",
+    )
+    p.add_argument(
         "--backend",
         choices=["cpu", "tpu"],
         default="cpu",
@@ -178,6 +187,7 @@ def parse_args(argv: list[str] | None = None) -> Options:
         print_version=ns.print_version,
         init_containers=ns.init_containers,
         match=list(ns.match),
+        ignore_case=ns.ignore_case,
         backend=ns.backend,
         remote=ns.remote,
         stats=ns.stats,

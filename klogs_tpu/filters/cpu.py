@@ -12,10 +12,11 @@ from klogs_tpu.filters.base import LogFilter
 
 
 class RegexFilter(LogFilter):
-    def __init__(self, patterns: list[str]):
+    def __init__(self, patterns: list[str], ignore_case: bool = False):
         if not patterns:
             raise ValueError("RegexFilter needs at least one pattern")
-        self._compiled = [re.compile(p.encode()) for p in patterns]
+        flags = re.IGNORECASE if ignore_case else 0
+        self._compiled = [re.compile(p.encode(), flags) for p in patterns]
 
     def match_lines(self, lines: list[bytes]) -> list[bool]:
         compiled = self._compiled

@@ -141,6 +141,7 @@ class FilterPipeline:
     deadline_s: float = 0.05
     service: "AsyncFilterService | None" = None
     patterns: list[str] | None = None
+    ignore_case: bool = False
     _live_sinks: "set[FilteredSink]" = dataclasses_field(default_factory=set)
 
     def sink_factory(self, job: StreamJob) -> Sink:
@@ -176,7 +177,7 @@ class FilterPipeline:
         set against the server's before any line flows."""
         verify = getattr(self.service, "verify_patterns", None)
         if verify is not None and self.patterns is not None:
-            await verify(self.patterns)
+            await verify(self.patterns, self.ignore_case)
 
     async def aclose(self) -> None:
         """Awaited teardown (run_async calls this): services that hold
@@ -230,7 +231,8 @@ class FilterPipeline:
 def make_pipeline(patterns: list[str], backend: str,
                   batch_lines: int | None = None,
                   deadline_s: float = 0.05,
-                  remote: str | None = None) -> FilterPipeline:
+                  remote: str | None = None,
+                  ignore_case: bool = False) -> FilterPipeline:
     stats = FilterStats()
     service = None
     if remote is not None:
@@ -243,11 +245,12 @@ def make_pipeline(patterns: list[str], backend: str,
             deadline_s=deadline_s,
             service=RemoteFilterClient(remote),
             patterns=patterns,
+            ignore_case=ignore_case,
         )
     if backend == "cpu":
         from klogs_tpu.filters.cpu import RegexFilter
 
-        log_filter: LogFilter = RegexFilter(patterns)
+        log_filter: LogFilter = RegexFilter(patterns, ignore_case=ignore_case)
         batch_lines = batch_lines or 1024
     elif backend == "tpu":
         import jax
@@ -264,8 +267,9 @@ def make_pipeline(patterns: list[str], backend: str,
             # Real chips: per-shard Pallas kernel; virtual/CPU meshes:
             # GSPMD over the jnp path (kernel needs Mosaic or interpret).
             impl = "pallas" if jax.default_backend() != "cpu" else "gspmd"
-            engine = MeshEngine(patterns, impl=impl)
-        log_filter = NFAEngineFilter(patterns, engine=engine, stats=stats)
+            engine = MeshEngine(patterns, ignore_case=ignore_case, impl=impl)
+        log_filter = NFAEngineFilter(patterns, ignore_case=ignore_case,
+                                     engine=engine, stats=stats)
         # Device batches are cheap per line but each round trip has fixed
         # latency: bigger batches + the async pipeline hide it.
         batch_lines = batch_lines or 8192

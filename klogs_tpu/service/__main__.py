@@ -15,11 +15,17 @@ def main() -> None:
     ap.add_argument("--match", action="append", required=True,
                     help="regex pattern (repeatable)")
     ap.add_argument("--backend", choices=["cpu", "tpu"], default="tpu")
+    ap.add_argument("-I", "--ignore-case", action="store_true",
+                    dest="ignore_case",
+                    help="case-insensitive patterns (collectors must "
+                    "connect with matching -I or the pattern handshake "
+                    "rejects them)")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=50051)
     ns = ap.parse_args()
     try:
-        asyncio.run(serve(ns.match, ns.backend, ns.host, ns.port))
+        asyncio.run(serve(ns.match, ns.backend, ns.host, ns.port,
+                          ignore_case=ns.ignore_case))
     except KeyboardInterrupt:
         pass
 

@@ -29,14 +29,21 @@ class RemoteFilterClient:
     async def hello(self) -> dict:
         return transport.unpack(await self._hello_rpc(b""))
 
-    async def verify_patterns(self, patterns: list[str]) -> None:
+    async def verify_patterns(self, patterns: list[str],
+                              ignore_case: bool = False) -> None:
         """Fail fast if the server filters with a different pattern set
-        than this collector was invoked with."""
+        (or case mode) than this collector was invoked with."""
         info = await self.hello()
         if list(info.get("patterns", [])) != list(patterns):
             raise PatternMismatch(
                 f"filter service at {self._target} serves patterns "
                 f"{info.get('patterns')!r}, collector wants {patterns!r}"
+            )
+        if bool(info.get("ignore_case", False)) != bool(ignore_case):
+            raise PatternMismatch(
+                f"filter service at {self._target} has ignore_case="
+                f"{info.get('ignore_case', False)!r}, collector wants "
+                f"{bool(ignore_case)!r}"
             )
 
     async def match(self, lines: list[bytes]) -> list[bool]:

@@ -17,29 +17,34 @@ from klogs_tpu.service import transport
 from klogs_tpu.version import BUILD_VERSION
 
 
-def _make_filter(patterns: list[str], backend: str):
+def _make_filter(patterns: list[str], backend: str,
+                 ignore_case: bool = False):
     if backend == "cpu":
         from klogs_tpu.filters.cpu import RegexFilter
 
-        return RegexFilter(patterns)
+        return RegexFilter(patterns, ignore_case=ignore_case)
     from klogs_tpu.filters.tpu import NFAEngineFilter
 
-    return NFAEngineFilter(patterns)
+    return NFAEngineFilter(patterns, ignore_case=ignore_case)
 
 
 class FilterServer:
     def __init__(self, patterns: list[str], backend: str = "tpu",
-                 host: str = "127.0.0.1", port: int = 50051):
+                 host: str = "127.0.0.1", port: int = 50051,
+                 ignore_case: bool = False):
         self.patterns = list(patterns)
         self.backend = backend
         self.host = host
         self.port = port
-        self._service = AsyncFilterService(_make_filter(patterns, backend))
+        self.ignore_case = ignore_case
+        self._service = AsyncFilterService(
+            _make_filter(patterns, backend, ignore_case=ignore_case))
         self._server: grpc.aio.Server | None = None
 
     async def _hello(self, request: bytes, context) -> bytes:
         return transport.pack({
             "patterns": self.patterns,
+            "ignore_case": self.ignore_case,
             "backend": self.backend,
             "version": BUILD_VERSION,
         })
@@ -80,8 +85,10 @@ class FilterServer:
         self._service.close()
 
 
-async def serve(patterns: list[str], backend: str, host: str, port: int) -> None:
-    server = FilterServer(patterns, backend, host, port)
+async def serve(patterns: list[str], backend: str, host: str, port: int,
+                ignore_case: bool = False) -> None:
+    server = FilterServer(patterns, backend, host=host, port=port,
+                       ignore_case=ignore_case)
     bound = await server.start()
     print(f"klogs filterd: serving {len(patterns)} pattern(s) "
           f"[{backend}] on {host}:{bound}", flush=True)

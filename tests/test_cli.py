@@ -52,3 +52,11 @@ class TestVersion:
         assert main(["-v"]) == 0
         out = capsys.readouterr().out
         assert "Version: development" in out
+
+
+def test_ignore_case_flag():
+    from klogs_tpu.cli import parse_args
+
+    opts = parse_args(["-a", "--match", "error", "-I"])
+    assert opts.ignore_case
+    assert not parse_args(["-a"]).ignore_case

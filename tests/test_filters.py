@@ -195,3 +195,19 @@ def test_stats_queue_vs_device_split():
     assert s.has_service_latencies
     assert abs(s.percentile_queue_s(50) - 0.002) < 1e-9
     assert abs(s.percentile_device_s(99) - 0.05) < 1e-9
+
+
+def test_ignore_case_both_engines():
+    """-I semantics: RegexFilter and NFAEngineFilter agree on
+    case-insensitive matching (and differ from case-sensitive)."""
+    from klogs_tpu.filters.cpu import RegexFilter
+    from klogs_tpu.filters.tpu import NFAEngineFilter
+
+    pats = ["error", "Panic: [0-9]+"]
+    lines = [b"ERROR here", b"error too", b"panic: 7", b"PANIC: 9", b"fine"]
+    ci_cpu = RegexFilter(pats, ignore_case=True).match_lines(lines)
+    ci_tpu = NFAEngineFilter(pats, ignore_case=True,
+                             kernel="interpret").match_lines(lines)
+    assert ci_cpu == ci_tpu == [True, True, True, True, False]
+    cs = RegexFilter(pats).match_lines(lines)
+    assert cs == [False, True, False, False, False]

@@ -154,3 +154,20 @@ def test_clean_shutdown_no_destroyed_tasks(recwarn):
             loop.close()
     msgs = [str(w.message) for w in caught]
     assert not any("Task was destroyed" in m for m in msgs), msgs
+
+
+def test_verify_rejects_case_mode_mismatch():
+    async def run():
+        server = FilterServer(PATTERNS, backend="cpu", port=0,
+                              ignore_case=True)
+        port = await server.start()
+        client = RemoteFilterClient(f"127.0.0.1:{port}")
+        try:
+            with pytest.raises(PatternMismatch):
+                await client.verify_patterns(PATTERNS, ignore_case=False)
+            await client.verify_patterns(PATTERNS, ignore_case=True)
+        finally:
+            await client.aclose()
+            await server.stop()
+
+    asyncio.run(run())
