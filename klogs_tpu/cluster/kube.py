@@ -68,6 +68,9 @@ class KubeBackend(ClusterBackend):
             connector=aiohttp.TCPConnector(
                 limit=BURST, ssl=creds.ssl_context
             ),
+            # client-go honors HTTP(S)_PROXY/NO_PROXY; trust_env is
+            # aiohttp's equivalent (also reads ~/.netrc, harmless here).
+            trust_env=True,
         )
 
     async def _auth_headers(self, force_refresh: bool = False) -> dict:
