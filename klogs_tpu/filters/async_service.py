@@ -30,14 +30,19 @@ dispatch has ms-scale fixed cost.
 """
 
 import asyncio
+import os
 import time
 from concurrent.futures import ThreadPoolExecutor
 
 from klogs_tpu.filters.base import FilterStats, LogFilter
 
-DEFAULT_MAX_IN_FLIGHT = 16
-DEFAULT_FETCH_WORKERS = 4
-DEFAULT_COALESCE_LINES = 8192
+# Each in-flight fetch blocks one worker thread for a full host<->device
+# round trip, so sustained batches/s caps at workers / RTT. On a remote
+# attach (~74ms RTT) that cap binds well before the engine does; both
+# knobs are env-tunable for such deployments.
+DEFAULT_MAX_IN_FLIGHT = int(os.environ.get("KLOGS_MAX_IN_FLIGHT", "16"))
+DEFAULT_FETCH_WORKERS = int(os.environ.get("KLOGS_FETCH_WORKERS", "8"))
+DEFAULT_COALESCE_LINES = int(os.environ.get("KLOGS_COALESCE_LINES", "8192"))
 DEFAULT_COALESCE_DELAY_S = 0.005
 
 

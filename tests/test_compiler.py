@@ -216,3 +216,35 @@ def test_property_vs_re_oracle():
             )
             tested += 1
     assert tested > 1000, f"only {tested} property checks ran — generator too lossy"
+
+
+def test_property_ignore_case_vs_re():
+    """Random patterns/lines: ignore_case engine semantics must equal
+    re.IGNORECASE across the jnp engine (now user-facing via -I)."""
+    import re as _re
+
+    import numpy as np
+
+    from klogs_tpu.filters.tpu import pack_lines
+    from klogs_tpu.ops import nfa
+
+    rng = random.Random(31)
+    tested = 0
+    for _ in range(25):
+        pats = [_rand_pattern(rng) for _ in range(rng.randrange(1, 4))]
+        try:
+            compiled = [_re.compile(p.encode(), _re.IGNORECASE) for p in pats]
+            prog = compile_patterns(pats, ignore_case=True)
+        except Exception:  # unsupported random pattern: skip
+            continue
+        dp = nfa.pack_program(prog)
+        lines = [_rand_line(rng) for _ in range(12)]
+        # Mix in case-flipped variants so the flag actually matters.
+        lines += [ln.swapcase() if hasattr(ln, "swapcase") else ln
+                  for ln in lines[:6]]
+        batch, lengths = pack_lines(lines, 16)
+        got = np.asarray(nfa.match_batch(dp, batch, lengths))[: len(lines)]
+        exp = [any(c.search(ln) for c in compiled) for ln in lines]
+        assert got.tolist() == exp, pats
+        tested += 1
+    assert tested >= 8
