@@ -196,11 +196,18 @@ def make_pipeline_for(opts: Options):
 
     from klogs_tpu.filters.sink import make_pipeline
 
+    from klogs_tpu.filters.compiler.parser import RegexSyntaxError
+
     try:
         return make_pipeline(opts.match, opts.backend, remote=opts.remote,
                              ignore_case=opts.ignore_case)
     except _re.error as e:
         term.fatal("invalid --match pattern %r: %s", e.pattern, e)
+    except RegexSyntaxError as e:
+        # NFA-compiler rejections (unsupported constructs like
+        # possessive quantifiers or backrefs) get the same friendly
+        # exit as re syntax errors, not a traceback.
+        term.fatal("unsupported --match pattern: %s", e)
     except ImportError as e:
         term.fatal("--backend %s is unavailable: %s", opts.backend, e)
 

@@ -40,9 +40,28 @@ from klogs_tpu.filters.base import FilterStats, LogFilter
 # round trip, so sustained batches/s caps at workers / RTT. On a remote
 # attach (~74ms RTT) that cap binds well before the engine does; both
 # knobs are env-tunable for such deployments.
-DEFAULT_MAX_IN_FLIGHT = int(os.environ.get("KLOGS_MAX_IN_FLIGHT", "16"))
-DEFAULT_FETCH_WORKERS = int(os.environ.get("KLOGS_FETCH_WORKERS", "8"))
-DEFAULT_COALESCE_LINES = int(os.environ.get("KLOGS_COALESCE_LINES", "8192"))
+def _env_int(name: str, default: int) -> int:
+    """Positive-int env knob; malformed values warn and fall back
+    rather than crashing module import with a bare ValueError."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        val = 0
+    if val < 1:
+        import sys
+
+        print(f"klogs: ignoring invalid {name}={raw!r} (want a positive "
+              f"integer); using {default}", file=sys.stderr)
+        return default
+    return val
+
+
+DEFAULT_MAX_IN_FLIGHT = _env_int("KLOGS_MAX_IN_FLIGHT", 16)
+DEFAULT_FETCH_WORKERS = _env_int("KLOGS_FETCH_WORKERS", 8)
+DEFAULT_COALESCE_LINES = _env_int("KLOGS_COALESCE_LINES", 8192)
 DEFAULT_COALESCE_DELAY_S = 0.005
 
 

@@ -157,15 +157,19 @@ class _Parser:
 
     def _repeat(self) -> object:
         node = self._atom()
+        seen_quant = False
         while True:
             c = self._peek()
             if c == 0x2A:  # '*'
+                self._reject_bad_repeat(node, seen_quant)
                 self.pos += 1
                 node = Star(node)
             elif c == 0x2B:  # '+'
+                self._reject_bad_repeat(node, seen_quant)
                 node = Cat((node, Star(node)))
                 self.pos += 1
             elif c == 0x3F:  # '?'
+                self._reject_bad_repeat(node, seen_quant)
                 self.pos += 1
                 node = Alt((node, Epsilon()))
             elif c == 0x7B:  # '{'
@@ -174,14 +178,38 @@ class _Parser:
                 if rep is None:
                     self.pos = saved
                     break
+                self._reject_bad_repeat(node, seen_quant)
                 lo, hi = rep
                 node = self._expand_counted(node, lo, hi)
             else:
                 break
-            # Lazy quantifier suffix: same language, ignore.
+            seen_quant = True
+            # Lazy quantifier suffix ('+?' '*?' '??' '{m,n}?'): lazy vs
+            # greedy picks WHICH match, not WHETHER one exists, so for
+            # any-match semantics the language is identical — consume it.
             if self._peek() == 0x3F:
                 self.pos += 1
         return node
+
+    def _reject_bad_repeat(self, node: object, seen_quant: bool) -> None:
+        """A quantifier directly following a quantifier is either re's
+        POSSESSIVE form ('a++', 'a{2,3}+' — atomic, no backtracking,
+        can reject strings the NFA language accepts, so an NFA cannot
+        express it) or re's 'multiple repeat' error ('a**', 'a+*').
+        Reject both, like RE2 — silently parsing 'X{2,3}+' as
+        '(X{2,3})+' produced WRONG verdicts (found by fuzzing).
+        A quantified bare anchor ('^*', '$+') is re's 'nothing to
+        repeat' error and is rejected for the same parity reason."""
+        if seen_quant:
+            raise RegexSyntaxError(
+                f"stacked or possessive quantifier at position {self.pos}"
+                " is not supported (possessive/atomic matching cannot be"
+                " expressed by an NFA; group with (?:...) if you meant"
+                " nested repetition)")
+        if isinstance(node, Sym) and node.sentinel is not None:
+            raise RegexSyntaxError(
+                f"nothing to repeat at position {self.pos} (quantifier"
+                " applied to an anchor)")
 
     def _try_counted(self) -> tuple[int, int | None] | None:
         """Parse {m} {m,} {m,n} after the '{'; None if not a counted

@@ -2,7 +2,9 @@
 
 import argparse
 import asyncio
+import sys
 
+from klogs_tpu.filters.compiler.parser import RegexSyntaxError
 from klogs_tpu.service.server import serve
 
 
@@ -28,6 +30,9 @@ def main() -> None:
                           ignore_case=ns.ignore_case))
     except KeyboardInterrupt:
         pass
+    except RegexSyntaxError as e:
+        print(f"unsupported --match pattern: {e}", file=sys.stderr)
+        raise SystemExit(1)
 
 
 if __name__ == "__main__":

@@ -168,3 +168,29 @@ class TestFollowMode:
             with open(os.path.join(out_dir, f), "rb") as fh:
                 assert len(fh.read().splitlines()) > 5  # live lines landed
         assert "Logs saved to" in capsys.readouterr().out
+
+
+def test_unsupported_match_pattern_is_fatal_not_traceback():
+    """A pattern the NFA compiler rejects (possessive quantifier) must
+    exit via the friendly fatal path, like a bad re pattern."""
+    from klogs_tpu import app
+    from klogs_tpu.cli import parse_args
+    from klogs_tpu.ui.term import FatalError
+
+    opts = parse_args(["-a", "--match", "a++", "--backend", "tpu"])
+    with pytest.raises(FatalError):  # SystemExit(1), message printed
+        app.make_pipeline_for(opts)
+
+
+def test_unsupported_match_pattern_message(capsys):
+    """The fatal must come from the RegexSyntaxError branch (the
+    'unsupported' wording), not some other handler."""
+    from klogs_tpu import app
+    from klogs_tpu.cli import parse_args
+    from klogs_tpu.ui.term import FatalError
+
+    opts = parse_args(["-a", "--match", "a{2,3}+", "--backend", "tpu"])
+    with pytest.raises(FatalError):
+        app.make_pipeline_for(opts)
+    cap = capsys.readouterr()
+    assert "unsupported --match pattern" in (cap.out + cap.err).lower()

@@ -248,3 +248,41 @@ def test_property_ignore_case_vs_re():
         assert got.tolist() == exp, pats
         tested += 1
     assert tested >= 8
+
+
+def test_possessive_and_stacked_quantifiers_rejected():
+    """re's possessive forms (atomic, no backtracking) cannot be
+    expressed by an NFA — silently parsing 'X{2,3}+' as '(X{2,3})+'
+    produced wrong verdicts (found by fuzzing). Reject like RE2."""
+    from klogs_tpu.filters.compiler.parser import RegexSyntaxError, parse
+
+    for pat in ("a++", "a*+", "a?+", "a{2,3}+", "(?:x+){2,2}+",
+                "a**", "a+*", "a{2}{3}", "^*", "$+", "^{2}"):
+        with pytest.raises(RegexSyntaxError):
+            parse(pat)
+
+
+def test_lazy_quantifiers_still_accepted():
+    """Lazy forms pick WHICH match, not WHETHER — same language, so
+    they stay supported and agree with re on existence."""
+    import re as _re
+
+    pats = ["a+?b", "x*?y", "c??d", "q{2,4}?z"]
+    lines = [b"aab", b"b", b"xy", b"y", b"cd", b"d", b"qqz", b"qz"]
+    for p in pats:
+        prog = compile_patterns([p])
+        for ln in lines:
+            assert reference_match(prog, ln) == bool(
+                _re.search(p.encode(), ln)), (p, ln)
+
+
+def test_grouped_nested_repetition_still_works():
+    """(?:...){m,n} with inner quantifiers stays legal when grouped."""
+    import re as _re
+
+    p = "(?:ab+){2,3}"
+    prog = compile_patterns([p])
+    lines = [b"abab", b"ab", b"abbbabb", b"ababab", b"xx"]
+    for ln in lines:
+        assert reference_match(prog, ln) == bool(
+            _re.search(p.encode(), ln)), ln
