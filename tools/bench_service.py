@@ -90,7 +90,9 @@ def main() -> None:
     finally:
         server.terminate()
         server.wait()
-    res["date"] = "2026-07-29"
+    from datetime import date
+
+    res["date"] = date.today().isoformat()
     res["n_patterns"] = len(bench.PATTERNS)
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "SERVICE_BENCH.json")
