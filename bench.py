@@ -17,12 +17,18 @@ transfers overlap compute. `detail.e2e_lps` is the fully synchronous
 path (pack + ship + match + fetch per batch) on the same attach;
 `detail.cpu_lps` is the host-regex baseline on the same lines.
 
-Sizes are env-tunable for smoke runs: KLOGS_BENCH_LINES (300000),
-KLOGS_BENCH_CPU_LINES (30000), KLOGS_BENCH_REPEATS (3); the device batch
-(KLOGS_BENCH_DEVICE_BATCH, 262144) and pipeline depth
-(KLOGS_BENCH_N_FLIGHT, 64) are sized so per-dispatch tunnel overhead
-(~10-16 ms/call even async) amortizes — smaller operating points measure
-the attach, not the engine (BASELINE.md caveats).
+Sizes are env-tunable for smoke runs: KLOGS_BENCH_LINES (default 300000
+for the host-side CPU baseline; the device subprocess defaults it to the
+device batch so the advertised operating point is actually measured —
+set it only to shrink smoke runs), KLOGS_BENCH_CPU_LINES (30000),
+KLOGS_BENCH_REPEATS (3); the device batch
+(KLOGS_BENCH_DEVICE_BATCH, 1048576) and pipeline depth
+(KLOGS_BENCH_N_FLIGHT, 64) sit at the measured knee of the 2026-07-30
+operating-point sweep (OPERATING_POINT.json, tools/bench_operating_point
+.py): per-dispatch overhead is ~3.4 ms even async, and the batch x depth
+curve flattens at ~8.6M lines/s — 98% of the sweep's fitted engine-only
+ceiling (~8.7M). Smaller operating points measure the attach, not the
+engine (BASELINE.md caveats).
 """
 
 import json
@@ -75,6 +81,26 @@ def cpu_lps(lines, repeats: int) -> float:
         t0 = time.perf_counter()
         filt.match_lines(lines)
         best = max(best, len(lines) / (time.perf_counter() - t0))
+    return best
+
+
+def measure_pipelined(run, n_rows: int, n_flight: int, repeats: int) -> float:
+    """Best-of-`repeats` sustained rate of `run()` with `n_flight`
+    dispatches in flight: block on the last output only, fetch ONE
+    representative mask (fetching all would serialize n_flight tunnel
+    round-trips and measure the attach, not the engine — module
+    docstring). Shared by bench.py's headline and
+    tools/bench_operating_point.py so their numbers stay comparable."""
+    import numpy as np
+
+    best = 0.0
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        outs = [run() for _ in range(n_flight)]
+        outs[-1].block_until_ready()
+        np.asarray(outs[-1])
+        dt = time.perf_counter() - t0
+        best = max(best, n_flight * n_rows / dt)
     return best
 
 
@@ -147,17 +173,8 @@ def device_lps(lines, repeats: int):
         run = lambda: nfa.match_batch(dpu, db, dl)
 
     np.asarray(run())  # warmup / compile
-    pipelined = 0.0
     n_flight = int(os.environ.get("KLOGS_BENCH_N_FLIGHT", "64"))
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        outs = [run() for _ in range(n_flight)]
-        outs[-1].block_until_ready()
-        np.asarray(outs[-1])  # one representative mask fetch (128 KB);
-        # fetching all would serialize n_flight tunnel round-trips and
-        # measure the attach, not the engine (see module docstring).
-        dt = time.perf_counter() - t0
-        pipelined = max(pipelined, n_flight * n_rows / dt)
+    pipelined = measure_pipelined(run, n_rows, n_flight, repeats)
 
     filt = NFAEngineFilter(PATTERNS)
     filt.match_lines(lines[:4096])  # warm the jit caches
@@ -185,8 +202,8 @@ def _device_subprocess(timeout_s: float):
         "import jax; jax.devices();"
         "print('ATTACHED', flush=True);"
         "import bench;"
-        "n=int(os.environ.get('KLOGS_BENCH_LINES','300000'));"
-        "b=int(os.environ.get('KLOGS_BENCH_DEVICE_BATCH','262144'));"
+        "b=int(os.environ.get('KLOGS_BENCH_DEVICE_BATCH','1048576'));"
+        "n=int(os.environ.get('KLOGS_BENCH_LINES','0')) or b;"
         "r=int(os.environ.get('KLOGS_BENCH_REPEATS','3'));"
         "lines=bench.make_lines(min(n,b));"
         "print('RESULT:'+json.dumps(bench.device_lps(lines,r)))"
