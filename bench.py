@@ -22,13 +22,16 @@ for the host-side CPU baseline; the device subprocess defaults it to the
 device batch so the advertised operating point is actually measured —
 set it only to shrink smoke runs), KLOGS_BENCH_CPU_LINES (30000),
 KLOGS_BENCH_REPEATS (3); the device batch
-(KLOGS_BENCH_DEVICE_BATCH, 1048576) and pipeline depth
-(KLOGS_BENCH_N_FLIGHT, 64) sit at the measured knee of the 2026-07-30
+(KLOGS_BENCH_DEVICE_BATCH, 1048576; on a CPU-only host 2048, where the
+jnp path is a tiny smoke and the reported value is the host-regex
+production path — see main()) and pipeline depth
+(KLOGS_BENCH_N_FLIGHT, 64 on TPU / 2 on CPU) sit at the measured knee of the 2026-07-30
 operating-point sweep (OPERATING_POINT.json, tools/bench_operating_point
-.py): per-dispatch overhead is ~3.4 ms even async, and the batch x depth
-curve flattens at ~8.6M lines/s — 98% of the sweep's fitted engine-only
-ceiling (~8.7M). Smaller operating points measure the attach, not the
-engine (BASELINE.md caveats).
+.py): the fixed per-measurement sync cost (~151 ms; per-dispatch is only
+~61 us) amortizes until the batch x depth curve flattens at ~8.6M
+lines/s — 98% of the sweep's fitted engine-only ceiling (~8.74M).
+Smaller operating points measure the sync, not the engine (BASELINE.md
+caveats).
 """
 
 import json
@@ -173,7 +176,11 @@ def device_lps(lines, repeats: int):
         run = lambda: nfa.match_batch(dpu, db, dl)
 
     np.asarray(run())  # warmup / compile
-    n_flight = int(os.environ.get("KLOGS_BENCH_N_FLIGHT", "64"))
+    # A CPU-only host runs the single-core jnp scan path: a deep pipeline
+    # just multiplies wall time without amortizing anything (no async
+    # device, no tunnel), so keep it shallow there.
+    n_flight = int(os.environ.get("KLOGS_BENCH_N_FLIGHT",
+                                  "2" if not use_kernel else "64"))
     pipelined = measure_pipelined(run, n_rows, n_flight, repeats)
 
     filt = NFAEngineFilter(PATTERNS)
@@ -199,14 +206,30 @@ def _device_subprocess(timeout_s: float):
 
     code = (
         "import json, os, sys;"
-        "import jax; jax.devices();"
+        "import jax;"
+        # An explicit CPU request must win even against an eagerly
+        # registered TPU PJRT plugin (axon's sitecustomize monkeypatches
+        # get_backend, so the env var alone still attaches — and hangs
+        # when the tunnel is wedged); the config knob wins.
+        "os.environ.get('JAX_PLATFORMS')=='cpu' and "
+        "jax.config.update('jax_platforms','cpu');"
+        "jax.devices();"
         "print('ATTACHED', flush=True);"
         "import bench;"
-        "b=int(os.environ.get('KLOGS_BENCH_DEVICE_BATCH','1048576'));"
+        "cpu=jax.default_backend()=='cpu';"
+        # A CPU-only host has no production device path (the CLI's
+        # --backend=cpu IS the host-regex engine there); the union-NFA
+        # jnp path is quadratic in states (~1.4k lines/s single-core),
+        # so run it tiny — enough to prove the path executes — and let
+        # main() report the host-regex number as the honest value.
+        "b=int(os.environ.get('KLOGS_BENCH_DEVICE_BATCH',"
+        "'2048' if cpu else '1048576'));"
         "n=int(os.environ.get('KLOGS_BENCH_LINES','0')) or b;"
-        "r=int(os.environ.get('KLOGS_BENCH_REPEATS','3'));"
+        "r=int(os.environ.get('KLOGS_BENCH_REPEATS','1' if cpu else '3'));"
         "lines=bench.make_lines(min(n,b));"
-        "print('RESULT:'+json.dumps(bench.device_lps(lines,r)))"
+        "res=bench.device_lps(lines,r);"
+        "res['backend']=jax.default_backend();"
+        "print('RESULT:'+json.dumps(res))"
     )
     import selectors
     import tempfile
@@ -288,7 +311,24 @@ def main() -> None:
     cpu = cpu_lps(lines[:n_cpu], repeats)
     dev = _device_subprocess(timeout_s)
 
-    if dev is not None:
+    if dev is not None and dev.get("backend") == "cpu":
+        # No TPU on this host: the production --backend=cpu path IS the
+        # host regex engine; the tiny jnp run only proves the device
+        # code path executes. Report the honest production number.
+        print(json.dumps({
+            "metric": "log-lines/sec filtered, 32 patterns x 256-pod batch (batch-NFA)",
+            "value": round(cpu, 1),
+            "unit": "lines/sec",
+            "vs_baseline": 1.0,
+            "detail": {
+                "cpu_regex_lps": round(cpu, 1),
+                "no_tpu_on_host": True,
+                "jnp_smoke_lps": round(dev["pipelined"], 1),
+                "n_patterns": len(PATTERNS),
+                "line_width_bytes": 128,
+            },
+        }))
+    elif dev is not None:
         pipelined, e2e = dev["pipelined"], dev["e2e"]
         print(json.dumps({
             "metric": "log-lines/sec filtered, 32 patterns x 256-pod batch (batch-NFA)",
