@@ -25,7 +25,8 @@ hostops = None
 def _build() -> bool:
     include = sysconfig.get_paths()["include"]
     cc = os.environ.get("CC", "cc")
-    cmd = [cc, "-O3", "-shared", "-fPIC", f"-I{include}", _SRC, "-o", _SO]
+    cmd = [cc, "-O3", "-shared", "-fPIC", "-pthread", f"-I{include}",
+           _SRC, "-o", _SO]
     try:
         res = subprocess.run(cmd, capture_output=True, timeout=120)
         return res.returncode == 0

@@ -9,7 +9,8 @@
  * (/root/reference/cmd/root.go:359-374). This is the equivalent
  * native layer for the batched-filter design.
  *
- * Exposed functions (all GIL-holding, no numpy C-API dependency —
+ * Exposed functions (GIL-holding except pack_classify's optional
+ * KLOGS_HOST_THREADS row-parallel phase; no numpy C-API dependency —
  * callers wrap the returned buffers with np.frombuffer):
  *
  *   pack_lines(lines: list[bytes], width: int, rows: int)
@@ -75,6 +76,68 @@ classify_span(int8_t *dst, const uint8_t *src, Py_ssize_t len,
     }
     if (j < len)
         dst[j] = tab[src[j]];
+}
+
+/* Optional row-parallel execution of the pack_classify body.
+ *
+ * KLOGS_HOST_THREADS=N (N>1) splits the row loop across N pthreads with
+ * the GIL RELEASED — the per-row work below is pure C over buffers whose
+ * line pointers/lengths were snapshotted under the GIL (PyBytes are
+ * immutable, and the caller's list holds the references alive for the
+ * duration of the call). On the single-core bench host this cannot be
+ * measured (nproc=1); it exists for production TPU hosts, where dozens
+ * of cores feed one device and the single-threaded packer (9.4M
+ * lines/s here) would otherwise be the sustained-rate bound against a
+ * faster-than-tunnel device link. Default (unset / 1) takes the
+ * original GIL-holding single-pass path, byte-for-byte identical
+ * output (covered by tests/test_native.py parity over both settings).
+ */
+#include <pthread.h>
+
+typedef struct {
+    const char **ptrs;          /* [rows] line pointers (NULL past n) */
+    const Py_ssize_t *lens;     /* [rows] clamped line lengths */
+    int8_t *out;
+    int32_t *lengths;
+    Py_ssize_t T;
+    const int8_t *tab;
+    const uint16_t *ptab;
+    int begin_c, end_c, pad_c;
+    Py_ssize_t lo, hi;          /* row range for this worker */
+} pack_job;
+
+static void
+pack_rows(const pack_job *job)
+{
+    const Py_ssize_t T = job->T;
+    for (Py_ssize_t i = job->lo; i < job->hi; i++) {
+        int8_t *row = job->out + i * T;
+        Py_ssize_t len = job->lens[i];
+        if (len > 0)
+            classify_span(row + 1, (const uint8_t *)job->ptrs[i], len,
+                          job->tab, job->ptab);
+        row[0] = (int8_t)job->begin_c;
+        row[1 + len] = (int8_t)job->end_c;
+        memset(row + 2 + len, (int8_t)job->pad_c, T - 2 - len);
+        job->lengths[i] = (int32_t)len;
+    }
+}
+
+static void *
+pack_worker(void *arg)
+{
+    pack_rows((const pack_job *)arg);
+    return NULL;
+}
+
+static int
+host_threads(void)
+{
+    const char *s = getenv("KLOGS_HOST_THREADS");
+    if (!s)
+        return 1;
+    int n = atoi(s);
+    return n < 1 ? 1 : (n > 64 ? 64 : n);
 }
 
 static PyObject *
@@ -170,31 +233,136 @@ pack_classify(PyObject *self, PyObject *args)
     const uint16_t *ptab = get_pair_tab(tab);
     int8_t *out = (int8_t *)PyBytes_AS_STRING(buf);
     int32_t *lengths = (int32_t *)PyBytes_AS_STRING(lens);
-    /* No up-front whole-buffer memset: each row writes BEGIN + body +
-     * END and pads only its own tail — for near-full rows (the common
+
+    /* Snapshot line pointers/lengths under the GIL; the row loop then
+     * runs GIL-free (pack_rows), split across threads when asked. No
+     * up-front whole-buffer memset: each row writes BEGIN + body + END
+     * and pads only its own tail — for near-full rows (the common
      * bucket) that is a handful of bytes instead of touching the 30+ MB
      * buffer twice. */
+    const char **ptrs = PyMem_Malloc(rows * sizeof(char *));
+    Py_ssize_t *lenv = PyMem_Malloc(rows * sizeof(Py_ssize_t));
+    if (!ptrs || !lenv) {
+        PyMem_Free(ptrs);
+        PyMem_Free(lenv);
+        PyBuffer_Release(&table);
+        Py_DECREF(buf);
+        Py_DECREF(lens);
+        return PyErr_NoMemory();
+    }
+
+    /* Threaded only when asked AND the call-local table snapshots could
+     * be allocated: with the GIL released, another Python thread may
+     * call in with a different classifier and rebuild the static
+     * pair-LUT cache mid-read, so workers must never read the shared
+     * tables. If the snapshots can't be had, stay single-threaded
+     * under the GIL — never trade correctness for parallelism. */
+    int nthreads = host_threads();
+    int threaded = nthreads > 1 && rows >= 4096;
+    int8_t *tab_copy = NULL;
+    uint16_t *ptab_copy = NULL;
+    if (threaded) {
+        tab_copy = PyMem_Malloc(256);
+        ptab_copy = PyMem_Malloc(65536 * sizeof(uint16_t));
+        if (tab_copy && ptab_copy) {
+            memcpy(tab_copy, tab, 256);
+            memcpy(ptab_copy, ptab, 65536 * sizeof(uint16_t));
+        } else {
+            PyMem_Free(tab_copy);
+            PyMem_Free(ptab_copy);
+            tab_copy = NULL;
+            ptab_copy = NULL;
+            threaded = 0;
+        }
+    }
+
+    /* Snapshot pointers/lengths; when threading, also own a reference
+     * to each item — with the GIL released the caller's list can be
+     * mutated by other Python threads, and a borrowed pointer into a
+     * freed bytes object would be read-after-free. The owned objects
+     * are recorded in their own array (NOT re-read from the list at
+     * cleanup: by then the list may hold different objects). */
+    PyObject **objs = NULL;
+    if (threaded) {
+        objs = PyMem_Malloc(n * sizeof(PyObject *));
+        if (!objs) {
+            PyMem_Free(tab_copy);
+            PyMem_Free(ptab_copy);
+            tab_copy = NULL;
+            ptab_copy = NULL;
+            threaded = 0;
+        }
+    }
+    Py_ssize_t held = 0;
     for (Py_ssize_t i = 0; i < rows; i++) {
-        int8_t *row = out + i * T;
-        Py_ssize_t len = 0;
+        ptrs[i] = NULL;
+        lenv[i] = 0;
         if (i < n) {
             PyObject *item = PyList_GET_ITEM(list, i);
             char *p;
+            Py_ssize_t len;
             if (PyBytes_AsStringAndSize(item, &p, &len) < 0) {
+                for (Py_ssize_t k = 0; k < held; k++)
+                    Py_DECREF(objs[k]);
+                PyMem_Free(objs);
+                PyMem_Free(ptrs);
+                PyMem_Free(lenv);
+                PyMem_Free(tab_copy);
+                PyMem_Free(ptab_copy);
                 PyBuffer_Release(&table);
                 Py_DECREF(buf);
                 Py_DECREF(lens);
                 return NULL;
             }
-            if (len > width)
-                len = width;
-            classify_span(row + 1, (const uint8_t *)p, len, tab, ptab);
+            if (threaded) {
+                Py_INCREF(item);
+                objs[held++] = item;
+            }
+            ptrs[i] = p;
+            lenv[i] = len > width ? width : len;
         }
-        row[0] = (int8_t)begin_c;
-        row[1 + len] = (int8_t)end_c;
-        memset(row + 2 + len, (int8_t)pad_c, T - 2 - len);
-        lengths[i] = (int32_t)len;
     }
+
+    pack_job job = {ptrs, lenv, out, lengths, T, tab, ptab,
+                    begin_c, end_c, pad_c, 0, rows};
+    if (!threaded) {
+        pack_rows(&job);
+    } else {
+        job.tab = tab_copy;
+        job.ptab = ptab_copy;
+        pthread_t tids[64];
+        pack_job jobs[64];
+        Py_ssize_t per = (rows + nthreads - 1) / nthreads;
+        int started = 0;
+        Py_BEGIN_ALLOW_THREADS
+        for (int t = 0; t < nthreads; t++) {
+            jobs[t] = job;
+            jobs[t].lo = t * per;
+            jobs[t].hi = (t + 1) * per < rows ? (t + 1) * per : rows;
+            if (jobs[t].lo >= jobs[t].hi)
+                break;
+            if (t == nthreads - 1 || jobs[t].hi == rows) {
+                pack_rows(&jobs[t]);  /* run the last slice inline */
+                break;
+            }
+            if (pthread_create(&tids[started], NULL, pack_worker,
+                               &jobs[t]) != 0) {
+                pack_rows(&jobs[t]);  /* spawn failed: do it here */
+                continue;
+            }
+            started++;
+        }
+        for (int t = 0; t < started; t++)
+            pthread_join(tids[t], NULL);
+        Py_END_ALLOW_THREADS
+        for (Py_ssize_t k = 0; k < held; k++)
+            Py_DECREF(objs[k]);
+        PyMem_Free(tab_copy);
+        PyMem_Free(ptab_copy);
+    }
+    PyMem_Free(objs);
+    PyMem_Free(ptrs);
+    PyMem_Free(lenv);
     PyBuffer_Release(&table);
     return Py_BuildValue("(NN)", buf, lens);
 }

@@ -140,3 +140,29 @@ def test_classify_chunk_c_matches_python(monkeypatch):
             monkeypatch.undo()
             assert got.dtype == exp.dtype == np.int8
             assert (got == exp).all(), (first, final)
+
+
+def test_pack_classify_threaded_parity(monkeypatch):
+    """KLOGS_HOST_THREADS>1 splits the row loop across pthreads with the
+    GIL released; output must be byte-identical to the single-threaded
+    pass (and hence to the numpy fallback). Rows > 4096 to actually take
+    the threaded path; odd lengths + empty lines + truncation covered."""
+    require_native()
+    import random as _random
+
+    from klogs_tpu.filters import tpu as ftpu
+    from klogs_tpu.ops import nfa
+
+    dp, live, acc = nfa.compile_grouped(["err.r", r"x[0-9]{2,4}y", "^z+$"])
+    table = np.asarray(dp.byte_class).astype(np.int8)
+    rng = _random.Random(7)
+    lines = [bytes(rng.choice(b"erxz0159y ")
+                   for _ in range(rng.choice((0, 1, 7, 31, 32, 40))))
+             for _ in range(5000)]
+    single = ftpu.pack_classify(lines, 32, table, dp.begin_class,
+                                dp.end_class, dp.pad_class)
+    monkeypatch.setenv("KLOGS_HOST_THREADS", "3")
+    threaded = ftpu.pack_classify(lines, 32, table, dp.begin_class,
+                                  dp.end_class, dp.pad_class)
+    assert threaded.shape == single.shape
+    assert (threaded == single).all()
