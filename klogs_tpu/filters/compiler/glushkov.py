@@ -120,6 +120,39 @@ class _Builder:
         raise TypeError(f"unknown AST node {node!r}")
 
 
+def _reject_divergent_anchor_pairs(b: "_Builder", n0: int, pat: str) -> None:
+    """Reject patterns where anchor-as-symbol semantics diverge from
+    re's anchor-as-assertion semantics (fuzz find, 2026-07-30).
+
+    The engine feeds ONE virtual BEGIN and ONE END sentinel per line, so
+    an anchor symbol can be consumed once. re treats anchors as
+    idempotent zero-width assertions: ``^^`` matches at position 0,
+    ``$$`` at the end, ``$^`` on an empty string — all unmatchable here.
+    The divergent cases are exactly an anchor position reachable
+    immediately (or across nullable-only content, which Glushkov follow
+    already short-circuits) after another anchor position, except
+    BEGIN→END (``^$``: the sentinel stream really does provide BEGIN
+    then END, so it matches the empty line in both semantics). Adjacent
+    same-anchor pairs could be merged soundly, but ``$^`` cannot, and a
+    loud reject keeps the oracle contract simple: every ACCEPTED pattern
+    behaves exactly like re. (Cf. the possessive-quantifier and \\b
+    rejections — RE2-style subset, documented in the parser.)"""
+    for i in range(n0, len(b.symbols)):
+        si = b.symbols[i]
+        if si is not BEGIN and si is not END:
+            continue
+        for j in b.follow[i]:
+            sj = b.symbols[j]
+            if sj is BEGIN or (si is END and sj is END):
+                raise RegexSyntaxError(
+                    f"consecutive anchors ({'^' if si is BEGIN else '$'}"
+                    f"...{'^' if sj is BEGIN else '$'} with only optional "
+                    f"content between) in {pat!r} are not supported: the "
+                    "engine consumes one BEGIN/END sentinel per line, so "
+                    "re's idempotent-assertion semantics cannot be honored"
+                )
+
+
 def compile_patterns(patterns: list[str], ignore_case: bool = False) -> NFAProgram:
     """Compile K patterns into one union automaton (any-match
     semantics, ≙ RegexFilter's any(p.search(line)))."""
@@ -130,10 +163,12 @@ def compile_patterns(patterns: list[str], ignore_case: bool = False) -> NFAProgr
     accept: set[int] = set()
     match_all = False
     for pat in patterns:
+        n0 = len(b.symbols)
         nullable, first, last = b.visit(parse(pat, ignore_case=ignore_case))
         match_all |= nullable
         inject.update(first)
         accept.update(last)
+        _reject_divergent_anchor_pairs(b, n0, pat)
 
     n = len(b.symbols)
     if n == 0:

@@ -5,7 +5,12 @@ into a small AST over *byte sets* and *sentinel symbols*. Anchors are
 not assertions here: ``^`` and ``$`` parse to ordinary symbols matching
 virtual BEGIN/END sentinels that the engine feeds around each line, so
 Glushkov construction needs no special cases and patterns like ``a^b``
-(never matches) or ``^a*$`` fall out correct by construction.
+(never matches) or ``^a*$`` fall out correct by construction. The one
+place symbol semantics would diverge from re's idempotent assertions —
+an anchor directly (or across nullable-only content) after another
+anchor, e.g. ``^^``, ``$$``, ``$^``, ``^a?^`` — is rejected at compile
+time (glushkov._reject_divergent_anchor_pairs), keeping the contract
+that every accepted pattern behaves exactly like re.
 
 Supported syntax: literals, ``.``, escapes (\\d \\D \\w \\W \\s \\S
 \\t \\n \\r \\f \\v \\0 \\xHH and escaped punctuation), character

@@ -286,3 +286,21 @@ def test_grouped_nested_repetition_still_works():
     for ln in lines:
         assert reference_match(prog, ln) == bool(
             _re.search(p.encode(), ln)), ln
+
+
+def test_divergent_anchor_pairs_rejected():
+    """Anchors are consumed sentinel symbols here but idempotent
+    assertions in re: '^^' matches at position 0 for re and never for
+    the engine (fuzz find, 2026-07-30). Patterns where an anchor is
+    follow-reachable from another anchor (except '^$', which the
+    sentinel stream really provides) are rejected loudly so every
+    ACCEPTED pattern behaves exactly like re."""
+    for pat in ("^^", "$$", "$^", "^a?^", "^a*^", "$(?:|x)$",
+                "(?:^|a)^", "a?$b?$", "^(?:a|)(?:|b)^"):
+        with pytest.raises(RegexSyntaxError):
+            compile_patterns([pat])
+    # The sentinel stream provides BEGIN then END once each: these stay.
+    for pat, line, want in (("^$", b"", True), ("^$", b"x", False),
+                            ("^a?$", b"a", True), ("a^b", b"ab", False),
+                            ("^a|b$", b"zb", True)):
+        assert reference_match(compile_patterns([pat]), line) == want

@@ -1,0 +1,166 @@
+"""Long-run randomized fuzz of the pattern compiler vs the `re` oracle.
+
+Deeper and wider than tests/test_compiler.py's property tests (which run
+in seconds on every pytest invocation): richer alphabet, deeper nesting,
+mid-pattern anchors, {m,n} up to 6, ignore-case trials, and — on a
+subsample (engine checks pay a jit compile per pattern set) — the full
+grouped interpret-kernel path through pack_classify, i.e. exactly the
+production TPU hot path run hermetically on CPU.
+
+Every divergence found historically became a unit test in
+tests/test_compiler.py (e.g. the possessive-quantifier reject, commit
+d491db4); run this after compiler changes and before releases.
+
+Usage: python tools/fuzz_compiler.py [--trials N] [--seed S] [--engine-every K]
+Exit 1 on any divergence, with a repro line printed.
+"""
+
+import argparse
+import os
+import random
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")  # hermetic; beats eager TPU plugins
+
+from klogs_tpu.filters.compiler import (  # noqa: E402
+    RegexSyntaxError,
+    compile_patterns,
+    reference_match,
+)
+
+ALPHABET = b"ab01 .-XY\t/=:\xc3\x28"
+CLASS_BODIES = ["ab", "a-c", "0-9a", "^ab", "^0-9", "b-", "]a", "a-zA-Z",
+                "^\\d", "\\w-", ".*+", "^^", "0-9-"]
+ESCAPES = [r"\d", r"\D", r"\w", r"\W", r"\s", r"\S", r"\.", r"\-", r"\t",
+           r"\x41", r"\x00", r"\(", r"\)", r"\[", r"\|", r"\{", r"\+"]
+
+
+def rand_pattern(rng: random.Random, depth: int = 0) -> str:
+    choices = ["lit", "lit", "lit", "class", "dot", "escape", "anchor"]
+    if depth < 4:
+        choices += ["cat", "cat", "cat", "alt", "alt", "star", "plus",
+                    "opt", "count", "group", "lazy"]
+    kind = rng.choice(choices)
+    if kind == "lit":
+        return re.escape(chr(rng.choice(b"ab01 XY/=:")))
+    if kind == "dot":
+        return "."
+    if kind == "anchor":
+        return rng.choice(["^", "$"])
+    if kind == "escape":
+        return rng.choice(ESCAPES)
+    if kind == "class":
+        return f"[{rng.choice(CLASS_BODIES)}]"
+    if kind == "cat":
+        return rand_pattern(rng, depth + 1) + rand_pattern(rng, depth + 1)
+    if kind == "alt":
+        return f"(?:{rand_pattern(rng, depth + 1)}|{rand_pattern(rng, depth + 1)})"
+    if kind == "group":
+        return f"({rand_pattern(rng, depth + 1)})"
+    inner = rand_pattern(rng, depth + 1)
+    if not inner or inner[-1] in "*+?}":
+        inner = f"(?:{inner})"
+    if kind == "star":
+        return inner + "*"
+    if kind == "plus":
+        return inner + "+"
+    if kind == "opt":
+        return inner + "?"
+    if kind == "lazy":
+        return inner + rng.choice(["*?", "+?", "??"])
+    lo = rng.randrange(0, 4)
+    hi = rng.randrange(lo, lo + 3)
+    return rng.choice([f"{inner}{{{lo},{hi}}}", f"{inner}{{{lo},}}",
+                       f"{inner}{{{max(lo,1)}}}"])
+
+
+def rand_line(rng: random.Random) -> bytes:
+    n = rng.randrange(0, 24)
+    return bytes(rng.choice(ALPHABET) for _ in range(n))
+
+
+def oracle(patterns, line: bytes, flags: int = 0) -> bool:
+    return any(re.search(p.encode("utf-8"), line, flags) for p in patterns)
+
+
+def engine_check(pats, lines, ignore_case):
+    """Full production path hermetically: pack_classify -> grouped
+    interpret kernel. Returns the verdict list."""
+    from klogs_tpu.filters.tpu import NFAEngineFilter
+
+    filt = NFAEngineFilter(pats, ignore_case=ignore_case, kernel="interpret")
+    return filt.match_lines(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=20000)
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--engine-every", type=int, default=200,
+                    help="run the interpret-kernel path on every Kth trial")
+    args = ap.parse_args()
+    seed = args.seed if args.seed is not None else int(time.time())
+    rng = random.Random(seed)
+    print(f"fuzz: seed={seed} trials={args.trials}", flush=True)
+
+    t0 = time.time()
+    checked = skipped = engine_runs = 0
+    for trial in range(args.trials):
+        k = rng.randrange(1, 5)
+        pats = [rand_pattern(rng) for _ in range(k)]
+        ignore_case = rng.random() < 0.25
+        flags = re.IGNORECASE if ignore_case else 0
+        try:
+            for p in pats:
+                re.compile(p.encode("utf-8"), flags)
+        except re.error:
+            skipped += 1
+            continue  # not valid re either: nothing to compare
+        try:
+            prog = compile_patterns(pats, ignore_case=ignore_case)
+        except RegexSyntaxError:
+            skipped += 1  # outside the supported subset (rejected loudly)
+            continue
+        lines = [rand_line(rng) for _ in range(12)] + [b""]
+        for line in lines:
+            expect = oracle(pats, line, flags)
+            got = reference_match(prog, line)
+            if got != expect:
+                print(f"DIVERGENCE (reference_match): seed={seed} "
+                      f"trial={trial} patterns={pats!r} ignore_case="
+                      f"{ignore_case} line={line!r} nfa={got} re={expect}",
+                      flush=True)
+                return 1
+            checked += 1
+        if args.engine_every and trial % args.engine_every == 0:
+            verdicts = engine_check(pats, lines, ignore_case)
+            expects = [oracle(pats, ln, flags) for ln in lines]
+            if verdicts != expects:
+                bad = next(i for i in range(len(lines))
+                           if verdicts[i] != expects[i])
+                print(f"DIVERGENCE (interpret kernel): seed={seed} "
+                      f"trial={trial} patterns={pats!r} ignore_case="
+                      f"{ignore_case} line={lines[bad]!r} "
+                      f"kernel={verdicts[bad]} re={expects[bad]}", flush=True)
+                return 1
+            engine_runs += 1
+        if trial and trial % 2000 == 0:
+            print(f"  {trial} trials, {checked} line-checks, "
+                  f"{engine_runs} engine sets, {skipped} skipped, "
+                  f"{time.time()-t0:.0f}s", flush=True)
+
+    print(f"fuzz OK: {checked} line-checks across {args.trials} trials "
+          f"({skipped} outside subset/invalid), {engine_runs} interpret-"
+          f"kernel pattern sets, {time.time()-t0:.0f}s, seed={seed}",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
