@@ -37,9 +37,14 @@ from klogs_tpu.filters.compiler.parser import (
     RegexSyntaxError,
     Star,
     Sym,
+    max_positions_cap,
     parse,
 )
 
+# Union-automaton position cap; the same KLOGS_MAX_PATTERN_POSITIONS
+# knob overrides it (in both directions) so raising or tightening one
+# cap never leaves the other silently binding. Read via
+# parser.max_positions_cap once per _Builder.
 MAX_UNION_POSITIONS = 4096
 
 
@@ -71,11 +76,14 @@ class _Builder:
     def __init__(self) -> None:
         self.symbols: list[object] = []  # per position: frozenset | BEGIN | END
         self.follow: list[set[int]] = []
+        self.max_union = max_positions_cap()  # read once per build
 
     def new_pos(self, symbol: object) -> int:
-        if len(self.symbols) >= MAX_UNION_POSITIONS:
+        if len(self.symbols) >= self.max_union:
             raise RegexSyntaxError(
-                f"pattern set too large: more than {MAX_UNION_POSITIONS} total positions"
+                f"pattern set too large: more than "
+                f"{self.max_union} total positions "
+                "(KLOGS_MAX_PATTERN_POSITIONS overrides the cap)"
             )
         self.symbols.append(symbol)
         self.follow.append(set())

@@ -72,8 +72,29 @@ _ALL_BYTES = frozenset(range(256))
 _DOT = _ALL_BYTES - {0x0A}  # '.' excludes \n (re default, no DOTALL)
 
 # Hard cap on AST leaf count after {m,n} expansion; the automaton state
-# count equals the leaf count, and VMEM sizing assumes it stays modest.
+# count equals the leaf count, and transition tables are quadratic in it
+# (an unchecked quantifier nest would compile gigabyte tables). RE2
+# analog: "program size too large". KLOGS_MAX_PATTERN_POSITIONS
+# overrides it in BOTH directions — raise for legitimately huge
+# patterns, lower to tighten VMEM bounds — and applies uniformly to the
+# per-pattern cap here and the union-automaton cap in glushkov.py.
 MAX_POSITIONS = 4096
+
+
+def max_positions_cap() -> int:
+    """Effective position cap (env override or MAX_POSITIONS). Read
+    once per parse/build — not per leaf — by the callers."""
+    import os
+
+    s = os.environ.get("KLOGS_MAX_PATTERN_POSITIONS")
+    if s is None:
+        return MAX_POSITIONS
+    try:
+        return max(1, int(s))
+    except ValueError:
+        raise RegexSyntaxError(
+            f"KLOGS_MAX_PATTERN_POSITIONS must be an integer, got {s!r}"
+        ) from None
 
 
 def _casefold(s: frozenset) -> frozenset:
@@ -97,6 +118,7 @@ class _Parser:
         self.pos = 0
         self.ignore_case = ignore_case
         self.n_leaves = 0
+        self.max_positions = max_positions_cap()  # read once per parse
 
     # -- low-level cursor ------------------------------------------------
     def _peek(self) -> int | None:
@@ -118,9 +140,10 @@ class _Parser:
 
     def _leaf(self, **kw) -> Sym:
         self.n_leaves += 1
-        if self.n_leaves > MAX_POSITIONS:
+        if self.n_leaves > self.max_positions:
             raise RegexSyntaxError(
-                f"pattern too large: more than {MAX_POSITIONS} positions"
+                f"pattern too large: more than {self.max_positions} "
+                "positions (KLOGS_MAX_PATTERN_POSITIONS overrides the cap)"
             )
         return Sym(**kw)
 
@@ -247,9 +270,11 @@ class _Parser:
         n_inner = _count_leaves(node)
         total = n_inner * (hi if hi is not None else lo + 1)
         self.n_leaves += total - n_inner  # node's own leaves already counted
-        if self.n_leaves > MAX_POSITIONS:
+        if self.n_leaves > self.max_positions:
             raise RegexSyntaxError(
-                f"pattern too large: counted repeat expands past {MAX_POSITIONS} positions"
+                f"pattern too large: counted repeat expands past "
+                f"{self.max_positions} positions "
+                "(KLOGS_MAX_PATTERN_POSITIONS overrides the cap)"
             )
         parts: list = [node] * lo
         if hi is None:

@@ -304,3 +304,21 @@ def test_divergent_anchor_pairs_rejected():
                             ("^a?$", b"a", True), ("a^b", b"ab", False),
                             ("^a|b$", b"zb", True)):
         assert reference_match(compile_patterns([pat]), line) == want
+
+
+def test_pattern_position_cap(monkeypatch):
+    """RE2-parity program-size cap (parser.MAX_POSITIONS): counted
+    repeats expand multiplicatively at parse time and tables are
+    quadratic in positions, so a runaway pattern must reject loudly,
+    not compile gigabyte tables. KLOGS_MAX_PATTERN_POSITIONS raises the
+    cap for legitimately huge patterns."""
+    monkeypatch.delenv("KLOGS_MAX_PATTERN_POSITIONS", raising=False)
+    big = "(?:(?:a{40}){40}){4}"  # 40*40*4 = 6400 positions > 4096
+    with pytest.raises(RegexSyntaxError, match="positions"):
+        compile_patterns([big])
+    monkeypatch.setenv("KLOGS_MAX_PATTERN_POSITIONS", "8000")
+    assert compile_patterns([big]).n_states >= 6400  # raised cap: compiles
+    monkeypatch.delenv("KLOGS_MAX_PATTERN_POSITIONS")
+    compile_patterns(["a{40}"] * 100)  # 4000 total: under the union cap
+    with pytest.raises(RegexSyntaxError, match="pattern set too large"):
+        compile_patterns(["a{40}"] * 200)  # 8000 total: union cap binds
