@@ -41,11 +41,10 @@ from klogs_tpu.filters.compiler.parser import (
     parse,
 )
 
-# Union-automaton position cap; the same KLOGS_MAX_PATTERN_POSITIONS
-# knob overrides it (in both directions) so raising or tightening one
-# cap never leaves the other silently binding. Read via
-# parser.max_positions_cap once per _Builder.
-MAX_UNION_POSITIONS = 4096
+# The union-automaton position cap equals the parser's per-pattern cap
+# (parser.MAX_POSITIONS, overridden by the same
+# KLOGS_MAX_PATTERN_POSITIONS knob, read once per _Builder) so raising
+# or tightening one cap never leaves the other silently binding.
 
 
 @dataclass

@@ -92,7 +92,10 @@ def max_positions_cap() -> int:
     try:
         return max(1, int(s))
     except ValueError:
-        raise RegexSyntaxError(
+        # Deliberately NOT RegexSyntaxError: callers treat that as "bad
+        # pattern" and soft-skip (the fuzzer would pass vacuously, the
+        # CLI would blame --match). A config typo should crash loudly.
+        raise ValueError(
             f"KLOGS_MAX_PATTERN_POSITIONS must be an integer, got {s!r}"
         ) from None
 

@@ -1,0 +1,66 @@
+"""bench.py driver-contract test: one JSON line, correct schema.
+
+The driver runs `python bench.py` at the end of every round and records
+the single JSON line it prints (BENCH_r{N}.json); a malformed or hanging
+bench means the round produces no perf artifact at all, so the contract
+is load-bearing. Run the real script in a subprocess on the hermetic CPU
+platform with smoke sizes — this exercises the full path including the
+device-measurement subprocess, its watchdog, and the CPU-only-host
+reporting branch (value = the host-regex production path, never the
+quadratic union-NFA jnp smoke)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_json_contract():
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "KLOGS_BENCH_LINES": "4000",
+        "KLOGS_BENCH_CPU_LINES": "2000",
+        "KLOGS_BENCH_DEVICE_BATCH": "512",
+        "KLOGS_BENCH_REPEATS": "1",
+        "KLOGS_BENCH_DEVICE_TIMEOUT_S": "240",
+    })
+    res = subprocess.run(
+        [sys.executable, "bench.py"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=420,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    out_lines = [ln for ln in res.stdout.strip().splitlines() if ln.strip()]
+    assert len(out_lines) == 1, f"expected ONE JSON line, got: {res.stdout!r}"
+    rec = json.loads(out_lines[0])
+    assert rec["unit"] == "lines/sec"
+    assert isinstance(rec["value"], (int, float)) and rec["value"] > 0
+    assert "metric" in rec and "detail" in rec
+    detail = rec["detail"]
+    assert detail["n_patterns"] == 32
+    assert detail["cpu_regex_lps"] > 0
+    # On a CPU-only host the honest value is the host-regex production
+    # path; the jnp run is only a smoke proof the device path executes.
+    if detail.get("no_tpu_on_host"):
+        assert rec["value"] == detail["cpu_regex_lps"]
+        assert rec["vs_baseline"] == 1.0
+        assert detail["jnp_smoke_lps"] > 0
+
+
+def test_graft_entry_contract():
+    """__graft_entry__ is the second driver contract: entry() must give
+    a jittable forward step + example args (compile-checked single-chip)
+    and dryrun_multichip() must run the full sharded step. The multichip
+    side runs in CI and the driver; here just the entry() contract."""
+    import jax
+    import numpy as np
+
+    sys.path.insert(0, REPO)
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = np.asarray(jax.jit(fn)(*args))
+    assert out.shape == (args[0].shape[0],)
+    assert out.dtype == bool

@@ -114,12 +114,16 @@ def safe_oracle(patterns, line: bytes, flags: int, budget_s: float = 2.0):
         signal.setitimer(signal.ITIMER_REAL, 0)
 
 
-def engine_check(pats, lines, ignore_case):
+def engine_check(pats, lines, ignore_case, chunk_bytes=4096):
     """Full production path hermetically: pack_classify -> grouped
-    interpret kernel. Returns the verdict list."""
+    interpret kernel. Returns the verdict list. A small chunk_bytes
+    routes longer lines through the carried-state chunk protocol
+    (classify_chunk_host + match_chunk_cls_pallas), the subtlest path
+    in the engine (END deferral across chunk boundaries)."""
     from klogs_tpu.filters.tpu import NFAEngineFilter
 
-    filt = NFAEngineFilter(pats, ignore_case=ignore_case, kernel="interpret")
+    filt = NFAEngineFilter(pats, ignore_case=ignore_case, kernel="interpret",
+                           chunk_bytes=chunk_bytes)
     return filt.match_lines(lines)
 
 
@@ -168,14 +172,41 @@ def main() -> int:
                 return 1
             checked += 1
         if args.engine_every and trial % args.engine_every == 0:
-            verdicts = engine_check(pats, lines, ignore_case)
-            if verdicts != expects:
-                bad = next(i for i in range(len(lines))
-                           if verdicts[i] != expects[i])
+            # Mix in lines several times the (shrunken) chunk width, so
+            # the carried-state chunk protocol crosses many boundaries;
+            # line lengths straddle the chunk edge (±2) to hit the
+            # END-at-boundary corner exactly.
+            long_lines = []
+            for _ in range(4):
+                target = rng.choice((255, 256, 257, 511, 512, 513, 700,
+                                     1100, 2048))
+                long_lines.append(bytes(rng.choice(ALPHABET)
+                                        for _ in range(target)))
+            try:
+                long_expects = [safe_oracle(pats, ln, flags, 5.0)
+                                for ln in long_lines]
+            except OracleTimeout:
+                # re blew up on a long line: keep the short-line engine
+                # check (its ground truth is already verified) so
+                # backtracking-prone sets still get engine coverage.
+                backtracked += 1
+                long_lines, long_expects = [], []
+            all_lines = lines + long_lines
+            all_expects = expects + long_expects
+            verdicts = engine_check(pats, all_lines, ignore_case,
+                                    chunk_bytes=256)
+            if verdicts != all_expects:
+                bad = next(i for i in range(len(all_lines))
+                           if verdicts[i] != all_expects[i])
+                bad_line = all_lines[bad]
+                shown = (f"{bad_line[:120]!r}..." if len(bad_line) > 120
+                         else repr(bad_line))
                 print(f"DIVERGENCE (interpret kernel): seed={seed} "
                       f"trial={trial} patterns={pats!r} ignore_case="
-                      f"{ignore_case} line={lines[bad]!r} "
-                      f"kernel={verdicts[bad]} re={expects[bad]}", flush=True)
+                      f"{ignore_case} len={len(bad_line)} "
+                      f"line={shown} "
+                      f"kernel={verdicts[bad]} re={all_expects[bad]}",
+                      flush=True)
                 return 1
             engine_runs += 1
         if trial and trial % 2000 == 0:
