@@ -259,6 +259,67 @@ def _grouped_kernel_body(g, cls_ref, char_mask_t_ref, follow_t_ref, out_ref,
         out_ref[:] = out_ref[:] | matched
 
 
+def _check_fused_combo(fused, prefilter_tables, unroll, interleave):
+    """The fused kernel has no gated variant and a single dependency
+    chain per group (no interleave/unroll). Silently running a
+    DIFFERENT kernel than the caller asked to measure would corrupt the
+    'pick by measurement' decision, so incompatible combos are loud."""
+    if not fused:
+        return
+    if prefilter_tables is not None:
+        raise ValueError(
+            "fused=True (KLOGS_TPU_FUSED_GROUPS) has no gated variant; "
+            "drop the prefilter tables or unset KLOGS_TPU_PREFILTER")
+    if unroll != 1 or interleave != 1:
+        raise ValueError(
+            "fused=True ignores unroll/interleave; unset "
+            "KLOGS_TPU_INTERLEAVE (or pass 1) when measuring the fused "
+            "kernel")
+
+
+def _grouped_kernel_fused(cls_ref, char_mask_all_ref, follow_t_ref, out_ref,
+                          *, T: int, C: int, live: int, acc: int, G: int):
+    """All G groups in ONE grid cell (grid iterates batch tiles only).
+
+    Two savings over the per-group grid of _grouped_kernel:
+    - the one-hot class expansion (iota==c over [C, TILE], pure VPU) is
+      computed once per step instead of once per step PER GROUP;
+    - the G mask matmuls collapse into one [G*S, C] @ [C, TILE] matmul,
+      so the C-deep (usually 64 < 128) contraction is amortized over
+      G*S output rows instead of padding the MXU per group.
+    The reach matmuls stay per-group ([S,S] blocks are independent —
+    stacking them block-diagonally would multiply FLOPs by G).
+    Trade-off: the per-lane VMEM charge grows by ~G state tiles + the
+    [G*S, TILE] mask block, shrinking the lane tile (see _cap_tile call
+    in _launch_grouped); pick by measurement (KLOGS_TPU_FUSED_GROUPS=1).
+    """
+    TILE_B = cls_ref.shape[1]
+    S = follow_t_ref.shape[2]
+    iota_c = jax.lax.broadcasted_iota(jnp.int32, (C, TILE_B), 0)
+    v0 = (jax.lax.broadcasted_iota(jnp.int32, (S, TILE_B), 0) == live
+          ).astype(jnp.int8)
+
+    def step(t, vs):
+        c = cls_ref[pl.ds(t, 1), :]
+        onehot = (iota_c == c).astype(jnp.int8)  # shared by all groups
+        mask_all = jnp.dot(char_mask_all_ref[:], onehot,
+                           preferred_element_type=jnp.int32)  # [G*S, TILE]
+        out = []
+        for g in range(G):
+            reach = jnp.dot(follow_t_ref[g], vs[g],
+                            preferred_element_type=jnp.int32)
+            mask = mask_all[g * S : (g + 1) * S, :]
+            out.append(((reach > 0) & (mask > 0)).astype(jnp.int8))
+        return tuple(out)
+
+    vs = jax.lax.fori_loop(0, T, step, tuple(v0 for _ in range(G)),
+                           unroll=False)
+    m = vs[0][acc : acc + 1, :]
+    for g in range(1, G):
+        m = m | vs[g][acc : acc + 1, :]
+    out_ref[:] = m
+
+
 def _grouped_kernel_gated(flags_ref, cls_ref, char_mask_t_ref, follow_t_ref,
                           out_ref, **kw):
     """Tile-skipping wrapper: flags_ref (scalar-prefetched, [n_tiles])
@@ -281,14 +342,15 @@ def _grouped_kernel_gated(flags_ref, cls_ref, char_mask_t_ref, follow_t_ref,
 
 @functools.partial(jax.jit, static_argnames=("live", "acc", "tile_b",
                                              "interpret", "unroll",
-                                             "interleave"))
+                                             "interleave", "fused"))
 def match_batch_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
                                batch: jax.Array, lengths: jax.Array,
                                tile_b: int = DEFAULT_TILE_B_GROUPED,
                                interpret: bool = False,
                                unroll: int = 1,
                                interleave: int = 1,
-                               prefilter_tables=None) -> jax.Array:
+                               prefilter_tables=None,
+                               fused: bool = False) -> jax.Array:
     """Full-line match over a compile_grouped program ([G, ...] leaves,
     shared byte classifier): [B, L] u8 + [B] -> [B] bool.
 
@@ -312,7 +374,9 @@ def match_batch_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
       mask (fallback; measured ~NFA-kernel-cost on v5e, see
       BENCH_DEVICE.json)."""
     B = batch.shape[0]
-    TILE_B = _cap_tile(tile_b, B, batch.shape[1] + 3, dp.n_states)
+    _check_fused_combo(fused, prefilter_tables, unroll, interleave)
+    TILE_B = _cap_tile(tile_b, B, batch.shape[1] + 3, dp.n_states,
+                       state_weight=9 * dp.follow.shape[0] if fused else 3)
     Bp = -(-B // TILE_B) * TILE_B
     if Bp != B:
         batch = jnp.pad(batch, ((0, Bp - B), (0, 0)))
@@ -326,12 +390,13 @@ def match_batch_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
         cand_input = (batch, lengths)  # byte-LUT tables need raw bytes
     return _launch_grouped(dp, live, acc, cls, B, TILE_B,
                            interpret, unroll, interleave,
-                           prefilter_tables, cand_input)
+                           prefilter_tables, cand_input, fused=fused)
 
 
 @functools.partial(jax.jit, static_argnames=("live", "acc", "tile_b",
                                              "interpret", "unroll",
-                                             "interleave", "return_stats"))
+                                             "interleave", "return_stats",
+                                             "fused"))
 def match_cls_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
                              cls: jax.Array,
                              tile_b: int = DEFAULT_TILE_B_GROUPED,
@@ -339,7 +404,8 @@ def match_cls_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
                              unroll: int = 1,
                              interleave: int = 1,
                              prefilter_tables=None,
-                             return_stats: bool = False):
+                             return_stats: bool = False,
+                             fused: bool = False):
     """Full-line match over HOST-classified int8 class ids: [B, T] i8
     (pack_classify layout: BEGIN, body classes, END, PAD latch columns)
     -> [B] bool. The single-chip hot path: the device-side byte->class
@@ -353,7 +419,11 @@ def match_cls_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
     n_tiles)) — three device scalars fetched with the mask, feeding the
     --stats prefilter line."""
     B = cls.shape[0]
-    TILE_B = _cap_tile(tile_b, B, cls.shape[1], dp.n_states)
+    _check_fused_combo(fused, prefilter_tables, unroll, interleave)
+    # Fused per-lane charge: cls block + G state tiles (i8 v + i32
+    # reach) + the shared [G*S, TILE] i32 mask block.
+    TILE_B = _cap_tile(tile_b, B, cls.shape[1], dp.n_states,
+                       state_weight=9 * dp.follow.shape[0] if fused else 3)
     Bp = -(-B // TILE_B) * TILE_B
     if Bp != B:
         # Pad rows are all-PAD: no state survives past step 0 except
@@ -364,13 +434,13 @@ def match_cls_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
     return _launch_grouped(dp, live, acc, cls.astype(jnp.int32), B, TILE_B,
                            interpret, unroll, interleave,
                            prefilter_tables, None,
-                           return_stats=return_stats)
+                           return_stats=return_stats, fused=fused)
 
 
 def _launch_grouped(dp, live, acc, cls, B, TILE_B,
                     interpret, unroll, interleave,
                     prefilter_tables, cand_input,
-                    return_stats: bool = False):
+                    return_stats: bool = False, fused: bool = False):
     """Shared kernel launch over classified [Bp, T] i32 ids (padded to a
     TILE_B multiple); B is the real row count to slice back to."""
     Bp, T = cls.shape
@@ -380,6 +450,27 @@ def _launch_grouped(dp, live, acc, cls, B, TILE_B,
     # char_mask [G,C,S] -> [G,S,C]; follow [G,S,S] -> [G,S,S]^T per group.
     char_mask_t = jnp.swapaxes(dp.char_mask, 1, 2)
     follow_t = jnp.swapaxes(dp.follow, 1, 2)
+
+    if fused:  # _check_fused_combo guaranteed prefilter_tables is None
+        out = pl.pallas_call(
+            functools.partial(_grouped_kernel_fused, T=T, C=C,
+                              live=live, acc=acc, G=G),
+            grid=(Bp // TILE_B,),
+            in_specs=[
+                pl.BlockSpec((T, TILE_B), lambda i: (0, i),
+                             memory_space=pltpu.VMEM),      # cls (transposed)
+                pl.BlockSpec((G * S, C), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),      # char_mask^T stacked
+                pl.BlockSpec((G, S, S), lambda i: (0, 0, 0),
+                             memory_space=pltpu.VMEM),      # follow^T
+            ],
+            out_specs=pl.BlockSpec((1, TILE_B), lambda i: (0, i),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((1, Bp), jnp.int8),
+            interpret=interpret,
+        )(cls.T, char_mask_t.reshape(G * S, C), follow_t)
+        matched = (out[0, :B] > 0) | jnp.asarray(dp.match_all)
+        return (matched, None) if return_stats else matched
 
     kern_kw = dict(T=T, C=C, live=live, acc=acc,
                    unroll=unroll, interleave=interleave)

@@ -147,3 +147,30 @@ def test_match_cls_with_class_prefilter():
     got = np.asarray(match_cls_grouped_pallas(
         dp, live, acc, cls, tile_b=8, interpret=True, prefilter_tables=ct))
     assert got.tolist() == RegexFilter(pats).match_lines(lines)
+
+
+def test_fused_groups_kernel_parity():
+    """The fused variant (all G groups in one grid cell, shared one-hot,
+    stacked mask matmul — KLOGS_TPU_FUSED_GROUPS=1) must agree with the
+    per-group grid kernel and the regex oracle, across multiple groups,
+    non-divisible batches, and anchored/match-all patterns."""
+    from klogs_tpu.filters.tpu import pack_classify
+    from klogs_tpu.ops.pallas_nfa import match_cls_grouped_pallas
+
+    pats = ["panic:", "code=50[34]", "^FATAL", r"x[0-9]{2,}y", "a.*b.*c",
+            r"(?:err|warn)\d+", "end$"] * 3  # force several groups
+    dp, live, acc = nfa.compile_grouped(pats, max_positions=24)
+    assert dp.follow.shape[0] >= 3, "want a multi-group program"
+    table = np.asarray(dp.byte_class).astype(np.int8)
+    lines = [b"panic: now", b"code=504", b"FATAL x", b"zFATAL x",
+             b"x123y!", b"abc", b"a-b-c", b"warn77", b"the end",
+             b"end it", b""] * 7  # 77 rows: not a tile multiple
+    cls = pack_classify(lines, 32, table, dp.begin_class, dp.end_class,
+                        dp.pad_class)[: len(lines)]
+    expect = RegexFilter(pats).match_lines(lines)
+    plain = np.asarray(match_cls_grouped_pallas(
+        dp, live, acc, cls, tile_b=16, interpret=True))
+    fused = np.asarray(match_cls_grouped_pallas(
+        dp, live, acc, cls, tile_b=16, interpret=True, fused=True))
+    assert plain.tolist() == expect
+    assert fused.tolist() == expect
