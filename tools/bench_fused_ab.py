@@ -1,0 +1,104 @@
+"""A/B the fused-groups kernel vs the per-group grid kernel on device.
+
+One attach session measures both variants at the headline operating
+point (batch 1M resident, 64 dispatches in flight — OPERATING_POINT.json
+knee) plus a couple of shallower points, and appends a "fused_ab" record
+to OPERATING_POINT.json. The fused kernel (KLOGS_TPU_FUSED_GROUPS=1)
+shares the one-hot class expansion across groups and stacks the G mask
+matmuls into one [G*S, C] matmul; whether that beats the per-group grid
+(whose out-tile revisiting the fused path gives up, shrinking its lane
+tile by the extra VMEM charge) is strictly an empirical question.
+
+Usage: python tools/bench_fused_ab.py
+Env:   KLOGS_AB_BATCH (1048576), KLOGS_AB_FLIGHTS (16,64), KLOGS_AB_REPEATS (3)
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+
+    from klogs_tpu.filters.tpu import pack_classify
+    from klogs_tpu.ops import nfa
+    from klogs_tpu.ops.pallas_nfa import match_cls_grouped_pallas
+
+    B = int(os.environ.get("KLOGS_AB_BATCH", "1048576"))
+    flights = [int(x) for x in
+               os.environ.get("KLOGS_AB_FLIGHTS", "16,64").split(",")]
+    repeats = int(os.environ.get("KLOGS_AB_REPEATS", "3"))
+
+    dev = jax.devices()[0]
+    print(f"attached: {dev}", flush=True)
+    dp, live, acc = nfa.compile_grouped(bench.PATTERNS)
+    table = np.asarray(dp.byte_class).astype(np.int8)
+    lines = [ln.rstrip(b"\n") for ln in bench.make_lines(B)]
+    cls = pack_classify(lines, 128, table, dp.begin_class,
+                        dp.end_class, dp.pad_class)
+    dcls = jax.device_put(cls)
+    print("shipped", flush=True)
+
+    # Ground truth from the host regex engine on a prefix — parity is
+    # checked against an INDEPENDENT oracle, so a divergent variant can
+    # never be vacuously compared against itself, and a divergence is a
+    # hard failure (exit 1), not a recorded "variant error".
+    from klogs_tpu.filters.cpu import RegexFilter
+
+    n_check = min(B, 65536)
+    expect = np.asarray(RegexFilter(bench.PATTERNS).match_lines(
+        lines[:n_check]))
+
+    variants = {}
+    diverged = False
+    for name, kw in (("plain", {}), ("fused", {"fused": True})):
+        try:
+            run = lambda: match_cls_grouped_pallas(dp, live, acc, dcls, **kw)
+            got = np.asarray(run())[:n_check]
+        except Exception as e:
+            print(f"{name}: FAILED {str(e)[:200]}", flush=True)
+            variants[name] = {"error": str(e)[:200]}
+            continue
+        if not (got == expect).all():
+            bad = int(np.argmax(got != expect))
+            print(f"{name}: DIVERGED from host regex at row {bad} "
+                  f"({lines[bad][:80]!r}): kernel={bool(got[bad])} "
+                  f"re={bool(expect[bad])}", flush=True)
+            variants[name] = {"error": "diverged from host regex"}
+            diverged = True
+            continue
+        rows = []
+        for nf in flights:
+            lps = bench.measure_pipelined(run, B, nf, repeats)
+            rows.append({"n_flight": nf, "lps": round(lps, 1)})
+            print(f"{name:>6} x {nf:>2} in flight: {lps:>12,.0f} lines/s",
+                  flush=True)
+        variants[name] = rows
+
+    record = {"fused_ab": {
+        "date": time.strftime("%Y-%m-%d"),
+        "device": str(dev),
+        "batch": B,
+        "n_patterns": len(bench.PATTERNS),
+        "variants": variants,
+    }}
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "OPERATING_POINT.json")
+    existing = json.load(open(path)) if os.path.exists(path) else []
+    existing.append(record)
+    with open(path, "w") as f:
+        json.dump(existing, f, indent=1)
+    print(f"wrote {path}", flush=True)
+    if diverged:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
