@@ -199,16 +199,19 @@ def _cap_tile(tile_b: int, B: int, T: int, S: int,
 
 def _grouped_kernel(cls_ref, char_mask_t_ref, follow_t_ref, out_ref,
                     *, T: int, C: int, live: int, acc: int,
-                    unroll: int = 1, interleave: int = 1):
+                    unroll: int = 1, interleave: int = 1,
+                    mask_block: int = 1):
     g = pl.program_id(1)
     _grouped_kernel_body(g, cls_ref, char_mask_t_ref, follow_t_ref, out_ref,
                          T=T, C=C, live=live, acc=acc,
-                         unroll=unroll, interleave=interleave)
+                         unroll=unroll, interleave=interleave,
+                         mask_block=mask_block)
 
 
 def _grouped_kernel_body(g, cls_ref, char_mask_t_ref, follow_t_ref, out_ref,
                          *, T: int, C: int, live: int, acc: int,
-                         unroll: int = 1, interleave: int = 1):
+                         unroll: int = 1, interleave: int = 1,
+                         mask_block: int = 1):
     """One (batch-tile, group) grid cell. The grid iterates groups
     innermost, so out_ref (indexed by tile only) stays VMEM-resident and
     accumulates the OR across groups. ``g`` is the group grid index,
@@ -219,36 +222,72 @@ def _grouped_kernel_body(g, cls_ref, char_mask_t_ref, follow_t_ref, out_ref,
     scheduler overlap one half's MXU matmuls with the other's VPU
     compare/AND (the serial step chain is otherwise MXU-then-VPU with
     bubbles). Semantics identical; pick by measurement.
+
+    ``mask_block=K`` restructures the scan into blocks of K steps: the
+    K per-step masks (one-hot compare + char-mask matmul — data that
+    does NOT depend on the state chain) are computed unrolled up front,
+    then the K dependent chain steps (reach matmul + threshold-AND) run
+    against the precomputed masks. The mask work is mutually
+    independent, so the scheduler can pipeline its MXU matmuls
+    back-to-back and overlap VPU one-hots with them, instead of
+    serializing everything behind the state chain. Requires T padded to
+    a K multiple (extra PAD steps are idempotent after the latch column:
+    live/acc belong to every class and self-loop). Semantics identical;
+    pick by measurement.
     """
     TILE_B = cls_ref.shape[1]
     S = follow_t_ref.shape[1]
     H = TILE_B // interleave
 
-    def make_step(lo):
-        iota_c = jax.lax.broadcasted_iota(jnp.int32, (C, H), 0)
+    if mask_block > 1:  # incompatible combos rejected in the launcher
+        iota_c = jax.lax.broadcasted_iota(jnp.int32, (C, TILE_B), 0)
 
-        def half_step(t, v):
-            c = cls_ref[pl.ds(t, 1), lo : lo + H]
-            onehot = (iota_c == c).astype(jnp.int8)
-            mask = jnp.dot(char_mask_t_ref[0], onehot,
-                           preferred_element_type=jnp.int32)
-            reach = jnp.dot(follow_t_ref[0], v,
-                            preferred_element_type=jnp.int32)
-            return ((reach > 0) & (mask > 0)).astype(jnp.int8)
+        def block(j, v):
+            base = j * mask_block
+            masks = []
+            for k in range(mask_block):  # independent: pipelines on MXU
+                c = cls_ref[pl.ds(base + k, 1), :]
+                onehot = (iota_c == c).astype(jnp.int8)
+                masks.append(
+                    jnp.dot(char_mask_t_ref[0], onehot,
+                            preferred_element_type=jnp.int32) > 0)
+            for k in range(mask_block):  # the serial chain, 2 ops/step
+                reach = jnp.dot(follow_t_ref[0], v,
+                                preferred_element_type=jnp.int32)
+                v = ((reach > 0) & masks[k]).astype(jnp.int8)
+            return v
 
-        return half_step
+        v0 = (jax.lax.broadcasted_iota(jnp.int32, (S, TILE_B), 0)
+              == live).astype(jnp.int8)
+        v = jax.lax.fori_loop(0, T // mask_block, block, v0, unroll=unroll)
+        matched = v[acc : acc + 1, :]
+    else:
+        def make_step(lo):
+            iota_c = jax.lax.broadcasted_iota(jnp.int32, (C, H), 0)
 
-    v0_half = [
-        (jax.lax.broadcasted_iota(jnp.int32, (S, H), 0) == live).astype(jnp.int8)
-        for _ in range(interleave)
-    ]
-    steps = [make_step(i * H) for i in range(interleave)]
+            def half_step(t, v):
+                c = cls_ref[pl.ds(t, 1), lo : lo + H]
+                onehot = (iota_c == c).astype(jnp.int8)
+                mask = jnp.dot(char_mask_t_ref[0], onehot,
+                               preferred_element_type=jnp.int32)
+                reach = jnp.dot(follow_t_ref[0], v,
+                                preferred_element_type=jnp.int32)
+                return ((reach > 0) & (mask > 0)).astype(jnp.int8)
 
-    def step(t, vs):
-        return tuple(s(t, v) for s, v in zip(steps, vs))
+            return half_step
 
-    vs = jax.lax.fori_loop(0, T, step, tuple(v0_half), unroll=unroll)
-    matched = jnp.concatenate([v[acc : acc + 1, :] for v in vs], axis=1)
+        v0_half = [
+            (jax.lax.broadcasted_iota(jnp.int32, (S, H), 0) == live
+             ).astype(jnp.int8)
+            for _ in range(interleave)
+        ]
+        steps = [make_step(i * H) for i in range(interleave)]
+
+        def step(t, vs):
+            return tuple(s(t, v) for s, v in zip(steps, vs))
+
+        vs = jax.lax.fori_loop(0, T, step, tuple(v0_half), unroll=unroll)
+        matched = jnp.concatenate([v[acc : acc + 1, :] for v in vs], axis=1)
 
     @pl.when(g == 0)
     def _():
@@ -259,22 +298,28 @@ def _grouped_kernel_body(g, cls_ref, char_mask_t_ref, follow_t_ref, out_ref,
         out_ref[:] = out_ref[:] | matched
 
 
-def _check_fused_combo(fused, prefilter_tables, unroll, interleave):
+def _check_fused_combo(fused, prefilter_tables, unroll, interleave,
+                       mask_block=1):
     """The fused kernel has no gated variant and a single dependency
     chain per group (no interleave/unroll). Silently running a
     DIFFERENT kernel than the caller asked to measure would corrupt the
     'pick by measurement' decision, so incompatible combos are loud."""
+    if mask_block > 1 and interleave != 1:
+        raise ValueError(
+            "mask_block (KLOGS_TPU_MASK_BLOCK) and interleave "
+            "(KLOGS_TPU_INTERLEAVE) are mutually exclusive chain "
+            "restructurings; set at most one")
     if not fused:
         return
     if prefilter_tables is not None:
         raise ValueError(
             "fused=True (KLOGS_TPU_FUSED_GROUPS) has no gated variant; "
             "drop the prefilter tables or unset KLOGS_TPU_PREFILTER")
-    if unroll != 1 or interleave != 1:
+    if unroll != 1 or interleave != 1 or mask_block != 1:
         raise ValueError(
-            "fused=True ignores unroll/interleave; unset "
-            "KLOGS_TPU_INTERLEAVE (or pass 1) when measuring the fused "
-            "kernel")
+            "fused=True ignores unroll/interleave/mask_block; unset "
+            "KLOGS_TPU_INTERLEAVE / KLOGS_TPU_MASK_BLOCK (or pass 1) "
+            "when measuring the fused kernel")
 
 
 def _grouped_kernel_fused(cls_ref, char_mask_all_ref, follow_t_ref, out_ref,
@@ -342,7 +387,8 @@ def _grouped_kernel_gated(flags_ref, cls_ref, char_mask_t_ref, follow_t_ref,
 
 @functools.partial(jax.jit, static_argnames=("live", "acc", "tile_b",
                                              "interpret", "unroll",
-                                             "interleave", "fused"))
+                                             "interleave", "fused",
+                                             "mask_block"))
 def match_batch_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
                                batch: jax.Array, lengths: jax.Array,
                                tile_b: int = DEFAULT_TILE_B_GROUPED,
@@ -350,7 +396,8 @@ def match_batch_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
                                unroll: int = 1,
                                interleave: int = 1,
                                prefilter_tables=None,
-                               fused: bool = False) -> jax.Array:
+                               fused: bool = False,
+                               mask_block: int = 1) -> jax.Array:
     """Full-line match over a compile_grouped program ([G, ...] leaves,
     shared byte classifier): [B, L] u8 + [B] -> [B] bool.
 
@@ -374,9 +421,13 @@ def match_batch_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
       mask (fallback; measured ~NFA-kernel-cost on v5e, see
       BENCH_DEVICE.json)."""
     B = batch.shape[0]
-    _check_fused_combo(fused, prefilter_tables, unroll, interleave)
-    TILE_B = _cap_tile(tile_b, B, batch.shape[1] + 3, dp.n_states,
-                       state_weight=9 * dp.follow.shape[0] if fused else 3)
+    _check_fused_combo(fused, prefilter_tables, unroll, interleave,
+                       mask_block)
+    # +3: BEGIN, END, latch columns; then the mask_block T-padding the
+    # launcher will add, so the VMEM budget sees the true cls width.
+    T_cap = -(-(batch.shape[1] + 3) // mask_block) * mask_block
+    TILE_B = _cap_tile(tile_b, B, T_cap, dp.n_states,
+                       state_weight=_state_weight(fused, dp, mask_block))
     Bp = -(-B // TILE_B) * TILE_B
     if Bp != B:
         batch = jnp.pad(batch, ((0, Bp - B), (0, 0)))
@@ -390,13 +441,14 @@ def match_batch_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
         cand_input = (batch, lengths)  # byte-LUT tables need raw bytes
     return _launch_grouped(dp, live, acc, cls, B, TILE_B,
                            interpret, unroll, interleave,
-                           prefilter_tables, cand_input, fused=fused)
+                           prefilter_tables, cand_input, fused=fused,
+                           mask_block=mask_block)
 
 
 @functools.partial(jax.jit, static_argnames=("live", "acc", "tile_b",
                                              "interpret", "unroll",
                                              "interleave", "return_stats",
-                                             "fused"))
+                                             "fused", "mask_block"))
 def match_cls_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
                              cls: jax.Array,
                              tile_b: int = DEFAULT_TILE_B_GROUPED,
@@ -405,7 +457,8 @@ def match_cls_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
                              interleave: int = 1,
                              prefilter_tables=None,
                              return_stats: bool = False,
-                             fused: bool = False):
+                             fused: bool = False,
+                             mask_block: int = 1):
     """Full-line match over HOST-classified int8 class ids: [B, T] i8
     (pack_classify layout: BEGIN, body classes, END, PAD latch columns)
     -> [B] bool. The single-chip hot path: the device-side byte->class
@@ -419,11 +472,14 @@ def match_cls_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
     n_tiles)) — three device scalars fetched with the mask, feeding the
     --stats prefilter line."""
     B = cls.shape[0]
-    _check_fused_combo(fused, prefilter_tables, unroll, interleave)
+    _check_fused_combo(fused, prefilter_tables, unroll, interleave,
+                       mask_block)
     # Fused per-lane charge: cls block + G state tiles (i8 v + i32
-    # reach) + the shared [G*S, TILE] i32 mask block.
-    TILE_B = _cap_tile(tile_b, B, cls.shape[1], dp.n_states,
-                       state_weight=9 * dp.follow.shape[0] if fused else 3)
+    # reach) + the shared [G*S, TILE] i32 mask block. The T charge
+    # includes the mask_block padding the launcher will add.
+    T_cap = -(-cls.shape[1] // mask_block) * mask_block
+    TILE_B = _cap_tile(tile_b, B, T_cap, dp.n_states,
+                       state_weight=_state_weight(fused, dp, mask_block))
     Bp = -(-B // TILE_B) * TILE_B
     if Bp != B:
         # Pad rows are all-PAD: no state survives past step 0 except
@@ -434,15 +490,36 @@ def match_cls_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
     return _launch_grouped(dp, live, acc, cls.astype(jnp.int32), B, TILE_B,
                            interpret, unroll, interleave,
                            prefilter_tables, None,
-                           return_stats=return_stats, fused=fused)
+                           return_stats=return_stats, fused=fused,
+                           mask_block=mask_block)
+
+
+def _state_weight(fused: bool, dp, mask_block: int = 1) -> int:
+    """Per-lane state-tile VMEM charge for _cap_tile (see its docstring
+    for calibration). mask_block keeps K precomputed bool masks plus one
+    i32 matmul transient resident alongside v/reach."""
+    if fused:
+        return 9 * dp.follow.shape[0]
+    if mask_block > 1:
+        return 3 + mask_block + 4
+    return 3
 
 
 def _launch_grouped(dp, live, acc, cls, B, TILE_B,
                     interpret, unroll, interleave,
                     prefilter_tables, cand_input,
-                    return_stats: bool = False, fused: bool = False):
+                    return_stats: bool = False, fused: bool = False,
+                    mask_block: int = 1):
     """Shared kernel launch over classified [Bp, T] i32 ids (padded to a
     TILE_B multiple); B is the real row count to slice back to."""
+    if mask_block > 1 and cls.shape[1] % mask_block:
+        # Extra PAD steps after the latch column are idempotent
+        # (live/acc belong to every class and self-loop), so rounding T
+        # up to a block multiple changes nothing semantically.
+        extra = mask_block - cls.shape[1] % mask_block
+        cls = jnp.concatenate(
+            [cls, jnp.full((cls.shape[0], extra), dp.pad_class,
+                           dtype=cls.dtype)], axis=1)
     Bp, T = cls.shape
     S, C = dp.n_states, dp.n_classes
     G = dp.follow.shape[0]
@@ -473,7 +550,8 @@ def _launch_grouped(dp, live, acc, cls, B, TILE_B,
         return (matched, None) if return_stats else matched
 
     kern_kw = dict(T=T, C=C, live=live, acc=acc,
-                   unroll=unroll, interleave=interleave)
+                   unroll=unroll, interleave=interleave,
+                   mask_block=mask_block)
     if prefilter_tables is None:
         out = pl.pallas_call(
             functools.partial(_grouped_kernel, **kern_kw),

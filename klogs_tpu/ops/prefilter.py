@@ -108,7 +108,14 @@ def class_tables(pf: PrefilterProgram, byte_class, n_classes: int,
 
     Returns None when some byte class is NOT uniform w.r.t. the LUTs
     (cannot happen when both were compiled from the same parse, but the
-    byte-LUT fallback stays correct if it ever does)."""
+    byte-LUT fallback stays correct if it ever does) — and when the
+    program is not ``usable``: candidate_mask_from_cls treats a
+    zero-requirement pattern column as shard padding and masks it out,
+    so tables built from a program where a REAL pattern has no clauses
+    would wrongly filter that pattern's matches. Production callers all
+    check ``usable`` first; this guard makes misuse impossible."""
+    if not pf.usable:
+        return None
     byte_class = np.asarray(byte_class)
     lut1, lut2 = pf.lut1, pf.lut2
     W = lut1.shape[1]

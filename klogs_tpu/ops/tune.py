@@ -131,9 +131,9 @@ def tune_grouped(dp, live: int, acc: int, batch, lengths,
 
 
 def env_overrides() -> dict:
-    """KLOGS_TPU_TILE / KLOGS_TPU_INTERLEAVE / KLOGS_TPU_FUSED_GROUPS,
-    when set. Callers pass the result straight into
-    match_cls_grouped_pallas / match_batch_grouped_pallas kwargs."""
+    """KLOGS_TPU_TILE / KLOGS_TPU_INTERLEAVE / KLOGS_TPU_FUSED_GROUPS /
+    KLOGS_TPU_MASK_BLOCK, when set. Callers pass the result straight
+    into match_cls_grouped_pallas / match_batch_grouped_pallas kwargs."""
     out = {}
     if os.environ.get("KLOGS_TPU_TILE"):
         out["tile_b"] = int(os.environ["KLOGS_TPU_TILE"])
@@ -141,4 +141,6 @@ def env_overrides() -> dict:
         out["interleave"] = int(os.environ["KLOGS_TPU_INTERLEAVE"])
     if os.environ.get("KLOGS_TPU_FUSED_GROUPS") == "1":
         out["fused"] = True
+    if os.environ.get("KLOGS_TPU_MASK_BLOCK"):
+        out["mask_block"] = int(os.environ["KLOGS_TPU_MASK_BLOCK"])
     return out

@@ -174,3 +174,76 @@ def test_fused_groups_kernel_parity():
         dp, live, acc, cls, tile_b=16, interpret=True, fused=True))
     assert plain.tolist() == expect
     assert fused.tolist() == expect
+
+
+def test_mask_block_kernel_parity():
+    """mask_block=K (precompute K masks off the state chain, then run K
+    dependent steps — KLOGS_TPU_MASK_BLOCK) must agree with the plain
+    kernel and the regex oracle, including when T is not a K multiple
+    (the launcher pads with idempotent PAD steps) and under the gated
+    prefilter path."""
+    from klogs_tpu.filters.compiler.prefilter import compile_prefilter
+    from klogs_tpu.filters.tpu import pack_classify
+    from klogs_tpu.ops.pallas_nfa import match_cls_grouped_pallas
+    from klogs_tpu.ops.prefilter import class_tables
+
+    pats = ["panic:", "code=50[34]", "^FATAL", r"x[0-9]{2,}y", "a.*b.*c",
+            r"(?:err|warn)\d+", "end$"]
+    dp, live, acc = nfa.compile_grouped(pats, max_positions=24)
+    table = np.asarray(dp.byte_class).astype(np.int8)
+    lines = [b"panic: now", b"code=504", b"FATAL x", b"zFATAL x",
+             b"x123y!", b"abc", b"a-b-c", b"warn77", b"the end",
+             b"end it", b""] * 7  # 77 rows: not a tile multiple
+    # width 29 -> T = 32 (BEGIN + 29 + END + latch): not a multiple of 3
+    cls = pack_classify(lines, 29, table, dp.begin_class, dp.end_class,
+                        dp.pad_class)[: len(lines)]
+    expect = RegexFilter(pats).match_lines(lines)
+    for K in (2, 3, 4, 8):
+        got = np.asarray(match_cls_grouped_pallas(
+            dp, live, acc, cls, tile_b=16, interpret=True, mask_block=K))
+        assert got.tolist() == expect, f"mask_block={K}"
+    # This pattern set is NOT prefilter-usable (`a.*b.*c` has no
+    # mandatory adjacent pair), and class_tables must refuse it — tables
+    # built anyway would wrongly filter that pattern's matches.
+    pf = compile_prefilter(pats)
+    assert not pf.usable
+    assert class_tables(pf, dp.byte_class, dp.n_classes) is None
+    # Composes with the gated prefilter path (shared kernel body) on a
+    # usable set.
+    gpats = ["panic:", "code=50[34]", "FATAL|CRIT"]
+    gdp, glive, gacc = nfa.compile_grouped(gpats)
+    gpf = compile_prefilter(gpats)
+    gtable = np.asarray(gdp.byte_class).astype(np.int8)
+    gct = class_tables(gpf, gdp.byte_class, gdp.n_classes)
+    assert gct is not None
+    glines = [b"panic: x", b"fine", b"code=504", b"FATAL boom", b"meh"] * 20
+    gcls = pack_classify(glines, 29, gtable, gdp.begin_class, gdp.end_class,
+                         gdp.pad_class)[: len(glines)]
+    gated = np.asarray(match_cls_grouped_pallas(
+        gdp, glive, gacc, gcls, tile_b=16, interpret=True, mask_block=4,
+        prefilter_tables=gct))
+    assert gated.tolist() == RegexFilter(gpats).match_lines(glines)
+    # Byte-consuming entry too (pads its own latch column).
+    from klogs_tpu.filters.tpu import pack_lines
+    batch, lengths = pack_lines(lines, 29)
+    batch, lengths = batch[: len(lines)], lengths[: len(lines)]
+    got = np.asarray(match_batch_grouped_pallas(
+        dp, live, acc, batch, lengths, tile_b=16, interpret=True,
+        mask_block=4))
+    assert got.tolist() == expect
+
+
+def test_mask_block_rejects_interleave_combo():
+    from klogs_tpu.filters.tpu import pack_classify
+    from klogs_tpu.ops.pallas_nfa import match_cls_grouped_pallas
+
+    dp, live, acc = nfa.compile_grouped(["abc"])
+    table = np.asarray(dp.byte_class).astype(np.int8)
+    cls = pack_classify([b"abc"], 8, table, dp.begin_class, dp.end_class,
+                        dp.pad_class)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        match_cls_grouped_pallas(dp, live, acc, cls, tile_b=8,
+                                 interpret=True, mask_block=2, interleave=2)
+    with pytest.raises(ValueError, match="fused=True ignores"):
+        match_cls_grouped_pallas(dp, live, acc, cls, tile_b=8,
+                                 interpret=True, mask_block=2, fused=True)

@@ -1,13 +1,20 @@
-"""A/B the fused-groups kernel vs the per-group grid kernel on device.
+"""A/B kernel variants against the per-group grid kernel on device.
 
-One attach session measures both variants at the headline operating
+One attach session measures every variant at the headline operating
 point (batch 1M resident, 64 dispatches in flight — OPERATING_POINT.json
 knee) plus a couple of shallower points, and appends a "fused_ab" record
-to OPERATING_POINT.json. The fused kernel (KLOGS_TPU_FUSED_GROUPS=1)
-shares the one-hot class expansion across groups and stacks the G mask
-matmuls into one [G*S, C] matmul; whether that beats the per-group grid
-(whose out-tile revisiting the fused path gives up, shrinking its lane
-tile by the extra VMEM charge) is strictly an empirical question.
+to OPERATING_POINT.json. Variants:
+
+- fused (KLOGS_TPU_FUSED_GROUPS=1): all G groups in one grid cell,
+  shared one-hot class expansion, G mask matmuls stacked into one
+  [G*S, C] matmul; trades a smaller lane tile (extra VMEM) for the
+  shared VPU work.
+- mask_block=K (KLOGS_TPU_MASK_BLOCK): precompute K per-step masks
+  (mutually independent MXU matmuls that pipeline back-to-back) ahead
+  of the K dependent chain steps, shortening the serial
+  MXU-then-VPU-per-step chain to reach-matmul + threshold-AND.
+
+Whether either beats the plain grid is strictly an empirical question.
 
 Usage: python tools/bench_fused_ab.py
 Env:   KLOGS_AB_BATCH (1048576), KLOGS_AB_FLIGHTS (16,64), KLOGS_AB_REPEATS (3)
@@ -58,7 +65,10 @@ def main() -> None:
 
     variants = {}
     diverged = False
-    for name, kw in (("plain", {}), ("fused", {"fused": True})):
+    for name, kw in (("plain", {}), ("fused", {"fused": True}),
+                     ("mask_block4", {"mask_block": 4}),
+                     ("mask_block8", {"mask_block": 8}),
+                     ("mask_block16", {"mask_block": 16})):
         try:
             run = lambda: match_cls_grouped_pallas(dp, live, acc, dcls, **kw)
             got = np.asarray(run())[:n_check]

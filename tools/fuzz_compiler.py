@@ -114,17 +114,35 @@ def safe_oracle(patterns, line: bytes, flags: int, budget_s: float = 2.0):
         signal.setitimer(signal.ITIMER_REAL, 0)
 
 
-def engine_check(pats, lines, ignore_case, chunk_bytes=4096):
+def engine_check(pats, lines, ignore_case, chunk_bytes=4096,
+                 mask_block=None):
     """Full production path hermetically: pack_classify -> grouped
     interpret kernel. Returns the verdict list. A small chunk_bytes
     routes longer lines through the carried-state chunk protocol
     (classify_chunk_host + match_chunk_cls_pallas), the subtlest path
-    in the engine (END deferral across chunk boundaries)."""
+    in the engine (END deferral across chunk boundaries).
+    ``mask_block`` opts the full-line kernel into the K-step
+    mask-precompute restructuring (KLOGS_TPU_MASK_BLOCK) so the fuzz
+    also covers that variant's T-padding path. Ambient tuning knobs
+    that would conflict with (or silently alter) the selected variant
+    are stashed for the duration of the check and restored after."""
     from klogs_tpu.filters.tpu import NFAEngineFilter
 
-    filt = NFAEngineFilter(pats, ignore_case=ignore_case, kernel="interpret",
-                           chunk_bytes=chunk_bytes)
-    return filt.match_lines(lines)
+    knobs = ("KLOGS_TPU_MASK_BLOCK", "KLOGS_TPU_INTERLEAVE",
+             "KLOGS_TPU_FUSED_GROUPS")
+    saved = {k: os.environ.pop(k, None) for k in knobs}
+    if mask_block:
+        os.environ["KLOGS_TPU_MASK_BLOCK"] = str(mask_block)
+    try:
+        filt = NFAEngineFilter(pats, ignore_case=ignore_case,
+                               kernel="interpret", chunk_bytes=chunk_bytes)
+        return filt.match_lines(lines)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 def main() -> int:
@@ -193,8 +211,9 @@ def main() -> int:
                 long_lines, long_expects = [], []
             all_lines = lines + long_lines
             all_expects = expects + long_expects
+            mb = rng.choice((None, None, 2, 4, 8))
             verdicts = engine_check(pats, all_lines, ignore_case,
-                                    chunk_bytes=256)
+                                    chunk_bytes=256, mask_block=mb)
             if verdicts != all_expects:
                 bad = next(i for i in range(len(all_lines))
                            if verdicts[i] != all_expects[i])
@@ -203,7 +222,7 @@ def main() -> int:
                          else repr(bad_line))
                 print(f"DIVERGENCE (interpret kernel): seed={seed} "
                       f"trial={trial} patterns={pats!r} ignore_case="
-                      f"{ignore_case} len={len(bad_line)} "
+                      f"{ignore_case} mask_block={mb} len={len(bad_line)} "
                       f"line={shown} "
                       f"kernel={verdicts[bad]} re={all_expects[bad]}",
                       flush=True)

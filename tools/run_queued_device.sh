@@ -1,0 +1,41 @@
+#!/bin/sh
+# Round-4 queued device measurements (BASELINE.md "Pending device
+# measurements"), run in order with per-tool attach retries. The axon
+# tunnel wedges transiently (attach hangs inside backend init), so each
+# tool gets a hard per-attempt timeout and several attempts spread over
+# time. Logs land next to this script's repo root as .{bench_r4,
+# fused_ab,service_bench}.log; progress markers go to .queued_status.
+set -u
+cd "$(dirname "$0")/.."
+status() { echo "$(date -u +%H:%M:%S) $*" >> .queued_status; }
+
+status "start"
+# 1. Headline bench (has its own attach-retry loop inside).
+KLOGS_BENCH_DEVICE_TIMEOUT_S=5400 timeout 6000 python -u bench.py \
+    > .bench_r4.log 2>&1
+status "bench.py rc=$?"
+
+# 2. Fused-groups A/B (attaches in-process; retry around it).
+i=0
+while [ $i -lt 8 ]; do
+    i=$((i+1))
+    timeout 900 python -u tools/bench_fused_ab.py >> .fused_ab.log 2>&1
+    rc=$?
+    status "bench_fused_ab attempt $i rc=$rc"
+    [ $rc -eq 0 ] && break
+    [ $rc -eq 1 ] && break   # divergence: hard fail, do not retry
+    sleep 60
+done
+
+# 3. gRPC service bench on the TPU backend.
+i=0
+while [ $i -lt 5 ]; do
+    i=$((i+1))
+    timeout 900 python -u tools/bench_service.py --backend tpu \
+        >> .service_bench.log 2>&1
+    rc=$?
+    status "bench_service attempt $i rc=$rc"
+    [ $rc -eq 0 ] && break
+    sleep 60
+done
+status "done"
