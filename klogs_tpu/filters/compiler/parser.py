@@ -1,23 +1,26 @@
 """Regex parser for the --match pattern compiler.
 
-Parses the RE2-style subset (no backreferences, no lookaround, no \\b)
-into a small AST over *byte sets* and *sentinel symbols*. Anchors are
-not assertions here: ``^`` and ``$`` parse to ordinary symbols matching
+Parses the RE2-style subset (no backreferences, no lookaround) into a
+small AST over *byte sets* and *sentinel symbols*. Anchors are not
+assertions here: ``^`` and ``$`` parse to ordinary symbols matching
 virtual BEGIN/END sentinels that the engine feeds around each line, so
 Glushkov construction needs no special cases and patterns like ``a^b``
 (never matches) or ``^a*$`` fall out correct by construction. The one
 place symbol semantics would diverge from re's idempotent assertions —
 an anchor directly (or across nullable-only content) after another
 anchor, e.g. ``^^``, ``$$``, ``$^``, ``^a?^`` — is rejected at compile
-time (glushkov._reject_divergent_anchor_pairs), keeping the contract
-that every accepted pattern behaves exactly like re.
+time (glushkov), keeping the contract that every accepted pattern
+behaves exactly like re.
 
 Supported syntax: literals, ``.``, escapes (\\d \\D \\w \\W \\s \\S
-\\t \\n \\r \\f \\v \\0 \\xHH and escaped punctuation), character
-classes ``[...]`` with ranges and negation, grouping ``(...)`` /
-``(?:...)``, alternation ``|``, quantifiers ``* + ? {m} {m,} {m,n}``
-(lazy variants accepted — laziness is irrelevant for boolean matching),
-anchors ``^ $``, and a whole-pattern ``(?i)`` prefix.
+\\t \\n \\r \\f \\v \\0 \\xHH and escaped punctuation), word-boundary
+assertions ``\\b`` / ``\\B`` (compiled to static edge constraints in
+glushkov.py — no runtime cost), character classes ``[...]`` with
+ranges and negation (``[\\b]`` is backspace, as in re), grouping
+``(...)`` / ``(?:...)``, alternation ``|``, quantifiers ``* + ? {m}
+{m,} {m,n}`` (lazy variants accepted — laziness is irrelevant for
+boolean matching), anchors ``^ $``, and a whole-pattern ``(?i)``
+prefix.
 
 The reference has no counterpart (filtering is new per the north star);
 the CPU baseline is Python ``re`` (≙ Go ``regexp`` in klogs' world,
@@ -48,6 +51,16 @@ class Sym:
 @dataclass(frozen=True)
 class Epsilon:
     pass
+
+
+@dataclass(frozen=True)
+class Boundary:
+    """Zero-width word-boundary assertion: ``\\b`` (negate=False)
+    requires the adjacent symbols to differ in word-category,
+    ``\\B`` (negate=True) requires them to agree. BEGIN/END sentinels
+    count as non-word, exactly like re's edge-of-string rule."""
+
+    negate: bool = False
 
 
 @dataclass(frozen=True)
@@ -237,10 +250,11 @@ class _Parser:
                 " is not supported (possessive/atomic matching cannot be"
                 " expressed by an NFA; group with (?:...) if you meant"
                 " nested repetition)")
-        if isinstance(node, Sym) and node.sentinel is not None:
+        if (isinstance(node, Boundary)
+                or (isinstance(node, Sym) and node.sentinel is not None)):
             raise RegexSyntaxError(
                 f"nothing to repeat at position {self.pos} (quantifier"
-                " applied to an anchor)")
+                " applied to an anchor or \\b assertion, as in re)")
 
     def _try_counted(self) -> tuple[int, int | None] | None:
         """Parse {m} {m,} {m,n} after the '{'; None if not a counted
@@ -302,6 +316,13 @@ class _Parser:
                     )
             node = self._alt()
             self._expect(0x29)
+            if isinstance(node, Boundary) or (
+                    isinstance(node, Sym) and node.sentinel is not None):
+                # re's "nothing to repeat" applies to a BARE anchor or
+                # assertion, not a group containing one ((?:\b)? is
+                # legal); a one-part Cat defeats _reject_bad_repeat
+                # without changing the language.
+                node = Cat((node,))
             return node
         if c == 0x5B:  # '['
             return self._char_class()
@@ -312,6 +333,13 @@ class _Parser:
         if c == 0x24:  # '$'
             return self._leaf(sentinel=END)
         if c == 0x5C:  # '\'
+            n = self._peek()
+            if n == 0x62:  # \b — word boundary (backspace inside [...])
+                self.pos += 1
+                return Boundary(negate=False)
+            if n == 0x42:  # \B
+                self.pos += 1
+                return Boundary(negate=True)
             return self._sym(self._escape(in_class=False))
         if c in (0x2A, 0x2B, 0x3F):  # quantifier with nothing to repeat
             raise RegexSyntaxError(f"nothing to repeat before {chr(c)!r}")
@@ -339,9 +367,13 @@ class _Parser:
         }
         if c in classes:
             return classes[c]
-        if c == 0x62:  # \b
-            raise RegexSyntaxError("\\b word-boundary assertions are not supported")
+        if c == 0x62:  # \b: backspace inside a class (re semantics);
+            # outside a class it is intercepted in _atom as Boundary.
+            if in_class:
+                return frozenset({0x08})
+            raise RegexSyntaxError("internal: \\b must be handled in _atom")
         if chr(c).isalnum():
+            # Includes [\B]: re rejects it as a bad escape in a class.
             raise RegexSyntaxError(f"unsupported escape \\{chr(c)}")
         return frozenset({c})  # escaped punctuation
 
@@ -399,7 +431,7 @@ class _Parser:
 def _count_leaves(node: object) -> int:
     if isinstance(node, Sym):
         return 1
-    if isinstance(node, Epsilon):
+    if isinstance(node, (Epsilon, Boundary)):
         return 0
     if isinstance(node, (Cat, Alt)):
         return sum(_count_leaves(p) for p in node.parts)

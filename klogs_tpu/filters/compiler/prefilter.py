@@ -40,6 +40,7 @@ import numpy as np
 
 from klogs_tpu.filters.compiler.parser import (
     Alt,
+    Boundary,
     Cat,
     Epsilon,
     Star,
@@ -130,7 +131,10 @@ def _alt_cnf(cnfs: list[frozenset]) -> frozenset:
 
 
 def _summarize(node) -> _Summary:
-    if isinstance(node, Epsilon):
+    if isinstance(node, (Epsilon, Boundary)):
+        # \b/\B are zero-width: they preserve byte adjacency (a
+        # mandatory pair across one remains mandatory) and add no
+        # byte content of their own.
         return _Summary("empty")
     if isinstance(node, Sym):
         if node.sentinel is not None:

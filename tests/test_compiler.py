@@ -129,7 +129,8 @@ def test_explicit_ignore_case_flag():
 
 @pytest.mark.parametrize(
     "bad",
-    [r"a\b", r"(?P<x>a)", r"(?=a)", "(a", "a)", "[a", r"a{2,1}", "*a", "[]"],
+    [r"\b+", r"a\b*", r"[\B]", r"\b^a", r"a$\b",  # assertion corner cases
+     r"(?P<x>a)", r"(?=a)", "(a", "a)", "[a", r"a{2,1}", "*a", "[]"],
 )
 def test_rejects_unsupported(bad):
     with pytest.raises((RegexSyntaxError, ValueError)):
@@ -322,3 +323,69 @@ def test_pattern_position_cap(monkeypatch):
     compile_patterns(["a{40}"] * 100)  # 4000 total: under the union cap
     with pytest.raises(RegexSyntaxError, match="pattern set too large"):
         compile_patterns(["a{40}"] * 200)  # 8000 total: union cap binds
+
+
+def test_word_boundaries_vs_re():
+    """\\b/\\B compile to static structure (split positions, constrained
+    follow edges, context/boundary-check states) — verify against re on
+    the hand cases that exercise every wiring path: mid-pattern edges,
+    leading/trailing assertions, anchor interplay, standalone
+    assertions (including re 3.12's empty-string \\B rule), grouped
+    quantification, and ignore-case."""
+    import re as _re
+
+    cases = [
+        (r"\berror\b", [b"error", b"an error here", b"errors", b"xerror",
+                        b"error.", b"-error-", b""]),
+        (r"\bfoo", [b"foo", b"a foo", b"afoo", b"-foo", b"foo!"]),
+        (r"foo\b", [b"foo", b"foob", b"foo bar", b"foo-", b"barfoo"]),
+        (r"\B", [b"", b"-", b"a", b"ab", b"a-", b"-a", b"--", b"a-b", b"-a-"]),
+        (r"\b", [b"", b"-", b"a", b"ab", b"--", b"-a-"]),
+        (r"a\Bb", [b"ab", b"a b", b"xaby"]),
+        (r"\Ba", [b"ba", b"a", b"-a", b"xa9a"]),
+        (r"a\B", [b"ab", b"a-", b"a", b"za"]),
+        (r"^\bfoo", [b"foo", b"-foo", b" foo", b"foox"]),
+        (r"foo\b$", [b"foo", b"foo-", b"afoo", b"foo "]),
+        (r"\b$", [b"a", b"-", b"", b"ab", b"a-"]),
+        (r"^\b", [b"a", b"-", b"", b"-a"]),
+        (r"x(?:\b)?y", [b"xy", b"x y"]),
+        (r"\w+\b\.", [b"word.", b"word x.", b"w.", b"."]),
+        (r"(?:\b|q)z", [b"z", b"-z", b"az", b"qz", b"aqz"]),
+        (r"err\b|warn\B", [b"err", b"errx", b"warn", b"warns", b"err warn"]),
+        (r"[\b]", [b"\x08", b"b", b""]),
+        (r"(?i)\bError\b", [b"ERROR", b"error!", b"xerror"]),
+        (r"\d+\b", [b"42", b"42x", b"a42 ", b"4"]),
+        (r".\b.", [b"a-", b"ab", b"--", b"a", b"-a"]),
+        (r"x(?:\b){2}y", [b"xy", b"x y"]),
+        (r"\b\B", [b"a", b"-", b"", b"ab"]),
+        # Empty-line corners of re 3.12's "\B does not match the empty
+        # string" rule, at every wiring site: direct constrained
+        # BEGIN→END edge, exit-constrained BEGIN, entry-constrained END
+        # (each found or guarded by fuzzing, 2026-07-30).
+        (r"^\B$", [b"", b"-", b"a"]),
+        (r"^\B", [b"", b"a", b"-", b"ab"]),
+        (r"\B$", [b"", b"a", b"-", b"ab", b"a-"]),
+        (r"^\b$", [b"", b"a"]),
+        (r"(?:^|.)(?:\B|[^0-9])", [b"", b"a", b"-"]),
+    ]
+    for pat, lines in cases:
+        prog = compile_patterns([pat])
+        for ln in lines:
+            got = reference_match(prog, ln)
+            want = bool(_re.search(pat.encode(), ln))
+            assert got == want, f"{pat!r} on {ln!r}: got {got} want {want}"
+
+
+def test_word_boundary_through_engine():
+    """The boundary machinery must survive grouping, augmentation, and
+    the interpret Pallas kernel — the full production path."""
+    from klogs_tpu.filters.tpu import NFAEngineFilter
+
+    pats = [r"\berror\b", r"warn\B", r"\bid=\d+\b"]
+    lines = [b"error", b"errors", b"an error.", b"warning", b"warn",
+             b"id=42", b"id=42x", b"xid=42", b"id=4 2", b""]
+    filt = NFAEngineFilter(pats, kernel="interpret")
+    import re as _re
+
+    want = [any(_re.search(p.encode(), ln) for p in pats) for ln in lines]
+    assert filt.match_lines(lines) == want

@@ -42,7 +42,8 @@ ESCAPES = [r"\d", r"\D", r"\w", r"\W", r"\s", r"\S", r"\.", r"\-", r"\t",
 
 
 def rand_pattern(rng: random.Random, depth: int = 0) -> str:
-    choices = ["lit", "lit", "lit", "class", "dot", "escape", "anchor"]
+    choices = ["lit", "lit", "lit", "class", "dot", "escape", "anchor",
+               "boundary"]
     if depth < 4:
         choices += ["cat", "cat", "cat", "alt", "alt", "star", "plus",
                     "opt", "count", "group", "lazy"]
@@ -53,6 +54,8 @@ def rand_pattern(rng: random.Random, depth: int = 0) -> str:
         return "."
     if kind == "anchor":
         return rng.choice(["^", "$"])
+    if kind == "boundary":
+        return rng.choice([r"\b", r"\b", r"\B"])
     if kind == "escape":
         return rng.choice(ESCAPES)
     if kind == "class":
