@@ -31,6 +31,7 @@ import numpy as np
 from klogs_tpu.filters.compiler.parser import (
     BEGIN,
     END,
+    _ALL_BYTES,
     _CLASS_W,
     Alt,
     Boundary,
@@ -165,8 +166,9 @@ class _Builder:
     def visit(self, node: object) -> tuple[int, list, list]:
         """Returns (nulls, first, last).
 
-        ``nulls``: _UNCOND|_EQ|_NEQ bits — under which adjacency
-        relations (or unconditionally) the node matches empty.
+        ``nulls``: _EQ|_NEQ|_EMPTY bits — the set of adjacency
+        relations under which the node matches empty (_FULL for an
+        unconditional empty match).
         ``first``/``last``: lists of (position, entry/exit constraint
         bits) — the constraint an edge into/out of the subexpression
         must satisfy (from boundary assertions at its rim). Fresh
@@ -285,15 +287,15 @@ def compile_patterns(patterns: list[str], ignore_case: bool = False) -> NFAProgr
     #   ctx[0] after BEGIN, ctx[1] after a non-word byte, ctx[2] after a
     #   word byte. Boundary-check accepts: bnd[0] consumes END, bnd[1] a
     #   non-word byte, bnd[2] a word byte.
-    _W = frozenset(_CLASS_W)
-    _NW = frozenset(range(256)) - _W
+    _NW = _ALL_BYTES - _CLASS_W
     specials: dict = {}
 
     def special(kind: str) -> int:
         p = specials.get(kind)
         if p is None:
-            byte_set = {"ctx_begin": frozenset(), "ctx_nw": _NW, "ctx_w": _W,
-                        "bnd_end": frozenset(), "bnd_nw": _NW, "bnd_w": _W}[kind]
+            byte_set = {"ctx_begin": frozenset(), "ctx_nw": _NW,
+                        "ctx_w": _CLASS_W, "bnd_end": frozenset(),
+                        "bnd_nw": _NW, "bnd_w": _CLASS_W}[kind]
             p = specials[kind] = b.new_pos(byte_set)
             if kind.startswith("ctx"):
                 inject.add(p)

@@ -17,10 +17,11 @@ Supported syntax: literals, ``.``, escapes (\\d \\D \\w \\W \\s \\S
 assertions ``\\b`` / ``\\B`` (compiled to static edge constraints in
 glushkov.py — no runtime cost), character classes ``[...]`` with
 ranges and negation (``[\\b]`` is backspace, as in re), grouping
-``(...)`` / ``(?:...)``, alternation ``|``, quantifiers ``* + ? {m}
-{m,} {m,n}`` (lazy variants accepted — laziness is irrelevant for
-boolean matching), anchors ``^ $``, and a whole-pattern ``(?i)``
-prefix.
+``(...)`` / ``(?:...)``, scoped case flags ``(?i:...)`` / ``(?-i:...)``,
+alternation ``|``, quantifiers ``* + ? {m} {m,} {m,n}`` (lazy variants
+accepted — laziness is irrelevant for boolean matching), anchors
+``^ $`` plus ``\\A`` / ``\\Z`` (≡ ^/$ in the single-line bytes
+domain), and a whole-pattern ``(?i)`` prefix.
 
 The reference has no counterpart (filtering is new per the north star);
 the CPU baseline is Python ``re`` (≙ Go ``regexp`` in klogs' world,
@@ -76,6 +77,13 @@ class Alt:
 @dataclass(frozen=True)
 class Star:
     inner: object
+
+
+def _is_bare_assertion(node: object) -> bool:
+    """A bare anchor or \\b/\\B — re's 'nothing to repeat' targets;
+    a group containing one ((?:\\b)?) is legal and wrapped in _atom."""
+    return isinstance(node, Boundary) or (
+        isinstance(node, Sym) and node.sentinel is not None)
 
 
 _CLASS_D = frozenset(range(0x30, 0x3A))
@@ -250,8 +258,7 @@ class _Parser:
                 " is not supported (possessive/atomic matching cannot be"
                 " expressed by an NFA; group with (?:...) if you meant"
                 " nested repetition)")
-        if (isinstance(node, Boundary)
-                or (isinstance(node, Sym) and node.sentinel is not None)):
+        if _is_bare_assertion(node):
             raise RegexSyntaxError(
                 f"nothing to repeat at position {self.pos} (quantifier"
                 " applied to an anchor or \\b assertion, as in re)")
@@ -305,19 +312,28 @@ class _Parser:
     def _atom(self) -> object:
         c = self._next()
         if c == 0x28:  # '('
+            scoped_flag: bool | None = None
             if self._peek() == 0x3F:  # '(?'
                 self.pos += 1
                 n = self._peek()
                 if n == 0x3A:  # non-capturing
                     self.pos += 1
+                elif n == 0x69 and self.src[self.pos:self.pos + 2] == b"i:":
+                    self.pos += 2  # (?i:...) scoped case-insensitivity
+                    scoped_flag, self.ignore_case = self.ignore_case, True
+                elif n == 0x2D and self.src[self.pos:self.pos + 3] == b"-i:":
+                    self.pos += 3  # (?-i:...) scoped case-sensitivity
+                    scoped_flag, self.ignore_case = self.ignore_case, False
                 else:
                     raise RegexSyntaxError(
-                        "only (?:...) groups supported (no lookaround/named groups)"
+                        "only (?:...) / (?i:...) / (?-i:...) groups supported "
+                        "(no lookaround/named groups)"
                     )
             node = self._alt()
+            if scoped_flag is not None:
+                self.ignore_case = scoped_flag
             self._expect(0x29)
-            if isinstance(node, Boundary) or (
-                    isinstance(node, Sym) and node.sentinel is not None):
+            if _is_bare_assertion(node):
                 # re's "nothing to repeat" applies to a BARE anchor or
                 # assertion, not a group containing one ((?:\b)? is
                 # legal); a one-part Cat defeats _reject_bad_repeat
@@ -340,6 +356,12 @@ class _Parser:
             if n == 0x42:  # \B
                 self.pos += 1
                 return Boundary(negate=True)
+            if n == 0x41:  # \A — start of string; ≡ ^ here (single-line
+                self.pos += 1  # bytes domain, no MULTILINE)
+                return self._leaf(sentinel=BEGIN)
+            if n == 0x5A:  # \Z — end of string; ≡ $ (re bytes semantics)
+                self.pos += 1
+                return self._leaf(sentinel=END)
             return self._sym(self._escape(in_class=False))
         if c in (0x2A, 0x2B, 0x3F):  # quantifier with nothing to repeat
             raise RegexSyntaxError(f"nothing to repeat before {chr(c)!r}")

@@ -389,3 +389,31 @@ def test_word_boundary_through_engine():
 
     want = [any(_re.search(p.encode(), ln) for p in pats) for ln in lines]
     assert filt.match_lines(lines) == want
+
+
+def test_scoped_flags_and_string_anchors_vs_re():
+    """(?i:...) / (?-i:...) scoped case flags and \\A / \\Z string
+    anchors (≡ ^ / $ in the single-line bytes domain) — verified
+    against re, including nesting and casefold-before-negation."""
+    import re as _re
+
+    cases = [
+        (r"(?i:foo)bar", [b"FOObar", b"fooBAR", b"foobar"]),
+        (r"(?i)a(?-i:B)c", [b"AbC", b"ABC", b"abc"]),
+        (r"x(?i:[a-c])y", [b"xAy", b"xdy", b"xby"]),
+        (r"(?i:[^a])", [b"a", b"A", b"b"]),
+        (r"(?i:err(?-i:X)or)", [b"ERRXOR", b"errXor", b"errxor"]),
+        (r"\Afoo", [b"foo", b"xfoo"]),
+        (r"foo\Z", [b"foo", b"foox"]),
+        (r"a\Ab", [b"ab"]),
+        (r"\A\b\w+\b\Z", [b"word", b"two words", b"", b"hy-phen"]),
+    ]
+    for pat, lines in cases:
+        prog = compile_patterns([pat])
+        for ln in lines:
+            got = reference_match(prog, ln)
+            want = bool(_re.search(pat.encode(), ln))
+            assert got == want, f"{pat!r} on {ln!r}: got {got} want {want}"
+    for pat in (r"[\A]", r"\A+", r"(?j:x)", r"(?-:x)"):
+        with pytest.raises(RegexSyntaxError):
+            compile_patterns([pat])

@@ -53,7 +53,7 @@ def rand_pattern(rng: random.Random, depth: int = 0) -> str:
     if kind == "dot":
         return "."
     if kind == "anchor":
-        return rng.choice(["^", "$"])
+        return rng.choice(["^", "$", r"\A", r"\Z"])
     if kind == "boundary":
         return rng.choice([r"\b", r"\b", r"\B"])
     if kind == "escape":
@@ -65,7 +65,8 @@ def rand_pattern(rng: random.Random, depth: int = 0) -> str:
     if kind == "alt":
         return f"(?:{rand_pattern(rng, depth + 1)}|{rand_pattern(rng, depth + 1)})"
     if kind == "group":
-        return f"({rand_pattern(rng, depth + 1)})"
+        opener = rng.choice(["(", "(", "(", "(?i:", "(?-i:"])
+        return f"{opener}{rand_pattern(rng, depth + 1)})"
     inner = rand_pattern(rng, depth + 1)
     if not inner or inner[-1] in "*+?}":
         inner = f"(?:{inner})"
