@@ -153,3 +153,17 @@ def test_engine_filter_routes_cls_to_mesh():
     f = NFAEngineFilter(pats, engine=eng, kernel="interpret")
     lines = [b"ERROR x", b"ok", b"WARN q 7", b"panic: z", b"WARN but none"] * 8
     assert f.match_lines(lines) == RegexFilter(pats).match_lines(lines)
+
+
+def test_boundary_patterns_across_pattern_shards():
+    """\\b/\\B automata carry extra context/boundary-check positions and
+    BEGIN/END sentinel memberships; pattern-sharded stacking
+    (stack_programs re-lays classes) and the mesh hot path must
+    preserve them."""
+    pats = [r"\berror\b", r"code=50[34]", r"warn\B", r"\bFATAL",
+            r"x\d+\b"]
+    eng = MeshEngine(pats, grid=(4, 2))
+    f = NFAEngineFilter(pats, engine=eng)
+    lines = [b"error", b"errors", b"an error.", b"code=503", b"warned",
+             b"warn", b"FATAL x", b"xFATAL", b"x42", b"x42y", b"", b"-"] * 2
+    assert f.match_lines(lines) == [oracle(pats, ln) for ln in lines]
