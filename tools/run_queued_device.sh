@@ -1,41 +1,46 @@
 #!/bin/sh
 # Round-4 queued device measurements (BASELINE.md "Pending device
-# measurements"), run in order with per-tool attach retries. The axon
-# tunnel wedges transiently (attach hangs inside backend init), so each
-# tool gets a hard per-attempt timeout and several attempts spread over
-# time. Logs land next to this script's repo root as .{bench_r4,
-# fused_ab,service_bench}.log; progress markers go to .queued_status.
+# measurements"), gated on a successful tunnel probe. The axon tunnel
+# wedges for long stretches (attach hangs inside backend init), so:
+# probe cheaply in a loop; when an attach succeeds, run the whole queue
+# back-to-back in that healthy window. Logs: .{bench_r4,fused_ab,
+# service_bench}.log at the repo root; progress markers in
+# .queued_status. Overall deadline ~6h from launch.
 set -u
 cd "$(dirname "$0")/.."
 status() { echo "$(date -u +%H:%M:%S) $*" >> .queued_status; }
 
-status "start"
-# 1. Headline bench (has its own attach-retry loop inside).
-KLOGS_BENCH_DEVICE_TIMEOUT_S=5400 timeout 6000 python -u bench.py \
-    > .bench_r4.log 2>&1
-status "bench.py rc=$?"
+deadline=$(( $(date +%s) + 21600 ))
+status "watchdog start (deadline +6h)"
+bench_done=0; ab_done=0; svc_done=0
 
-# 2. Fused-groups A/B (attaches in-process; retry around it).
-i=0
-while [ $i -lt 8 ]; do
-    i=$((i+1))
-    timeout 900 python -u tools/bench_fused_ab.py >> .fused_ab.log 2>&1
-    rc=$?
-    status "bench_fused_ab attempt $i rc=$rc"
-    [ $rc -eq 0 ] && break
-    [ $rc -eq 1 ] && break   # divergence: hard fail, do not retry
-    sleep 60
+while [ "$(date +%s)" -lt "$deadline" ]; do
+    if ! timeout 90 python -c "import jax; jax.devices()" 2>/dev/null; then
+        sleep 75
+        continue
+    fi
+    status "probe OK — tunnel healthy, running queue"
+    if [ "$bench_done" -eq 0 ]; then
+        KLOGS_BENCH_DEVICE_TIMEOUT_S=1500 timeout 1800 python -u bench.py \
+            >> .bench_r4.log 2>&1 && bench_done=1
+        status "bench.py rc=$? done=$bench_done"
+    fi
+    if [ "$ab_done" -eq 0 ]; then
+        timeout 1800 python -u tools/bench_fused_ab.py >> .fused_ab.log 2>&1
+        rc=$?
+        [ $rc -eq 0 ] && ab_done=1
+        [ $rc -eq 1 ] && ab_done=1  # divergence: hard fail, do not retry
+        status "bench_fused_ab rc=$rc done=$ab_done"
+    fi
+    if [ "$svc_done" -eq 0 ]; then
+        timeout 900 python -u tools/bench_service.py --backend tpu \
+            >> .service_bench.log 2>&1 && svc_done=1
+        status "bench_service rc=$? done=$svc_done"
+    fi
+    if [ "$bench_done" -eq 1 ] && [ "$ab_done" -eq 1 ] && [ "$svc_done" -eq 1 ]; then
+        status "all done"
+        exit 0
+    fi
+    sleep 75
 done
-
-# 3. gRPC service bench on the TPU backend.
-i=0
-while [ $i -lt 5 ]; do
-    i=$((i+1))
-    timeout 900 python -u tools/bench_service.py --backend tpu \
-        >> .service_bench.log 2>&1
-    rc=$?
-    status "bench_service attempt $i rc=$rc"
-    [ $rc -eq 0 ] && break
-    sleep 60
-done
-status "done"
+status "deadline reached: bench=$bench_done ab=$ab_done svc=$svc_done"
