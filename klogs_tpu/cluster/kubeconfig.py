@@ -13,6 +13,11 @@ bearer tokens (inline or tokenFile), and exec-plugin credential helpers
 it via clientcmd at cmd/root.go:76): the helper command runs
 non-interactively, its ExecCredential JSON yields a token or client
 cert, and the result is cached until its expirationTimestamp.
+
+When NO kubeconfig file exists, credentials fall back to the in-cluster
+service account (rest.InClusterConfig analog) — the deployment mode of
+a collector running as a pod. A kubeconfig that exists but is malformed
+stays a hard error, as in client-go.
 """
 
 import base64
@@ -29,6 +34,12 @@ import yaml
 
 class KubeconfigError(RuntimeError):
     pass
+
+
+class KubeconfigMissing(KubeconfigError):
+    """No kubeconfig file exists at any candidate path — the only case
+    that falls through to in-cluster credentials (a file that exists
+    but is malformed stays a hard error, as in client-go)."""
 
 
 @dataclass
@@ -111,7 +122,7 @@ def _merge_configs(paths: list[str]) -> dict:
         if not merged["current-context"] and cfg.get("current-context"):
             merged["current-context"] = cfg["current-context"]
     if not loaded_any:
-        raise KubeconfigError(
+        raise KubeconfigMissing(
             f"no kubeconfig found at {os.pathsep.join(paths)}"
         )
     return merged
@@ -234,8 +245,78 @@ def exec_credential(spec: dict, force: bool = False) -> dict:
     return status
 
 
+# Kubelet-mounted service-account directory (rest.InClusterConfig).
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+def in_cluster_creds() -> "ClusterCreds | None":
+    """client-go rest.InClusterConfig analog: when running inside a pod,
+    the kubelet mounts a service-account token + CA and the apiserver
+    address is in the environment. Returns None when not in a pod.
+
+    The token is re-read from the mounted file on every refresh: bound
+    service-account tokens rotate (~1h) and the kubelet updates the
+    file, so a long --follow survives rotation (client-go re-reads
+    periodically for the same reason)."""
+    # client-go ErrNotInCluster semantics: BOTH env vars must be
+    # non-empty (a set-but-empty value means "not in a pod", never a
+    # default port).
+    host = os.environ.get("KUBERNETES_SERVICE_HOST")
+    port = os.environ.get("KUBERNETES_SERVICE_PORT")
+    token_path = os.path.join(SA_DIR, "token")
+    ca_path = os.path.join(SA_DIR, "ca.crt")
+    if not host or not port or not os.path.exists(token_path):
+        return None
+    if ":" in host and not host.startswith("["):
+        host = f"[{host}]"  # IPv6 (client-go: net.JoinHostPort)
+    try:
+        ssl_ctx = (ssl.create_default_context(cafile=ca_path)
+                   if os.path.exists(ca_path)
+                   else ssl.create_default_context())
+    except ssl.SSLError as e:
+        # Keep the module's error contract: a corrupt mounted CA must
+        # surface as the friendly fatal, not a raw traceback.
+        raise KubeconfigError(
+            f"in-cluster CA bundle {ca_path} is unusable: {e}") from e
+    try:
+        with open(os.path.join(SA_DIR, "namespace")) as f:
+            namespace = f.read().strip() or "default"
+    except OSError:
+        namespace = "default"
+
+    def provider(force: bool = False) -> "str | None":
+        try:
+            with open(token_path) as f:
+                return f.read().strip() or None
+        except OSError:
+            return None
+
+    return ClusterCreds(
+        context_name="in-cluster",
+        namespace=namespace,
+        server=f"https://{host}:{port}",
+        ssl_context=ssl_ctx,
+        token=provider(),
+        token_provider=provider,
+    )
+
+
 def load_creds(kubeconfig: str = "") -> ClusterCreds:
-    paths = [kubeconfig] if kubeconfig else kubeconfig_paths()
+    if not kubeconfig:
+        # client-go fallback order: kubeconfig file(s) first, then the
+        # in-cluster service account when no file exists (the common
+        # case for a collector running as a pod).
+        try:
+            return _file_creds(kubeconfig_paths())
+        except KubeconfigMissing:
+            creds = in_cluster_creds()
+            if creds is not None:
+                return creds
+            raise
+    return _file_creds([kubeconfig])
+
+
+def _file_creds(paths: list[str]) -> ClusterCreds:
     cfg = _merge_configs(paths)
     path_desc = os.pathsep.join(paths)
 

@@ -19,6 +19,19 @@ engine feeds a virtual BEGIN before byte 0 and END after the last
 byte, so ^/$ need no special-casing here and nullability of the
 symbol-regex is exactly "matches every line" (match_all).
 
+Word-boundary assertions (\\b/\\B) also compile to static structure,
+with zero runtime cost: every pair of consecutively consumed symbols
+has one adjacency relation (word-categories equal / differ / the
+BEGIN→END empty-line pair), an assertion is a constraint on the
+relation, and constraints intersect through sequencing and union
+through alternation. Mid-pattern assertions filter follow edges (over
+category-pure, pre-split positions); leading ones route injection
+through always-injected context positions that track the previous
+symbol's category; trailing ones route acceptance through
+boundary-check positions that consume the next symbol. See
+compile_patterns for the wiring and the interpreter-probed empty-line
+rule.
+
 Byte-class compression: bytes with identical membership across all
 position symbol-sets collapse to one class, so the character-mask
 table is [n_classes, S] with n_classes typically ≪ 256.

@@ -581,3 +581,70 @@ def test_inline_tls_material_deleted(tmp_path, monkeypatch):
         assert leftovers == []
     finally:
         _tf.tempdir = None
+
+
+def test_in_cluster_fallback(tmp_path, monkeypatch):
+    """rest.InClusterConfig analog: no kubeconfig file + mounted
+    service-account dir + env -> in-cluster creds, with the token
+    re-read per refresh (bound SA tokens rotate)."""
+    from klogs_tpu.cluster import kubeconfig as kc
+
+    sa = tmp_path / "sa"
+    sa.mkdir()
+    (sa / "token").write_text("sa-token-1\n")
+    (sa / "namespace").write_text("prod\n")
+    monkeypatch.setattr(kc, "SA_DIR", str(sa))
+    monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.0.0.1")
+    monkeypatch.setenv("KUBERNETES_SERVICE_PORT", "6443")
+    monkeypatch.setenv("KUBECONFIG", str(tmp_path / "nope"))
+
+    creds = load_creds()
+    assert creds.context_name == "in-cluster"
+    assert creds.server == "https://10.0.0.1:6443"
+    assert creds.namespace == "prod"
+    assert creds.current_token() == "sa-token-1"
+    # Rotation: the mounted file changes; the next refresh sees it.
+    (sa / "token").write_text("sa-token-2\n")
+    assert creds.current_token() == "sa-token-2"
+
+
+def test_in_cluster_not_in_pod_keeps_kubeconfig_error(tmp_path, monkeypatch):
+    from klogs_tpu.cluster import kubeconfig as kc
+
+    monkeypatch.setattr(kc, "SA_DIR", str(tmp_path / "absent"))
+    monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.0.0.1")
+    monkeypatch.setenv("KUBECONFIG", str(tmp_path / "nope"))
+    with pytest.raises(KubeconfigError, match="no kubeconfig found"):
+        load_creds()
+
+
+def test_malformed_kubeconfig_does_not_fall_back(tmp_path, monkeypatch):
+    """A kubeconfig that EXISTS but is broken must stay a hard error
+    even inside a pod (client-go semantics) — silent fallback would
+    mask the user's config mistake."""
+    from klogs_tpu.cluster import kubeconfig as kc
+
+    sa = tmp_path / "sa"
+    sa.mkdir()
+    (sa / "token").write_text("t")
+    monkeypatch.setattr(kc, "SA_DIR", str(sa))
+    monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.0.0.1")
+    bad = tmp_path / "kc"
+    bad.write_text("{not yaml: [")
+    monkeypatch.setenv("KUBECONFIG", str(bad))
+    with pytest.raises(KubeconfigError, match="not valid YAML"):
+        load_creds()
+
+
+def test_in_cluster_ipv6_host(tmp_path, monkeypatch):
+    from klogs_tpu.cluster import kubeconfig as kc
+
+    sa = tmp_path / "sa"
+    sa.mkdir()
+    (sa / "token").write_text("t")
+    monkeypatch.setattr(kc, "SA_DIR", str(sa))
+    monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "fd00::1")
+    monkeypatch.setenv("KUBERNETES_SERVICE_PORT", "443")
+    monkeypatch.setenv("KUBECONFIG", str(tmp_path / "nope"))
+    creds = load_creds()
+    assert creds.server == "https://[fd00::1]:443"
