@@ -224,6 +224,23 @@ def main(argv: list[str] | None = None) -> int:
         return 1
     except KeyboardInterrupt:
         return 130
+    except Exception as e:
+        # --remote surprises (pattern handshake mismatch, bad transport
+        # security config): still one line + exit 1, matching the
+        # reference's pterm.Fatal style. Lazy + guarded import: grpc is
+        # optional, and an ImportError here must not mask the original
+        # exception.
+        try:
+            from klogs_tpu.service.client import (
+                PatternMismatch,
+                ServiceConfigError,
+            )
+        except ImportError:
+            raise e
+        if isinstance(e, (PatternMismatch, ServiceConfigError)):
+            term.error("%s", e)
+            return 1
+        raise
 
 
 if __name__ == "__main__":

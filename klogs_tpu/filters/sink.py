@@ -236,14 +236,30 @@ def make_pipeline(patterns: list[str], backend: str,
     stats = FilterStats()
     service = None
     if remote is not None:
+        import os
+
         from klogs_tpu.service.client import RemoteFilterClient
 
+        # Transport security for the cross-node collector->filterd hop,
+        # via env (a --remote deployment is configured by manifest, not
+        # interactive flags): KLOGS_REMOTE_TLS_CA switches to TLS,
+        # _TLS_CERT/_TLS_KEY add mTLS, _TOKEN_FILE attaches bearer auth
+        # (passed as a path: the client re-reads it per RPC, so a
+        # rotated mounted Secret keeps working mid-follow). A bad combo
+        # raises ServiceConfigError, which the CLI maps to one friendly
+        # line — no SystemExit from library code.
+        service = RemoteFilterClient(
+            remote,
+            tls_ca=os.environ.get("KLOGS_REMOTE_TLS_CA"),
+            tls_cert=os.environ.get("KLOGS_REMOTE_TLS_CERT"),
+            tls_key=os.environ.get("KLOGS_REMOTE_TLS_KEY"),
+            auth_token_file=os.environ.get("KLOGS_REMOTE_TOKEN_FILE"))
         return FilterPipeline(
             log_filter=None,
             stats=stats,
             batch_lines=batch_lines or 8192,
             deadline_s=deadline_s,
-            service=RemoteFilterClient(remote),
+            service=service,
             patterns=patterns,
             ignore_case=ignore_case,
         )

@@ -24,14 +24,38 @@ def main() -> None:
                     "rejects them)")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=50051)
+    ap.add_argument("--tls-cert", help="PEM server certificate (enables TLS)")
+    ap.add_argument("--tls-key", help="PEM server private key")
+    ap.add_argument("--tls-client-ca",
+                    help="PEM CA bundle; require+verify client certs (mTLS)")
+    ap.add_argument("--auth-token-file",
+                    help="file with a shared bearer token (e.g. a mounted "
+                    "Kubernetes Secret); RPCs without it are rejected")
     ns = ap.parse_args()
+    if ns.auth_token_file:
+        # Fail fast on a bad path/empty file; the server re-reads the
+        # file per RPC afterwards so Secret rotation needs no restart.
+        try:
+            with open(ns.auth_token_file) as f:
+                if not f.read().strip():
+                    ap.error(f"--auth-token-file {ns.auth_token_file} is empty")
+        except OSError as e:
+            ap.error(f"cannot read --auth-token-file: {e}")
     try:
         asyncio.run(serve(ns.match, ns.backend, ns.host, ns.port,
-                          ignore_case=ns.ignore_case))
+                          ignore_case=ns.ignore_case,
+                          tls_cert=ns.tls_cert, tls_key=ns.tls_key,
+                          tls_client_ca=ns.tls_client_ca,
+                          auth_token_file=ns.auth_token_file))
     except KeyboardInterrupt:
         pass
-    except RegexSyntaxError as e:
+    except RegexSyntaxError as e:  # subclasses ValueError: catch first
         print(f"unsupported --match pattern: {e}", file=sys.stderr)
+        raise SystemExit(1)
+    except ValueError as e:
+        # FilterServer validates TLS pairing (cert+key, client-ca needs
+        # both) — surface as the friendly one-liner.
+        print(f"klogs-filterd: {e}", file=sys.stderr)
         raise SystemExit(1)
 
 

@@ -16,18 +16,99 @@ class PatternMismatch(RuntimeError):
     pass
 
 
+class ServiceConfigError(ValueError):
+    """Invalid/partial transport-security configuration or unreadable
+    credential material — surfaced as one friendly fatal line by the
+    CLI, never a silent insecure fallback."""
+
+
+def _read(path: str, what: str) -> bytes:
+    try:
+        with open(path, "rb") as f:
+            return f.read()
+    except OSError as e:
+        raise ServiceConfigError(f"cannot read {what} {path}: {e}") from e
+
+
 class RemoteFilterClient:
-    def __init__(self, target: str):
+    """``tls_ca`` switches the channel to TLS (server verified against
+    that bundle); ``tls_cert``/``tls_key`` add a client certificate
+    (mTLS). ``auth_token`` (or ``auth_token_file``, re-read per RPC so
+    a rotated mounted Secret keeps working) attaches ``authorization:
+    Bearer <token>`` metadata to every RPC. All default off — see
+    FilterServer for the matching server-side knobs. Partial TLS
+    configuration is an error, never a silent plaintext fallback; a
+    bearer token over plaintext earns a warning (it travels in the
+    clear)."""
+
+    def __init__(self, target: str, tls_ca: str | None = None,
+                 tls_cert: str | None = None, tls_key: str | None = None,
+                 auth_token: str | None = None,
+                 auth_token_file: str | None = None):
+        if (tls_cert or tls_key) and not tls_ca:
+            raise ServiceConfigError(
+                "tls_cert/tls_key (mTLS) require tls_ca — refusing to "
+                "silently open an insecure channel")
+        if bool(tls_cert) != bool(tls_key):
+            raise ServiceConfigError(
+                "tls_cert and tls_key must be provided together")
+        if auth_token and auth_token_file:
+            raise ServiceConfigError(
+                "pass auth_token OR auth_token_file, not both")
         self._target = target
-        self._channel = grpc.aio.insecure_channel(target, options=[
+        if auth_token_file:
+            _read(auth_token_file, "bearer token file")  # fail fast,
+            # BEFORE any channel exists or warning prints
+        options = [
             ("grpc.max_receive_message_length", 256 * 1024 * 1024),
             ("grpc.max_send_message_length", 256 * 1024 * 1024),
-        ])
+        ]
+        if tls_ca:
+            creds = grpc.ssl_channel_credentials(
+                root_certificates=_read(tls_ca, "TLS CA bundle"),
+                private_key=_read(tls_key, "TLS client key") if tls_key else None,
+                certificate_chain=_read(tls_cert, "TLS client cert")
+                if tls_cert else None)
+            self._channel = grpc.aio.secure_channel(target, creds,
+                                                    options=options)
+        else:
+            if auth_token or auth_token_file:
+                from klogs_tpu.ui import term
+
+                term.warning(
+                    "bearer token to %s travels over PLAINTEXT "
+                    "(set KLOGS_REMOTE_TLS_CA to encrypt the hop)", target)
+            self._channel = grpc.aio.insecure_channel(target, options=options)
+        self._auth_token = auth_token
+        self._auth_token_file = auth_token_file
         self._match_rpc = self._channel.unary_unary(transport.MATCH)
         self._hello_rpc = self._channel.unary_unary(transport.HELLO)
 
+    def _metadata(self):
+        token = self._auth_token
+        if self._auth_token_file:
+            # An unreadable token file names ITSELF as the failure — a
+            # silent unauthenticated RPC would blame the server/token
+            # value instead of the local path.
+            token = _read(self._auth_token_file,
+                          "bearer token file").decode().strip()
+        return (("authorization", f"Bearer {token}"),) if token else None
+
+    def _friendly(self, e: "grpc.aio.AioRpcError"):
+        # One clean line instead of a grpc traceback: reuse the CLI's
+        # ClusterError path (control-plane-failure UX, cli.py).
+        from klogs_tpu.cluster.backend import ClusterError
+
+        return ClusterError(
+            f"filter service at {self._target}: "
+            f"{e.code().name}: {e.details()}")
+
     async def hello(self) -> dict:
-        return transport.unpack(await self._hello_rpc(b""))
+        try:
+            return transport.unpack(
+                await self._hello_rpc(b"", metadata=self._metadata()))
+        except grpc.aio.AioRpcError as e:
+            raise self._friendly(e) from e
 
     async def verify_patterns(self, patterns: list[str],
                               ignore_case: bool = False) -> None:
@@ -47,7 +128,12 @@ class RemoteFilterClient:
             )
 
     async def match(self, lines: list[bytes]) -> list[bool]:
-        resp = await self._match_rpc(transport.encode_match_request(lines))
+        try:
+            resp = await self._match_rpc(
+                transport.encode_match_request(lines),
+                metadata=self._metadata())
+        except grpc.aio.AioRpcError as e:
+            raise self._friendly(e) from e
         return transport.decode_match_response(resp)
 
     async def aclose(self) -> None:

@@ -6,9 +6,27 @@ Run standalone:  python -m klogs_tpu.service --match ERROR --match 'WARN.*' \
 All client batches funnel into one AsyncFilterService, so concurrent
 collectors coalesce into shared device batches (the device's efficient
 regime) regardless of how small each client's flushes are.
+
+Transport security (for the collector-in-cluster -> filterd-near-TPU
+deployment, where the hop crosses node boundaries):
+
+- TLS: ``tls_cert``/``tls_key`` serve over TLS; adding
+  ``tls_client_ca`` requires and verifies client certificates (mTLS).
+- Bearer auth: ``auth_token`` (or ``auth_token_file``, re-read per RPC
+  so a rotated mounted Secret keeps working without a restart) rejects
+  any RPC not carrying ``authorization: Bearer <token>`` metadata with
+  UNAUTHENTICATED — the cert-free option a Kubernetes Secret deploys in
+  one line. Token-only mode over plaintext sends the secret in the
+  clear; combine with TLS on untrusted networks (the server prints a
+  reminder).
+
+Both default off: the localhost/co-located case stays zero-config.
+Partial TLS configuration (cert without key, client-ca without cert) is
+a constructor error, never a silent plaintext fallback.
 """
 
 import asyncio
+import hmac
 
 import grpc
 
@@ -31,17 +49,66 @@ def _make_filter(patterns: list[str], backend: str,
 class FilterServer:
     def __init__(self, patterns: list[str], backend: str = "tpu",
                  host: str = "127.0.0.1", port: int = 50051,
-                 ignore_case: bool = False):
+                 ignore_case: bool = False,
+                 tls_cert: str | None = None, tls_key: str | None = None,
+                 tls_client_ca: str | None = None,
+                 auth_token: str | None = None,
+                 auth_token_file: str | None = None):
+        if bool(tls_cert) != bool(tls_key):
+            raise ValueError(
+                "tls_cert and tls_key must be provided together "
+                "(refusing to fall back to plaintext on partial TLS config)")
+        if tls_client_ca and not tls_cert:
+            raise ValueError("tls_client_ca (mTLS) requires tls_cert/tls_key")
+        if auth_token and auth_token_file:
+            raise ValueError("pass auth_token OR auth_token_file, not both")
         self.patterns = list(patterns)
         self.backend = backend
         self.host = host
         self.port = port
         self.ignore_case = ignore_case
+        self.tls_cert = tls_cert
+        self.tls_key = tls_key
+        self.tls_client_ca = tls_client_ca
+        self.auth_token = auth_token
+        self.auth_token_file = auth_token_file
         self._service = AsyncFilterService(
             _make_filter(patterns, backend, ignore_case=ignore_case))
         self._server: grpc.aio.Server | None = None
 
+    @property
+    def auth_enabled(self) -> bool:
+        return bool(self.auth_token or self.auth_token_file)
+
+    def _current_token(self) -> str | None:
+        if self.auth_token_file:
+            # Re-read per check: a rotated mounted Secret (kubelet
+            # updates the file) keeps authenticating without a restart
+            # — same rationale as kubeconfig.in_cluster_creds.
+            try:
+                with open(self.auth_token_file) as f:
+                    return f.read().strip() or None
+            except OSError:
+                return None
+        return self.auth_token
+
+    async def _check_auth(self, context) -> bool:
+        if not self.auth_enabled:
+            return True
+        token = self._current_token()
+        meta = dict(context.invocation_metadata() or ())
+        got = meta.get("authorization", "")
+        # Compare utf-8 bytes: compare_digest on str raises TypeError
+        # for non-ASCII, which would turn every RPC into UNKNOWN.
+        if token and hmac.compare_digest(
+                got.encode(), f"Bearer {token}".encode()):
+            return True
+        await context.abort(grpc.StatusCode.UNAUTHENTICATED,
+                            "missing or wrong bearer token")
+        return False  # unreachable; abort raises
+
     async def _hello(self, request: bytes, context) -> bytes:
+        await self._check_auth(context)
         return transport.pack({
             "patterns": self.patterns,
             "ignore_case": self.ignore_case,
@@ -50,6 +117,7 @@ class FilterServer:
         })
 
     async def _match(self, request: bytes, context) -> bytes:
+        await self._check_auth(context)
         lines = transport.decode_match_request(request)
         mask = await self._service.match(lines)
         return transport.encode_match_response(mask)
@@ -72,7 +140,27 @@ class FilterServer:
             ("grpc.max_send_message_length", 256 * 1024 * 1024),
         ])
         self._server.add_generic_rpc_handlers((handler,))
-        self.port = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        addr = f"{self.host}:{self.port}"
+        if self.tls_cert and self.tls_key:
+            def read(path, what):
+                try:
+                    with open(path, "rb") as f:
+                        return f.read()
+                except OSError as e:
+                    # ValueError: __main__'s friendly one-liner path.
+                    raise ValueError(
+                        f"cannot read {what} {path}: {e}") from e
+
+            key = read(self.tls_key, "TLS key")
+            cert = read(self.tls_cert, "TLS certificate")
+            ca = (read(self.tls_client_ca, "client CA bundle")
+                  if self.tls_client_ca else None)
+            creds = grpc.ssl_server_credentials(
+                [(key, cert)], root_certificates=ca,
+                require_client_auth=ca is not None)
+            self.port = self._server.add_secure_port(addr, creds)
+        else:
+            self.port = self._server.add_insecure_port(addr)
         await self._server.start()
         return self.port
 
@@ -86,12 +174,22 @@ class FilterServer:
 
 
 async def serve(patterns: list[str], backend: str, host: str, port: int,
-                ignore_case: bool = False) -> None:
+                ignore_case: bool = False, **security) -> None:
     server = FilterServer(patterns, backend, host=host, port=port,
-                       ignore_case=ignore_case)
+                          ignore_case=ignore_case, **security)
     bound = await server.start()
-    print(f"klogs filterd: serving {len(patterns)} pattern(s) "
-          f"[{backend}] on {host}:{bound}", flush=True)
+    mode = "TLS" if server.tls_cert else "plaintext"
+    if server.tls_client_ca:
+        mode = "mTLS"
+    if server.auth_enabled:
+        mode += "+bearer"
+        if not server.tls_cert:
+            print("klogs filterd: WARNING bearer auth over plaintext sends "
+                  "the token in the clear; add --tls-cert/--tls-key on "
+                  "untrusted networks", flush=True)
+    print(f"klogs filterd: serving {len(server.patterns)} pattern(s) "
+          f"[{server.backend}] on {server.host}:{bound} ({mode})",
+          flush=True)
     try:
         await server.wait()
     finally:
