@@ -17,11 +17,13 @@ Supported syntax: literals, ``.``, escapes (\\d \\D \\w \\W \\s \\S
 assertions ``\\b`` / ``\\B`` (compiled to static edge constraints in
 glushkov.py — no runtime cost), character classes ``[...]`` with
 ranges and negation (``[\\b]`` is backspace, as in re), grouping
-``(...)`` / ``(?:...)``, scoped case flags ``(?i:...)`` / ``(?-i:...)``,
-alternation ``|``, quantifiers ``* + ? {m} {m,} {m,n}`` (lazy variants
-accepted — laziness is irrelevant for boolean matching), anchors
-``^ $`` plus ``\\A`` / ``\\Z`` (≡ ^/$ in the single-line bytes
-domain), and a whole-pattern ``(?i)`` prefix.
+``(...)`` / ``(?:...)``, scoped flag groups over ``i`` (ignore-case)
+and ``s`` (DOTALL) — ``(?i:...)``, ``(?-i:...)``, ``(?s:...)``,
+``(?i-s:...)`` etc. — alternation ``|``, quantifiers ``* + ? {m} {m,}
+{m,n}`` (lazy variants accepted — laziness is irrelevant for boolean
+matching), anchors ``^ $`` plus ``\\A`` / ``\\Z`` (≡ ^/$ in the
+single-line bytes domain), and whole-pattern ``(?i)`` / ``(?s)`` /
+``(?si)`` prefixes.
 
 The reference has no counterpart (filtering is new per the north star);
 the CPU baseline is Python ``re`` (≙ Go ``regexp`` in klogs' world,
@@ -141,6 +143,7 @@ class _Parser:
         self.src = pattern.encode("utf-8")
         self.pos = 0
         self.ignore_case = ignore_case
+        self.dotall = False
         self.n_leaves = 0
         self.max_positions = max_positions_cap()  # read once per parse
 
@@ -177,11 +180,62 @@ class _Parser:
         return self._leaf(bytes_=byte_set)
 
     # -- grammar ---------------------------------------------------------
+    _FLAG_ATTR = {0x69: "ignore_case", 0x73: "dotall"}  # i, s
+
+    def _scan_flags(self) -> "tuple[list[int], list[int]] | None":
+        """At a position just past ``(?``: consume ``[is]*(-[is]+)?:``
+        and return (positive, negative) flag byte lists, or None (cursor
+        restored) when this is not a flags/plain group — the caller
+        rejects with the group-syntax message. An unknown flag letter is
+        its own loud error, named. The plain ``(?:`` form is the empty
+        case. Global ``(?i)``-style prefixes are handled in parse()."""
+        start = self.pos
+        pos_flags: list[int] = []
+        neg_flags: list[int] = []
+        bucket = pos_flags
+        while True:
+            c = self._peek()
+            if c in self._FLAG_ATTR:
+                self.pos += 1
+                bucket.append(c)
+            elif c == 0x2D and bucket is pos_flags:  # '-'
+                self.pos += 1
+                bucket = neg_flags
+            elif c == 0x3A:  # ':'
+                self.pos += 1
+                if bucket is neg_flags and not neg_flags:
+                    break  # '(?-:' — not a valid flags group
+                if set(pos_flags) & set(neg_flags):
+                    raise RegexSyntaxError(
+                        "inline flag turned on and off in the same "
+                        "group, as in re")
+                return pos_flags, neg_flags
+            elif c is not None and chr(c).isalpha():
+                raise RegexSyntaxError(
+                    f"unsupported inline flag {chr(c)!r} (only i and s)")
+            else:
+                break
+        self.pos = start
+        return None
+
     def parse(self) -> object:
-        # Whole-pattern (?i) prefix only (inline scoped flags unsupported).
-        if self.src.startswith(b"(?i)"):
-            self.ignore_case = True
-            self.pos = 4
+        # Whole-pattern global flags — (?i) (?s) (?si) ... — at the
+        # start only, as in re ("global flags not at the start of the
+        # expression" is re's error for the misplaced form, which the
+        # group parser rejects loudly here too).
+        while self.src[self.pos:self.pos + 2] == b"(?":
+            saved = self.pos
+            self.pos += 2
+            flags: list[int] = []
+            while self._peek() in self._FLAG_ATTR:
+                flags.append(self._next())
+            if flags and self._peek() == 0x29:  # ')'
+                self.pos += 1
+                for f in flags:
+                    setattr(self, self._FLAG_ATTR[f], True)
+            else:
+                self.pos = saved
+                break
         node = self._alt()
         if self.pos != len(self.src):
             raise RegexSyntaxError(
@@ -312,26 +366,25 @@ class _Parser:
     def _atom(self) -> object:
         c = self._next()
         if c == 0x28:  # '('
-            scoped_flag: bool | None = None
+            saved_flags: tuple | None = None
             if self._peek() == 0x3F:  # '(?'
                 self.pos += 1
-                n = self._peek()
-                if n == 0x3A:  # non-capturing
-                    self.pos += 1
-                elif n == 0x69 and self.src[self.pos:self.pos + 2] == b"i:":
-                    self.pos += 2  # (?i:...) scoped case-insensitivity
-                    scoped_flag, self.ignore_case = self.ignore_case, True
-                elif n == 0x2D and self.src[self.pos:self.pos + 3] == b"-i:":
-                    self.pos += 3  # (?-i:...) scoped case-sensitivity
-                    scoped_flag, self.ignore_case = self.ignore_case, False
-                else:
+                flags = self._scan_flags()
+                if flags is None:
                     raise RegexSyntaxError(
-                        "only (?:...) / (?i:...) / (?-i:...) groups supported "
-                        "(no lookaround/named groups)"
+                        "only (?:...) and (?i/s:...) flag groups supported "
+                        "(no lookaround/named groups; global flags go at "
+                        "the start, as in re)"
                     )
+                saved_flags = (self.ignore_case, self.dotall)
+                pos_flags, neg_flags = flags
+                for f in pos_flags:
+                    setattr(self, self._FLAG_ATTR[f], True)
+                for f in neg_flags:
+                    setattr(self, self._FLAG_ATTR[f], False)
             node = self._alt()
-            if scoped_flag is not None:
-                self.ignore_case = scoped_flag
+            if saved_flags is not None:
+                self.ignore_case, self.dotall = saved_flags
             self._expect(0x29)
             if _is_bare_assertion(node):
                 # re's "nothing to repeat" applies to a BARE anchor or
@@ -343,7 +396,7 @@ class _Parser:
         if c == 0x5B:  # '['
             return self._char_class()
         if c == 0x2E:  # '.'
-            return self._leaf(bytes_=_DOT)
+            return self._leaf(bytes_=_ALL_BYTES if self.dotall else _DOT)
         if c == 0x5E:  # '^'
             return self._leaf(sentinel=BEGIN)
         if c == 0x24:  # '$'

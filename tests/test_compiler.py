@@ -417,3 +417,33 @@ def test_scoped_flags_and_string_anchors_vs_re():
     for pat in (r"[\A]", r"\A+", r"(?j:x)", r"(?-:x)"):
         with pytest.raises(RegexSyntaxError):
             compile_patterns([pat])
+
+
+def test_dotall_flag_vs_re():
+    """(?s)/(?s:...) DOTALL — '.' includes newline — including combined
+    and negated forms; verified against re."""
+    import re as _re
+
+    cases = [
+        (r"(?s)a.b", [b"a\nb", b"axb"]),
+        (r"(?s:a.b)c", [b"a\nbc", b"axbc"]),
+        (r"a(?s:.)b", [b"a\nb"]),
+        (r"(?si)A.b", [b"a\nB", b"A_b"]),
+        (r"(?s)(?i)A.b", [b"a\nB"]),
+        (r"x(?-s:.)y", [b"x\ny", b"xay"]),
+        (r"(?s)x(?-s:.)y", [b"x\ny", b"xay"]),
+        (r"(?i-s:a.)b", [b"A\nb", b"Axb"]),
+        (r"a.c", [b"a\nc", b"abc"]),  # default: . excludes \n
+    ]
+    for pat, lines in cases:
+        prog = compile_patterns([pat])
+        for ln in lines:
+            got = reference_match(prog, ln)
+            want = bool(_re.search(pat.encode(), ln))
+            assert got == want, f"{pat!r} on {ln!r}: got {got} want {want}"
+    # Loud rejects: mid-pattern global flags (re errors too), flags we
+    # do not implement (re may accept), malformed forms.
+    for pat in (r"a(?i)b", r"(?m)x", r"(?x)a b", r"(?-:x)", r"(?-s)x",
+                r"(?sm:x)"):
+        with pytest.raises(RegexSyntaxError):
+            compile_patterns([pat])

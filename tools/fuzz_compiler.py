@@ -34,7 +34,7 @@ from klogs_tpu.filters.compiler import (  # noqa: E402
     reference_match,
 )
 
-ALPHABET = b"ab01 .-XY\t/=:\xc3\x28"
+ALPHABET = b"ab01 .-XY\t/=:\xc3\x28\n"  # \n: DOTALL edge
 CLASS_BODIES = ["ab", "a-c", "0-9a", "^ab", "^0-9", "b-", "]a", "a-zA-Z",
                 "^\\d", "\\w-", ".*+", "^^", "0-9-"]
 ESCAPES = [r"\d", r"\D", r"\w", r"\W", r"\s", r"\S", r"\.", r"\-", r"\t",
@@ -65,7 +65,8 @@ def rand_pattern(rng: random.Random, depth: int = 0) -> str:
     if kind == "alt":
         return f"(?:{rand_pattern(rng, depth + 1)}|{rand_pattern(rng, depth + 1)})"
     if kind == "group":
-        opener = rng.choice(["(", "(", "(", "(?i:", "(?-i:"])
+        opener = rng.choice(["(", "(", "(", "(?i:", "(?-i:",
+                     "(?s:", "(?-s:", "(?si:", "(?i-s:"])
         return f"{opener}{rand_pattern(rng, depth + 1)})"
     inner = rand_pattern(rng, depth + 1)
     if not inner or inner[-1] in "*+?}":
@@ -85,8 +86,12 @@ def rand_pattern(rng: random.Random, depth: int = 0) -> str:
 
 
 def rand_line(rng: random.Random) -> bytes:
+    # Trailing newlines are stripped: the engine contract matches on
+    # newline-stripped bodies (framer output), and re's $-before-
+    # trailing-\n rule differs from the END sentinel by design.
+    # INTERIOR \n stays — that is the (?s)/DOTALL coverage.
     n = rng.randrange(0, 24)
-    return bytes(rng.choice(ALPHABET) for _ in range(n))
+    return bytes(rng.choice(ALPHABET) for _ in range(n)).rstrip(b"\n")
 
 
 def oracle(patterns, line: bytes, flags: int = 0) -> bool:
@@ -203,7 +208,8 @@ def main() -> int:
                 target = rng.choice((255, 256, 257, 511, 512, 513, 700,
                                      1100, 2048))
                 long_lines.append(bytes(rng.choice(ALPHABET)
-                                        for _ in range(target)))
+                                        for _ in range(target))
+                                  .rstrip(b"\n"))  # engine contract
             try:
                 long_expects = [safe_oracle(pats, ln, flags, 5.0)
                                 for ln in long_lines]
