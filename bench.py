@@ -143,7 +143,7 @@ def device_lps(lines, repeats: int):
 
             best = tune_grouped(dp, live, acc, None, None, cls=dcls,
                                 quiet=False)
-            kw = {"tile_b": best["tile_b"], "interleave": best["interleave"]}
+            kw = {k: v for k, v in best.items() if k != "lines_per_s"}
         # KLOGS_TPU_PREFILTER=1 opts into the two-phase path (class-
         # domain candidate mask gates kernel tiles). Default OFF per the
         # 2026-07-29 device A/B (BENCH_DEVICE.json): with classification

@@ -7,8 +7,12 @@ pipelined sweep on the live device, cached per (automaton shape, batch
 geometry, device kind) in ``~/.cache/klogs_tpu/tune.json``.
 
 Hooked in two places:
-- NFAEngineFilter reads KLOGS_TPU_TILE / KLOGS_TPU_INTERLEAVE env
-  overrides, else the cache (if a prior tune ran), else defaults.
+- NFAEngineFilter reads KLOGS_TPU_TILE / KLOGS_TPU_INTERLEAVE /
+  KLOGS_TPU_MASK_BLOCK / KLOGS_TPU_FUSED_GROUPS env overrides, else
+  measured defaults. (The on-disk cache written here is consumed by
+  operators/bench runs that call tune_grouped or load_cached
+  explicitly; the hot path stays env-driven so a stale cache can never
+  silently change production behavior.)
 - bench.py / operators run ``tune_grouped`` explicitly (KLOGS_BENCH_TUNE=1).
 """
 
@@ -18,6 +22,16 @@ import time
 
 CANDIDATE_TILES = (1024, 2048, 4096, 8192)
 CANDIDATE_INTERLEAVE = (1, 2)
+# Chain restructurings swept alongside (tile, interleave): mask_block=K
+# precomputes K step masks off the serial chain; fused runs all groups
+# in one grid cell with a shared one-hot. Both parity-tested; whether
+# either wins is hardware-empirical (pallas_nfa.py docstrings).
+CANDIDATE_VARIANTS = (
+    {},  # plain
+    {"mask_block": 4},
+    {"mask_block": 8},
+    {"fused": True},
+)
 
 
 def _cache_path() -> str:
@@ -74,18 +88,18 @@ def tune_grouped(dp, live: int, acc: int, batch, lengths,
 
     B = batch.shape[0] if cls is None else cls.shape[0]
 
-    def default_runner(tile_b: int, interleave: int) -> float:
+    def default_runner(tile_b: int, interleave: int, **variant) -> float:
         # Non-divisor tiles are fine: the kernel wrapper pads the batch
         # up to a tile multiple internally.
         if cls is not None:
             run = lambda: match_cls_grouped_pallas(
                 dp, live, acc, cls,
-                tile_b=tile_b, interleave=interleave,
+                tile_b=tile_b, interleave=interleave, **variant,
             )
         else:
             run = lambda: match_batch_grouped_pallas(
                 dp, live, acc, batch, lengths,
-                tile_b=tile_b, interleave=interleave,
+                tile_b=tile_b, interleave=interleave, **variant,
             )
         run().block_until_ready()  # compile
         best = 0.0
@@ -97,26 +111,45 @@ def tune_grouped(dp, live: int, acc: int, batch, lengths,
         return best
 
     runner = runner or default_runner
+    # Injected test runners may predate the variant kwargs; detect by
+    # signature instead of catching TypeError (which JAX also raises
+    # for real kernel bugs — swallowing those would silently "measure"
+    # only the plain config).
+    import inspect
+
+    params = inspect.signature(runner).parameters.values()
+    runner_takes_variants = any(
+        p.kind == inspect.Parameter.VAR_KEYWORD for p in params)
     results = []
     seen = set()
     for tile in (min(t, B) for t in CANDIDATE_TILES):
         for il in CANDIDATE_INTERLEAVE:
-            if tile % il or tile // il < 8 or (tile, il) in seen:
+            if tile % il or tile // il < 8:
                 continue
-            seen.add((tile, il))
-            try:
-                lps = runner(tile, il)
-            except Exception as e:  # VMEM overflow / compile failure
-                if not quiet:
-                    print(f"tune: tile={tile} interleave={il} failed: "
-                          f"{str(e)[:80]}")
-                continue
-            if lps > 0:
-                results.append({"tile_b": tile, "interleave": il,
-                                "lines_per_s": round(lps, 1)})
-                if not quiet:
-                    print(f"tune: tile={tile} interleave={il} "
-                          f"-> {lps:,.0f} lines/s")
+            for variant in CANDIDATE_VARIANTS:
+                if variant and il != 1:
+                    continue  # restructurings are interleave-exclusive
+                if variant and not runner_takes_variants:
+                    continue
+                key = (tile, il, tuple(sorted(variant.items())))
+                if key in seen:
+                    continue
+                seen.add(key)
+                desc = " ".join(f"{k}={v}" for k, v in variant.items())
+                try:
+                    lps = runner(tile, il, **variant)
+                except Exception as e:  # VMEM overflow / compile failure
+                    if not quiet:
+                        print(f"tune: tile={tile} interleave={il} {desc} "
+                              f"failed: {str(e)[:80]}")
+                    continue
+                if lps > 0:
+                    results.append({"tile_b": tile, "interleave": il,
+                                    **variant,
+                                    "lines_per_s": round(lps, 1)})
+                    if not quiet:
+                        print(f"tune: tile={tile} interleave={il} {desc}"
+                              f" -> {lps:,.0f} lines/s")
     if not results:
         raise RuntimeError("kernel tuning failed for every candidate config")
     best = max(results, key=lambda r: r["lines_per_s"])

@@ -207,9 +207,15 @@ def main() -> int:
             for _ in range(4):
                 target = rng.choice((255, 256, 257, 511, 512, 513, 700,
                                      1100, 2048))
-                long_lines.append(bytes(rng.choice(ALPHABET)
-                                        for _ in range(target))
-                                  .rstrip(b"\n"))  # engine contract
+                raw = bytearray(rng.choice(ALPHABET) for _ in range(target))
+                # Engine contract strips trailing \n; REPLACE trailing
+                # newlines instead so the chunk-boundary target length
+                # (255/256/257/...) is preserved exactly.
+                i = len(raw)
+                while i and raw[i - 1] == 0x0A:
+                    raw[i - 1] = 0x61  # 'a'
+                    i -= 1
+                long_lines.append(bytes(raw))
             try:
                 long_expects = [safe_oracle(pats, ln, flags, 5.0)
                                 for ln in long_lines]
