@@ -17,7 +17,9 @@ Supported syntax: literals, ``.``, escapes (\\d \\D \\w \\W \\s \\S
 assertions ``\\b`` / ``\\B`` (compiled to static edge constraints in
 glushkov.py — no runtime cost), character classes ``[...]`` with
 ranges and negation (``[\\b]`` is backspace, as in re), grouping
-``(...)`` / ``(?:...)``, scoped flag groups over ``i`` (ignore-case)
+``(...)`` / ``(?:...)`` / ``(?P<name>...)`` (captures are irrelevant
+to boolean matching; duplicate names reject as in re), comments
+``(?#...)``, scoped flag groups over ``i`` (ignore-case)
 and ``s`` (DOTALL) — ``(?i:...)``, ``(?-i:...)``, ``(?s:...)``,
 ``(?i-s:...)`` etc. — alternation ``|``, quantifiers ``* + ? {m} {m,}
 {m,n}`` (lazy variants accepted — laziness is irrelevant for boolean
@@ -145,6 +147,7 @@ class _Parser:
         self.ignore_case = ignore_case
         self.dotall = False
         self.n_leaves = 0
+        self.group_names: set[bytes] = set()
         self.max_positions = max_positions_cap()  # read once per parse
 
     # -- low-level cursor ------------------------------------------------
@@ -181,6 +184,17 @@ class _Parser:
 
     # -- grammar ---------------------------------------------------------
     _FLAG_ATTR = {0x69: "ignore_case", 0x73: "dotall"}  # i, s
+
+    def _skip_comments(self) -> None:
+        """Splice out ``(?#...)`` comments at the cursor. Comments are
+        TRANSPARENT in re's token stream — a quantifier after one binds
+        to the atom BEFORE it (``a(?#c)*b`` ≡ ``a*b``) — so they are
+        consumed at the lexical level, never parsed as atoms. The first
+        ')' ends a comment; EOF inside one is 'unexpected end'."""
+        while self.src[self.pos:self.pos + 3] == b"(?#":
+            self.pos += 3
+            while self._next() != 0x29:  # ')'
+                pass
 
     def _scan_flags(self) -> "tuple[list[int], list[int]] | None":
         """At a position just past ``(?``: consume ``[is]*(-[is]+)?:``
@@ -223,6 +237,7 @@ class _Parser:
         # start only, as in re ("global flags not at the start of the
         # expression" is re's error for the misplaced form, which the
         # group parser rejects loudly here too).
+        self._skip_comments()
         while self.src[self.pos:self.pos + 2] == b"(?":
             saved = self.pos
             self.pos += 2
@@ -233,6 +248,7 @@ class _Parser:
                 self.pos += 1
                 for f in flags:
                     setattr(self, self._FLAG_ATTR[f], True)
+                self._skip_comments()
             else:
                 self.pos = saved
                 break
@@ -253,6 +269,7 @@ class _Parser:
     def _concat(self) -> object:
         parts = []
         while True:
+            self._skip_comments()
             c = self._peek()
             if c is None or c in (0x7C, 0x29):  # '|' ')'
                 break
@@ -265,6 +282,7 @@ class _Parser:
         node = self._atom()
         seen_quant = False
         while True:
+            self._skip_comments()  # a(?#c)*b ≡ a*b: * binds to a
             c = self._peek()
             if c == 0x2A:  # '*'
                 self._reject_bad_repeat(node, seen_quant)
@@ -369,6 +387,38 @@ class _Parser:
             saved_flags: tuple | None = None
             if self._peek() == 0x3F:  # '(?'
                 self.pos += 1
+                n = self._peek()
+                if n == 0x50:  # 'P' — (?P<name>...): captures are
+                    # irrelevant to boolean matching, so a named group
+                    # is just a group; backref forms stay rejected.
+                    if self.src[self.pos:self.pos + 2] != b"P<":
+                        raise RegexSyntaxError(
+                            "only the (?P<name>...) ?P-form is supported "
+                            "(no (?P=name) backreferences)")
+                    self.pos += 2
+                    name = b""
+                    while self._peek() not in (None, 0x3E):  # '>'
+                        name += bytes([self._next()])
+                    self._expect(0x3E)
+                    if (not name or not name.isascii()
+                            or not name.decode("ascii").isidentifier()):
+                        # re (bytes patterns) additionally rejects
+                        # non-ASCII names — mirror it so the CPU re
+                        # baseline compiles everything we accept.
+                        raise RegexSyntaxError(
+                            f"bad group name {name.decode('latin-1')!r}")
+                    if name in self.group_names:
+                        # re errors on redefinition; accepting here would
+                        # compile patterns the CPU re baseline rejects.
+                        raise RegexSyntaxError(
+                            f"redefinition of group name "
+                            f"{name.decode('latin-1')!r}, as in re")
+                    self.group_names.add(name)
+                    node = self._alt()
+                    self._expect(0x29)
+                    if _is_bare_assertion(node):
+                        node = Cat((node,))
+                    return node
                 flags = self._scan_flags()
                 if flags is None:
                     raise RegexSyntaxError(

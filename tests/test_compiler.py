@@ -130,7 +130,8 @@ def test_explicit_ignore_case_flag():
 @pytest.mark.parametrize(
     "bad",
     [r"\b+", r"a\b*", r"[\B]", r"\b^a", r"a$\b",  # assertion corner cases
-     r"(?P<x>a)", r"(?=a)", "(a", "a)", "[a", r"a{2,1}", "*a", "[]"],
+     r"(?P=x)", r"(?P<x>a)(?P<x>b)", r"(?'x'a)",  # backref/dup/ext forms
+     r"(?=a)", "(a", "a)", "[a", r"a{2,1}", "*a", "[]"],
 )
 def test_rejects_unsupported(bad):
     with pytest.raises((RegexSyntaxError, ValueError)):
@@ -445,5 +446,33 @@ def test_dotall_flag_vs_re():
     # do not implement (re may accept), malformed forms.
     for pat in (r"a(?i)b", r"(?m)x", r"(?x)a b", r"(?-:x)", r"(?-s)x",
                 r"(?sm:x)"):
+        with pytest.raises(RegexSyntaxError):
+            compile_patterns([pat])
+
+
+def test_named_groups_and_comments_vs_re():
+    """(?P<name>...) is a plain group for boolean matching (captures
+    are irrelevant); (?#comments) contribute nothing. Duplicate names
+    and backref forms reject, as in re."""
+    import re as _re
+
+    cases = [
+        (r"(?P<lvl>ERROR|WARN) code", [b"ERROR code", b"WARN code",
+                                       b"INFO code"]),
+        (r"(?P<a>x)(?P<b>y)+", [b"xyy", b"x"]),
+        (r"a(?#note)b", [b"ab", b"a b"]),
+        # comments are TRANSPARENT: the quantifier binds to 'a'
+        (r"a(?#note)*b", [b"ab", b"b", b"aab"]),
+        (r"a(?#note)?b", [b"b", b"ab"]),
+        (r"(?#lead)(?i)x", [b"X"]),
+        (r"(?P<g>^\bfoo)", [b"foo", b"-foo"]),
+    ]
+    for pat, lines in cases:
+        prog = compile_patterns([pat])
+        for ln in lines:
+            got = reference_match(prog, ln)
+            want = bool(_re.search(pat.encode(), ln))
+            assert got == want, f"{pat!r} on {ln!r}: got {got} want {want}"
+    for pat in (r"(?P<1x>a)", r"(?#x", r"(?#c)*a", "(?P<\u00aa>x)"):
         with pytest.raises(RegexSyntaxError):
             compile_patterns([pat])

@@ -66,8 +66,12 @@ def rand_pattern(rng: random.Random, depth: int = 0) -> str:
         return f"(?:{rand_pattern(rng, depth + 1)}|{rand_pattern(rng, depth + 1)})"
     if kind == "group":
         opener = rng.choice(["(", "(", "(", "(?i:", "(?-i:",
-                     "(?s:", "(?-s:", "(?si:", "(?i-s:"])
-        return f"{opener}{rand_pattern(rng, depth + 1)})"
+                             "(?s:", "(?-s:", "(?si:", "(?i-s:",
+                             f"(?P<g{rng.randrange(1000)}>"])
+        inner = rand_pattern(rng, depth + 1)
+        if rng.random() < 0.1:  # comments are lexical splices
+            inner += "(?#c)"
+        return f"{opener}{inner})"
     inner = rand_pattern(rng, depth + 1)
     if not inner or inner[-1] in "*+?}":
         inner = f"(?:{inner})"
