@@ -61,6 +61,32 @@ async def resolve_namespace(
     return namespace
 
 
+async def select_noninteractive(
+    backend: ClusterBackend, namespace: str, opts: Options,
+    quiet: bool = False,
+) -> list[PodInfo]:
+    """The re-runnable core of pod selection: label union
+    (cmd/root.go:455-461) or all-Ready (cmd/root.go:137-143). Shared by
+    the startup path and the --watch-new re-poll so both always select
+    the same pod set; ``quiet`` suppresses the per-call chatter during
+    polling."""
+    if opts.labels:
+        pods: list[PodInfo] = []
+        for label in opts.labels:
+            if not quiet:
+                term.info("Getting Pods with label %s\n", term.green(label))
+            found = await backend.list_pods(namespace, label_selector=label)
+            if not found and not quiet:
+                term.error(
+                    "No pods found in namespace %s with label %s\n", namespace, label
+                )
+            # Union semantics, no dedup across labels (cmd/root.go:458-460).
+            pods.extend(found)
+        return pods
+    all_pods = await backend.list_pods(namespace)
+    return [p for p in all_pods if p.ready]  # cmd/root.go:137-143
+
+
 async def select_pods(
     backend: ClusterBackend, namespace: str, opts: Options,
     select_keys: Iterable[str] | None = None,
@@ -68,20 +94,9 @@ async def select_pods(
     """Pod selection: label union (cmd/root.go:455-461) or
     listAllPods with Ready filter + optional multiselect (cmd/root.go:126-164)."""
     if opts.labels:
-        pods: list[PodInfo] = []
-        for label in opts.labels:
-            term.info("Getting Pods with label %s\n", term.green(label))
-            found = await backend.list_pods(namespace, label_selector=label)
-            if not found:
-                term.error(
-                    "No pods found in namespace %s with label %s\n", namespace, label
-                )
-            # Union semantics, no dedup across labels (cmd/root.go:458-460).
-            pods.extend(found)
-        return pods
+        return await select_noninteractive(backend, namespace, opts)
 
-    all_pods = await backend.list_pods(namespace)
-    ready = [p for p in all_pods if p.ready]  # cmd/root.go:137-143
+    ready = await select_noninteractive(backend, namespace, opts)
     if not ready:
         term.error("No pods found in namespace %s", namespace)
         return []
@@ -248,7 +263,27 @@ async def run_async(
                 backend, namespace, log_opts,
                 sink_factory=pipeline.sink_factory if pipeline else None,
             )
-            if opts.follow and jobs:
+            # --watch-new: stern-style dynamic discovery. Only a
+            # NON-interactive selection can be re-planned (the user's
+            # one-off multiselect cannot); re-run the same -a/-l
+            # selection and let the runner diff.
+            plan_new = None
+            if opts.watch_new and opts.follow:
+                if opts.all_pods or opts.labels:
+                    async def plan_new() -> list[StreamJob]:
+                        pods = await select_noninteractive(
+                            backend, namespace, opts, quiet=True)
+                        return plan_jobs(pods, opts.log_path,
+                                         opts.init_containers)
+                else:
+                    term.warning(
+                        "--watch-new needs -a or -l (an interactive pod "
+                        "pick cannot be re-run); ignoring")
+            elif opts.watch_new:
+                term.warning("--watch-new only applies with -f; ignoring")
+            # With discovery active, an EMPTY initial selection still
+            # waits (the point of starting the watch before deploying).
+            if opts.follow and (jobs or plan_new is not None):
                 flusher = (
                     asyncio.create_task(pipeline.run_deadline_flusher())
                     if pipeline is not None else None
@@ -262,7 +297,21 @@ async def run_async(
                 else:
                     watcher = watcher_done = None
                 try:
-                    await runner.run(jobs, stop=stop)
+                    raw = os.environ.get("KLOGS_WATCH_INTERVAL_S", "5")
+                    try:
+                        # Floor of 0.2s: a zero/negative value would
+                        # busy-poll the apiserver for the whole session.
+                        interval = max(0.2, float(raw))
+                    except ValueError:
+                        term.fatal(
+                            "KLOGS_WATCH_INTERVAL_S must be a number, "
+                            "got %r", raw)
+                    results = await runner.run(
+                        jobs, stop=stop, plan_new=plan_new,
+                        discover_interval_s=interval)
+                    # Late-discovered streams must appear in the size
+                    # table too.
+                    log_files = [r.job.path for r in results]
                 finally:
                     if watcher is not None:
                         # Unblock the /dev/tty reader thread so the

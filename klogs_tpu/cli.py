@@ -56,6 +56,7 @@ class Options:
     stats: bool = False
     profile: str | None = None
     cluster: str = "kube"
+    watch_new: bool = False
 
 
 USE = "klogs"
@@ -158,6 +159,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="Print lines/sec, matched %%, and batch-latency summary",
     )
     p.add_argument(
+        "--watch-new",
+        action="store_true",
+        dest="watch_new",
+        help="With -f and -a/-l: keep watching for NEW pods matching the "
+        "selection and stream them as they appear (stern-style; the "
+        "reference fixes the pod set at startup)",
+    )
+    p.add_argument(
         "--profile",
         default=None,
         metavar="DIR",
@@ -193,6 +202,7 @@ def parse_args(argv: list[str] | None = None) -> Options:
         stats=ns.stats,
         profile=ns.profile,
         cluster=ns.cluster,
+        watch_new=ns.watch_new,
     )
 
 

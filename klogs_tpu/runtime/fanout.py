@@ -225,36 +225,116 @@ class FanoutRunner:
         except asyncio.TimeoutError:
             return not self._stopping
 
+    def _spawn(self, job: StreamJob, tasks: list) -> None:
+        # Create (truncate) the log file up front (cmd/root.go:245-257).
+        os.makedirs(os.path.dirname(job.path) or ".", exist_ok=True)
+        open(job.path, "wb").close()
+        tasks.append(asyncio.create_task(self._worker(job)))
+
+    async def _discover_loop(self, plan_new, interval_s: float,
+                             seen: set, tasks: list) -> None:
+        """Poll-based dynamic discovery (stern-style --watch-new, beyond
+        the reference, whose pod set is fixed at startup): periodically
+        re-plan, spawn workers for unseen (pod, container, init) keys.
+        Polling over the watch API keeps this backend-agnostic and free
+        of resourceVersion bookkeeping; at reference scale a re-list
+        every few seconds is far below the Burst budget. List failures
+        are transient apiserver weather: warn and keep polling."""
+        while not self._stopping:
+            try:
+                await asyncio.wait_for(self._stop_event.wait(),
+                                       timeout=interval_s)
+                return  # stop fired
+            except asyncio.TimeoutError:
+                pass
+            try:
+                jobs = await plan_new()
+                if self._stopping:
+                    return  # stop fired while the list was in flight
+                fresh = [j for j in jobs
+                         if (j.pod, j.container, j.init) not in seen]
+                if not fresh:
+                    continue
+                term.info("Discovered %d new container stream(s): %s",
+                          len(fresh),
+                          ", ".join(f"{j.pod}/{j.container}"
+                                    for j in fresh[:6])
+                          + ("…" if len(fresh) > 6 else ""))
+                for j in fresh:
+                    seen.add((j.pod, j.container, j.init))
+                    self._spawn(j, tasks)
+            except Exception as e:
+                # Includes _spawn's file creation (full disk, lost
+                # permissions): warn and keep polling — a transient
+                # fault must not silently kill discovery for the rest
+                # of the session.
+                term.warning("pod discovery poll failed (%s); retrying", e)
+
     async def run(
         self,
         jobs: list[StreamJob],
         stop: asyncio.Event | None = None,
+        plan_new=None,
+        discover_interval_s: float = 5.0,
     ) -> list[StreamResult]:
         """Run all stream workers to completion; if ``stop`` fires first,
-        shut down cleanly (close streams, flush sinks) and return."""
-        # Create (truncate) every log file up front (cmd/root.go:245-257).
+        shut down cleanly (close streams, flush sinks) and return.
+
+        ``plan_new`` (async () -> list[StreamJob], follow mode only)
+        enables dynamic discovery: the plan is re-polled every
+        ``discover_interval_s`` and workers spawn for jobs not yet seen
+        — new pods matching the selection start streaming mid-follow.
+        With discovery active the run ends on ``stop`` (new work can
+        always appear), never by worker exhaustion."""
+        tasks: list[asyncio.Task] = []
         for job in jobs:
-            os.makedirs(os.path.dirname(job.path) or ".", exist_ok=True)
-            open(job.path, "wb").close()
+            self._spawn(job, tasks)
 
-        tasks = [asyncio.create_task(self._worker(j)) for j in jobs]
-        wait_all = asyncio.gather(*tasks)
+        seen = {(j.pod, j.container, j.init) for j in jobs}
+        poller = (asyncio.create_task(
+                      self._discover_loop(plan_new, discover_interval_s,
+                                          seen, tasks))
+                  if plan_new is not None and self.log_opts.follow else None)
+        stop_task = asyncio.create_task(stop.wait()) if stop is not None else None
 
-        if stop is None:
-            return await wait_all
-
-        stop_task = asyncio.create_task(stop.wait())
-        done, _ = await asyncio.wait(
-            {asyncio.ensure_future(wait_all), stop_task},
-            return_when=asyncio.FIRST_COMPLETED,
-        )
-        if stop_task in done and not wait_all.done():
-            await self.stop()
-            results = await wait_all
-        else:
-            stop_task.cancel()
-            results = await wait_all
-        return results
+        try:
+            while True:
+                pending = [t for t in tasks if not t.done()]
+                if not pending and poller is None:
+                    break  # static plan fully drained
+                waiters = set(pending)
+                if stop_task is not None:
+                    waiters.add(stop_task)
+                if poller is not None:
+                    waiters.add(poller)
+                if not waiters:
+                    break
+                done, _ = await asyncio.wait(
+                    waiters, return_when=asyncio.FIRST_COMPLETED)
+                if stop_task is not None and stop_task in done:
+                    await self.stop()
+                    break
+                if poller is not None and poller.done():
+                    # Normal exit = stop fired inside the poll loop; the
+                    # loop swallows per-iteration faults, so anything
+                    # else here is unexpected — surface it, don't let
+                    # the task die with an unretrieved exception.
+                    exc = poller.exception()
+                    if exc is not None:
+                        term.warning(
+                            "pod discovery stopped unexpectedly: %s", exc)
+                    poller = None
+        finally:
+            if poller is not None:
+                self._stop_event.set()
+                try:
+                    await poller
+                except Exception as e:
+                    term.warning(
+                        "pod discovery stopped unexpectedly: %s", e)
+            if stop_task is not None:
+                stop_task.cancel()
+        return await asyncio.gather(*tasks)
 
     async def stop(self) -> None:
         """Explicit teardown: close all live streams; workers then drain

@@ -194,3 +194,96 @@ def test_unsupported_match_pattern_message(capsys):
         app.make_pipeline_for(opts)
     cap = capsys.readouterr()
     assert "unsupported --match pattern" in (cap.out + cap.err).lower()
+
+
+def test_watch_new_streams_pods_added_mid_follow(tmp_path, monkeypatch):
+    """--watch-new (stern-style dynamic discovery, beyond the
+    reference): a pod created AFTER the follow starts is discovered by
+    the re-plan poll, its file appears, live lines land, and it shows
+    in the final size table."""
+    monkeypatch.setenv("KLOGS_WATCH_INTERVAL_S", "0.2")
+    out_dir = str(tmp_path / "logs")
+    fc = FakeCluster()
+    fc.add_pod("default", "pod-a", containers=["c0"],
+               lines_per_container=3, follow_interval_s=0.001)
+    opts = parse_args(["-n", "default", "-a", "-f", "--watch-new",
+                       "-p", out_dir])
+
+    async def scenario():
+        stop = asyncio.Event()
+
+        async def late_pod_then_stop():
+            await asyncio.sleep(0.3)
+            fc.add_pod("default", "pod-late", containers=["c9"],
+                       lines_per_container=1, follow_interval_s=0.001)
+            await asyncio.sleep(1.2)  # >1 poll interval + some streaming
+            stop.set()
+
+        t = asyncio.create_task(late_pod_then_stop())
+        rc = await app.run_async(opts, backend=fc, stop=stop)
+        await t
+        return rc
+
+    rc = asyncio.run(asyncio.wait_for(scenario(), timeout=20))
+    assert rc == 0
+    names = sorted(os.listdir(out_dir))
+    assert "pod-a__c0.log" in names
+    assert "pod-late__c9.log" in names, names
+    with open(os.path.join(out_dir, "pod-late__c9.log"), "rb") as fh:
+        assert len(fh.read().splitlines()) >= 2  # history + live lines
+
+
+def test_watch_new_without_selector_warns_and_runs(tmp_path, capsys):
+    """--watch-new with an interactive pick can't re-plan: warn, keep
+    the static behavior."""
+    out_dir = str(tmp_path / "logs")
+    fc = FakeCluster.synthetic(n_pods=1, n_containers=1, lines_per_container=3)
+    opts = parse_args(["-n", "default", "-f", "--watch-new", "-p", out_dir])
+
+    async def scenario():
+        stop = asyncio.Event()
+
+        async def trigger():
+            await asyncio.sleep(0.2)
+            stop.set()
+
+        t = asyncio.create_task(trigger())
+        rc = await app.run_async(opts, backend=fc, stop=stop,
+                                 select_keys=["space", "enter"])
+        await t
+        return rc
+
+    rc = asyncio.run(asyncio.wait_for(scenario(), timeout=10))
+    assert rc == 0
+    assert "watch-new needs -a or -l" in capsys.readouterr().out
+
+
+def test_watch_new_waits_on_empty_initial_selection(tmp_path, monkeypatch):
+    """Starting the watch BEFORE any pod exists (the stern use case):
+    the run must wait, pick up the first pod when it appears, and exit
+    cleanly on stop."""
+    monkeypatch.setenv("KLOGS_WATCH_INTERVAL_S", "0.2")
+    out_dir = str(tmp_path / "logs")
+    fc = FakeCluster()
+    fc.add_namespace("default")  # zero pods
+    opts = parse_args(["-n", "default", "-a", "-f", "--watch-new",
+                       "-p", out_dir])
+
+    async def scenario():
+        stop = asyncio.Event()
+
+        async def deploy_then_stop():
+            await asyncio.sleep(0.4)
+            fc.add_pod("default", "first-pod", containers=["c0"],
+                       lines_per_container=2, follow_interval_s=0.001)
+            await asyncio.sleep(1.0)
+            stop.set()
+
+        t = asyncio.create_task(deploy_then_stop())
+        rc = await app.run_async(opts, backend=fc, stop=stop)
+        await t
+        return rc
+
+    rc = asyncio.run(asyncio.wait_for(scenario(), timeout=20))
+    assert rc == 0
+    assert "first-pod__c0.log" in os.listdir(out_dir)
