@@ -204,8 +204,9 @@ async def _watch_for_quit(
 
 
 def make_pipeline_for(opts: Options):
-    """The --match filter pipeline (None = unfiltered reference path)."""
-    if not opts.match:
+    """The --match/--exclude filter pipeline (None = unfiltered
+    reference path)."""
+    if not opts.match and not opts.exclude:
         return None
     import re as _re
 
@@ -215,14 +216,15 @@ def make_pipeline_for(opts: Options):
 
     try:
         return make_pipeline(opts.match, opts.backend, remote=opts.remote,
-                             ignore_case=opts.ignore_case)
+                             ignore_case=opts.ignore_case,
+                             exclude=opts.exclude)
     except _re.error as e:
-        term.fatal("invalid --match pattern %r: %s", e.pattern, e)
+        term.fatal("invalid --match/--exclude pattern %r: %s", e.pattern, e)
     except RegexSyntaxError as e:
         # NFA-compiler rejections (unsupported constructs like
         # possessive quantifiers or backrefs) get the same friendly
         # exit as re syntax errors, not a traceback.
-        term.fatal("unsupported --match pattern: %s", e)
+        term.fatal("unsupported --match/--exclude pattern: %s", e)
     except ImportError as e:
         term.fatal("--backend %s is unavailable: %s", opts.backend, e)
 
@@ -297,15 +299,17 @@ async def run_async(
                 else:
                     watcher = watcher_done = None
                 try:
-                    raw = os.environ.get("KLOGS_WATCH_INTERVAL_S", "5")
-                    try:
-                        # Floor of 0.2s: a zero/negative value would
-                        # busy-poll the apiserver for the whole session.
-                        interval = max(0.2, float(raw))
-                    except ValueError:
-                        term.fatal(
-                            "KLOGS_WATCH_INTERVAL_S must be a number, "
-                            "got %r", raw)
+                    interval = 5.0
+                    if plan_new is not None:  # knob is irrelevant otherwise
+                        raw = os.environ.get("KLOGS_WATCH_INTERVAL_S", "5")
+                        try:
+                            # Floor of 0.2s: a zero/negative value would
+                            # busy-poll the apiserver all session.
+                            interval = max(0.2, float(raw))
+                        except ValueError:
+                            term.fatal(
+                                "KLOGS_WATCH_INTERVAL_S must be a number, "
+                                "got %r", raw)
                     results = await runner.run(
                         jobs, stop=stop, plan_new=plan_new,
                         discover_interval_s=interval)

@@ -50,6 +50,7 @@ class Options:
     init_containers: bool = False
     # North-star extensions
     match: list[str] = field(default_factory=list)
+    exclude: list[str] = field(default_factory=list)
     ignore_case: bool = False
     backend: str = "cpu"
     remote: str | None = None
@@ -159,6 +160,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="Print lines/sec, matched %%, and batch-latency summary",
     )
     p.add_argument(
+        "--exclude",
+        action="append",
+        default=[],
+        metavar="REGEX",
+        help="Drop lines matching this pattern even when --match keeps "
+        "them (repeatable; alone = keep everything EXCEPT matches)",
+    )
+    p.add_argument(
         "--watch-new",
         action="store_true",
         dest="watch_new",
@@ -196,6 +205,7 @@ def parse_args(argv: list[str] | None = None) -> Options:
         print_version=ns.print_version,
         init_containers=ns.init_containers,
         match=list(ns.match),
+        exclude=list(ns.exclude),
         ignore_case=ns.ignore_case,
         backend=ns.backend,
         remote=ns.remote,

@@ -171,3 +171,52 @@ class LogFilter(abc.ABC):
 
     def close(self) -> None:
         """Release engine resources (device buffers, transports)."""
+
+
+class IncludeExcludeFilter(LogFilter):
+    """keep = (no include set OR include matches) AND NOT exclude
+    matches — the stern-style noise-suppression combinator. Both sides
+    are independent LogFilters; dispatch() submits BOTH batches before
+    either result is awaited, so on device engines the two automata
+    pipeline instead of serializing round trips."""
+
+    def __init__(self, include: "LogFilter | None", exclude: LogFilter):
+        self.include = include
+        self.exclude = exclude
+
+    def match_lines(self, lines: list[bytes]) -> list[bool]:
+        return self.fetch(self.dispatch(lines))
+
+    def dispatch(self, lines: list[bytes]):
+        hi = self.include.dispatch(lines) if self.include is not None else None
+        he = self.exclude.dispatch(lines)
+        return (hi, he)
+
+    def fetch(self, handle) -> list[bool]:
+        hi, he = handle
+        ex = self.exclude.fetch(he)
+        if hi is None:
+            return [not e for e in ex]
+        inc = self.include.fetch(hi)
+        return [i and not e for i, e in zip(inc, ex)]
+
+    def close(self) -> None:
+        if self.include is not None:
+            self.include.close()
+        self.exclude.close()
+
+
+def build_include_exclude(builder, patterns: list[str],
+                          exclude: "list[str] | None") -> LogFilter:
+    """Compose include/exclude pattern sets over a single-engine
+    ``builder(pats) -> LogFilter`` — THE one place the combination
+    logic lives (collector and filterd both call it, so they can never
+    drift). Raises when both sets are empty: a pipeline with no
+    patterns at all has nothing to decide."""
+    exclude = exclude or []
+    if not patterns and not exclude:
+        raise ValueError("need at least one include or exclude pattern")
+    include = builder(patterns) if patterns else None
+    if exclude:
+        return IncludeExcludeFilter(include, builder(exclude))
+    return include

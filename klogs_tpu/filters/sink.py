@@ -142,6 +142,7 @@ class FilterPipeline:
     service: "AsyncFilterService | None" = None
     patterns: list[str] | None = None
     ignore_case: bool = False
+    exclude: list[str] | None = None
     _live_sinks: "set[FilteredSink]" = dataclasses_field(default_factory=set)
 
     def sink_factory(self, job: StreamJob) -> Sink:
@@ -177,7 +178,8 @@ class FilterPipeline:
         set against the server's before any line flows."""
         verify = getattr(self.service, "verify_patterns", None)
         if verify is not None and self.patterns is not None:
-            await verify(self.patterns, self.ignore_case)
+            await verify(self.patterns, self.ignore_case,
+                         exclude=self.exclude or [])
 
     async def aclose(self) -> None:
         """Awaited teardown (run_async calls this): services that hold
@@ -228,13 +230,41 @@ class FilterPipeline:
             term.info("  %s", s.pf_disabled_reason)
 
 
+def _build_filter(patterns: list[str], backend: str, stats,
+                  ignore_case: bool) -> "LogFilter":
+    """One engine for one pattern set (shared by the include and
+    exclude sides so both always get the same backend treatment)."""
+    if backend == "cpu":
+        from klogs_tpu.filters.cpu import RegexFilter
+
+        return RegexFilter(patterns, ignore_case=ignore_case)
+    import jax
+
+    from klogs_tpu.filters.tpu import NFAEngineFilter
+
+    # Multi-chip: shard lines (data) x pattern groups over the mesh;
+    # single chip: plain on-device batches, no collective overhead.
+    engine = None
+    if jax.device_count() > 1:
+        from klogs_tpu.parallel.mesh import MeshEngine
+
+        # Real chips: per-shard Pallas kernel; virtual/CPU meshes:
+        # GSPMD over the jnp path (kernel needs Mosaic or interpret).
+        impl = "pallas" if jax.default_backend() != "cpu" else "gspmd"
+        engine = MeshEngine(patterns, ignore_case=ignore_case, impl=impl)
+    return NFAEngineFilter(patterns, ignore_case=ignore_case,
+                           engine=engine, stats=stats)
+
+
 def make_pipeline(patterns: list[str], backend: str,
                   batch_lines: int | None = None,
                   deadline_s: float = 0.05,
                   remote: str | None = None,
-                  ignore_case: bool = False) -> FilterPipeline:
+                  ignore_case: bool = False,
+                  exclude: list[str] | None = None) -> FilterPipeline:
     stats = FilterStats()
     service = None
+    exclude = exclude or []
     if remote is not None:
         import os
 
@@ -262,36 +292,33 @@ def make_pipeline(patterns: list[str], backend: str,
             service=service,
             patterns=patterns,
             ignore_case=ignore_case,
+            exclude=exclude,
         )
+    if backend not in ("cpu", "tpu"):
+        raise ValueError(f"unknown filter backend {backend!r}")
+    from klogs_tpu.filters.base import build_include_exclude
+
+    # Stats ride the include side only (or the combiner's inputs would
+    # double-count); a both-empty call raises in the combinator instead
+    # of building a pipeline that crashes on first use.
+    made = []
+
+    def builder(pats):
+        f = _build_filter(pats, backend, stats if not made else None,
+                          ignore_case)
+        made.append(f)
+        return f
+
+    log_filter: LogFilter = build_include_exclude(builder, patterns, exclude)
     if backend == "cpu":
-        from klogs_tpu.filters.cpu import RegexFilter
-
-        log_filter: LogFilter = RegexFilter(patterns, ignore_case=ignore_case)
         batch_lines = batch_lines or 1024
-    elif backend == "tpu":
-        import jax
-
+    else:
         from klogs_tpu.filters.async_service import AsyncFilterService
-        from klogs_tpu.filters.tpu import NFAEngineFilter
 
-        # Multi-chip: shard lines (data) x pattern groups over the mesh;
-        # single chip: plain on-device batches, no collective overhead.
-        engine = None
-        if jax.device_count() > 1:
-            from klogs_tpu.parallel.mesh import MeshEngine
-
-            # Real chips: per-shard Pallas kernel; virtual/CPU meshes:
-            # GSPMD over the jnp path (kernel needs Mosaic or interpret).
-            impl = "pallas" if jax.default_backend() != "cpu" else "gspmd"
-            engine = MeshEngine(patterns, ignore_case=ignore_case, impl=impl)
-        log_filter = NFAEngineFilter(patterns, ignore_case=ignore_case,
-                                     engine=engine, stats=stats)
         # Device batches are cheap per line but each round trip has fixed
         # latency: bigger batches + the async pipeline hide it.
         batch_lines = batch_lines or 8192
         service = AsyncFilterService(log_filter, stats=stats)
-    else:
-        raise ValueError(f"unknown filter backend {backend!r}")
     return FilterPipeline(
         log_filter=log_filter,
         stats=stats,

@@ -225,10 +225,14 @@ class FanoutRunner:
         except asyncio.TimeoutError:
             return not self._stopping
 
-    def _spawn(self, job: StreamJob, tasks: list) -> None:
+    @staticmethod
+    def _create_file(job: StreamJob) -> None:
         # Create (truncate) the log file up front (cmd/root.go:245-257).
         os.makedirs(os.path.dirname(job.path) or ".", exist_ok=True)
         open(job.path, "wb").close()
+
+    def _spawn(self, job: StreamJob, tasks: list) -> None:
+        self._create_file(job)
         tasks.append(asyncio.create_task(self._worker(job)))
 
     async def _discover_loop(self, plan_new, interval_s: float,
@@ -261,8 +265,11 @@ class FanoutRunner:
                                     for j in fresh[:6])
                           + ("…" if len(fresh) > 6 else ""))
                 for j in fresh:
-                    seen.add((j.pod, j.container, j.init))
+                    # seen only AFTER a successful spawn: a transient
+                    # file-creation failure must leave the job eligible
+                    # for the next poll, not silently drop it forever.
                     self._spawn(j, tasks)
+                    seen.add((j.pod, j.container, j.init))
             except Exception as e:
                 # Includes _spawn's file creation (full disk, lost
                 # permissions): warn and keep polling — a transient
@@ -286,9 +293,14 @@ class FanoutRunner:
         — new pods matching the selection start streaming mid-follow.
         With discovery active the run ends on ``stop`` (new work can
         always appear), never by worker exhaustion."""
-        tasks: list[asyncio.Task] = []
+        # Two phases, as the reference does it (cmd/root.go:245-257):
+        # create/truncate EVERY log file before any worker starts, so a
+        # file-creation failure propagates with zero tasks running (no
+        # orphaned streams to leak).
         for job in jobs:
-            self._spawn(job, tasks)
+            self._create_file(job)
+        tasks: list[asyncio.Task] = [
+            asyncio.create_task(self._worker(j)) for j in jobs]
 
         seen = {(j.pod, j.container, j.init) for j in jobs}
         poller = (asyncio.create_task(

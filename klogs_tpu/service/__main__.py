@@ -14,8 +14,11 @@ def main() -> None:
         description="klogs_tpu filter service: owns the TPU engine, "
         "serves Match RPCs to log collectors",
     )
-    ap.add_argument("--match", action="append", required=True,
-                    help="regex pattern (repeatable)")
+    ap.add_argument("--match", action="append", default=[],
+                    help="regex pattern to KEEP (repeatable)")
+    ap.add_argument("--exclude", action="append", default=[],
+                    help="regex pattern to DROP even when kept "
+                    "(repeatable; alone = keep all non-matching)")
     ap.add_argument("--backend", choices=["cpu", "tpu"], default="tpu")
     ap.add_argument("-I", "--ignore-case", action="store_true",
                     dest="ignore_case",
@@ -46,7 +49,8 @@ def main() -> None:
                           ignore_case=ns.ignore_case,
                           tls_cert=ns.tls_cert, tls_key=ns.tls_key,
                           tls_client_ca=ns.tls_client_ca,
-                          auth_token_file=ns.auth_token_file))
+                          auth_token_file=ns.auth_token_file,
+                          exclude=ns.exclude))
     except KeyboardInterrupt:
         pass
     except RegexSyntaxError as e:  # subclasses ValueError: catch first

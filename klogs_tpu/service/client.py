@@ -111,14 +111,21 @@ class RemoteFilterClient:
             raise self._friendly(e) from e
 
     async def verify_patterns(self, patterns: list[str],
-                              ignore_case: bool = False) -> None:
+                              ignore_case: bool = False,
+                              exclude: "list[str] | None" = None) -> None:
         """Fail fast if the server filters with a different pattern set
-        (or case mode) than this collector was invoked with."""
+        (case mode or exclude set) than this collector was invoked
+        with."""
         info = await self.hello()
         if list(info.get("patterns", [])) != list(patterns):
             raise PatternMismatch(
                 f"filter service at {self._target} serves patterns "
                 f"{info.get('patterns')!r}, collector wants {patterns!r}"
+            )
+        if list(info.get("exclude", [])) != list(exclude or []):
+            raise PatternMismatch(
+                f"filter service at {self._target} has exclude patterns "
+                f"{info.get('exclude')!r}, collector wants {exclude or []!r}"
             )
         if bool(info.get("ignore_case", False)) != bool(ignore_case):
             raise PatternMismatch(

@@ -36,14 +36,20 @@ from klogs_tpu.version import BUILD_VERSION
 
 
 def _make_filter(patterns: list[str], backend: str,
-                 ignore_case: bool = False):
-    if backend == "cpu":
-        from klogs_tpu.filters.cpu import RegexFilter
+                 ignore_case: bool = False,
+                 exclude: "list[str] | None" = None):
+    from klogs_tpu.filters.base import build_include_exclude
 
-        return RegexFilter(patterns, ignore_case=ignore_case)
-    from klogs_tpu.filters.tpu import NFAEngineFilter
+    def one(pats):
+        if backend == "cpu":
+            from klogs_tpu.filters.cpu import RegexFilter
 
-    return NFAEngineFilter(patterns, ignore_case=ignore_case)
+            return RegexFilter(pats, ignore_case=ignore_case)
+        from klogs_tpu.filters.tpu import NFAEngineFilter
+
+        return NFAEngineFilter(pats, ignore_case=ignore_case)
+
+    return build_include_exclude(one, patterns, exclude)
 
 
 class FilterServer:
@@ -53,7 +59,8 @@ class FilterServer:
                  tls_cert: str | None = None, tls_key: str | None = None,
                  tls_client_ca: str | None = None,
                  auth_token: str | None = None,
-                 auth_token_file: str | None = None):
+                 auth_token_file: str | None = None,
+                 exclude: "list[str] | None" = None):
         if bool(tls_cert) != bool(tls_key):
             raise ValueError(
                 "tls_cert and tls_key must be provided together "
@@ -63,6 +70,9 @@ class FilterServer:
         if auth_token and auth_token_file:
             raise ValueError("pass auth_token OR auth_token_file, not both")
         self.patterns = list(patterns)
+        self.exclude = list(exclude or [])
+        if not self.patterns and not self.exclude:
+            raise ValueError("need at least one --match or --exclude pattern")
         self.backend = backend
         self.host = host
         self.port = port
@@ -73,7 +83,8 @@ class FilterServer:
         self.auth_token = auth_token
         self.auth_token_file = auth_token_file
         self._service = AsyncFilterService(
-            _make_filter(patterns, backend, ignore_case=ignore_case))
+            _make_filter(patterns, backend, ignore_case=ignore_case,
+                         exclude=self.exclude))
         self._server: grpc.aio.Server | None = None
 
     @property
@@ -111,6 +122,7 @@ class FilterServer:
         await self._check_auth(context)
         return transport.pack({
             "patterns": self.patterns,
+            "exclude": self.exclude,
             "ignore_case": self.ignore_case,
             "backend": self.backend,
             "version": BUILD_VERSION,

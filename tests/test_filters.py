@@ -211,3 +211,51 @@ def test_ignore_case_both_engines():
     assert ci_cpu == ci_tpu == [True, True, True, True, False]
     cs = RegexFilter(pats).match_lines(lines)
     assert cs == [False, True, False, False, False]
+
+
+def test_include_exclude_filter_combinations():
+    """keep = include AND NOT exclude; exclude-only = inverse match.
+    Verified across cpu and interpret-kernel engines, matching re."""
+    import re as _re
+
+    from klogs_tpu.filters.base import IncludeExcludeFilter
+    from klogs_tpu.filters.cpu import RegexFilter
+    from klogs_tpu.filters.tpu import NFAEngineFilter
+
+    lines = [b"ERROR boot", b"ERROR healthz ping", b"INFO fine",
+             b"WARN healthz", b"panic: x", b""]
+    inc_p, exc_p = ["ERROR", "panic"], ["healthz"]
+
+    def expect(line):
+        keep = any(_re.search(p.encode(), line) for p in inc_p)
+        drop = any(_re.search(p.encode(), line) for p in exc_p)
+        return keep and not drop
+
+    for mk in (lambda p: RegexFilter(p),
+               lambda p: NFAEngineFilter(p, kernel="interpret")):
+        f = IncludeExcludeFilter(mk(inc_p), mk(exc_p))
+        assert f.match_lines(lines) == [expect(ln) for ln in lines]
+        # two-phase path (what AsyncFilterService drives)
+        assert f.fetch(f.dispatch(lines)) == [expect(ln) for ln in lines]
+        f.close()
+    # exclude-only: inverse match
+    f = IncludeExcludeFilter(None, RegexFilter(exc_p))
+    assert f.match_lines(lines) == [
+        not any(_re.search(p.encode(), ln) for p in exc_p) for ln in lines]
+    f.close()
+
+
+def test_make_pipeline_exclude_modes(tmp_path):
+    from klogs_tpu.filters.sink import make_pipeline
+
+    # include + exclude
+    p = make_pipeline(["ERROR"], "cpu", exclude=["healthz"])
+    got = p.log_filter.match_lines(
+        [b"ERROR a", b"ERROR healthz", b"ok healthz", b"meh"])
+    assert got == [True, False, False, False]
+    p.close()
+    # exclude-only
+    p = make_pipeline([], "cpu", exclude=["noise"])
+    got = p.log_filter.match_lines([b"noise here", b"signal"])
+    assert got == [False, True]
+    p.close()

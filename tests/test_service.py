@@ -308,3 +308,40 @@ def test_bearer_token_rotation_survives(tmp_path):
             await server.stop()
 
     asyncio.run(run())
+
+
+def test_exclude_patterns_over_service():
+    """filterd with --match + --exclude semantics; the handshake also
+    verifies the exclude set (divergent filtering is impossible)."""
+    async def run():
+        server = FilterServer(["ERROR"], backend="cpu", port=0,
+                              exclude=["healthz"])
+        port = await server.start()
+        client = RemoteFilterClient(f"127.0.0.1:{port}")
+        try:
+            await client.verify_patterns(["ERROR"], exclude=["healthz"])
+            got = await client.match(
+                [b"ERROR a", b"ERROR healthz", b"fine"])
+            assert got == [True, False, False]
+            with pytest.raises(PatternMismatch, match="exclude"):
+                await client.verify_patterns(["ERROR"], exclude=[])
+        finally:
+            await client.aclose()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_exclude_only_service():
+    async def run():
+        server = FilterServer([], backend="cpu", port=0, exclude=["debug"])
+        port = await server.start()
+        client = RemoteFilterClient(f"127.0.0.1:{port}")
+        try:
+            got = await client.match([b"debug x", b"keep me"])
+            assert got == [False, True]
+        finally:
+            await client.aclose()
+            await server.stop()
+
+    asyncio.run(run())
