@@ -128,7 +128,7 @@ def safe_oracle(patterns, line: bytes, flags: int, budget_s: float = 2.0):
 
 
 def engine_check(pats, lines, ignore_case, chunk_bytes=4096,
-                 mask_block=None):
+                 mask_block=None, exclude=None):
     """Full production path hermetically: pack_classify -> grouped
     interpret kernel. Returns the verdict list. A small chunk_bytes
     routes longer lines through the carried-state chunk protocol
@@ -147,8 +147,18 @@ def engine_check(pats, lines, ignore_case, chunk_bytes=4096,
     if mask_block:
         os.environ["KLOGS_TPU_MASK_BLOCK"] = str(mask_block)
     try:
-        filt = NFAEngineFilter(pats, ignore_case=ignore_case,
-                               kernel="interpret", chunk_bytes=chunk_bytes)
+        if exclude:
+            from klogs_tpu.filters.base import build_include_exclude
+
+            filt = build_include_exclude(
+                lambda p: NFAEngineFilter(p, ignore_case=ignore_case,
+                                          kernel="interpret",
+                                          chunk_bytes=chunk_bytes),
+                pats, exclude)
+        else:
+            filt = NFAEngineFilter(pats, ignore_case=ignore_case,
+                                   kernel="interpret",
+                                   chunk_bytes=chunk_bytes)
         return filt.match_lines(lines)
     finally:
         for k, v in saved.items():
@@ -232,8 +242,24 @@ def main() -> int:
             all_lines = lines + long_lines
             all_expects = expects + long_expects
             mb = rng.choice((None, None, 2, 4, 8))
-            verdicts = engine_check(pats, all_lines, ignore_case,
-                                    chunk_bytes=256, mask_block=mb)
+            # Sometimes split the set: last pattern(s) become EXCLUDES
+            # (keep = any(include) and not any(exclude)) — the
+            # IncludeExcludeFilter combinator under the full grammar.
+            exc = []
+            if len(pats) >= 2 and rng.random() < 0.3:
+                n_exc = rng.randrange(1, len(pats))
+                inc_pats, exc = pats[:-n_exc], pats[-n_exc:]
+            else:
+                inc_pats = pats
+            if exc:
+                all_expects = [
+                    e and not safe_oracle(exc, ln, flags)
+                    for e, ln in zip(
+                        [safe_oracle(inc_pats, ln, flags)
+                         for ln in all_lines], all_lines)]
+            verdicts = engine_check(inc_pats, all_lines, ignore_case,
+                                    chunk_bytes=256, mask_block=mb,
+                                    exclude=exc)
             if verdicts != all_expects:
                 bad = next(i for i in range(len(all_lines))
                            if verdicts[i] != all_expects[i])
@@ -241,7 +267,8 @@ def main() -> int:
                 shown = (f"{bad_line[:120]!r}..." if len(bad_line) > 120
                          else repr(bad_line))
                 print(f"DIVERGENCE (interpret kernel): seed={seed} "
-                      f"trial={trial} patterns={pats!r} ignore_case="
+                      f"trial={trial} patterns={inc_pats!r} exclude={exc!r} "
+                      f"ignore_case="
                       f"{ignore_case} mask_block={mb} len={len(bad_line)} "
                       f"line={shown} "
                       f"kernel={verdicts[bad]} re={all_expects[bad]}",
