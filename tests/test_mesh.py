@@ -167,3 +167,21 @@ def test_boundary_patterns_across_pattern_shards():
     lines = [b"error", b"errors", b"an error.", b"code=503", b"warned",
              b"warn", b"FATAL x", b"xFATAL", b"x42", b"x42y", b"", b"-"] * 2
     assert f.match_lines(lines) == [oracle(pats, ln) for ln in lines]
+
+
+def test_exclude_with_mesh_engines():
+    """make_pipeline on a multi-device backend builds BOTH the include
+    and exclude sides as MeshEngines; the two sharded automata must
+    coexist and the combined verdicts must match re."""
+    import re as _re
+
+    from klogs_tpu.filters.sink import make_pipeline
+
+    p = make_pipeline(["ERROR", r"\bpanic\b"], "tpu", exclude=["healthz"])
+    lines = [b"ERROR up", b"ERROR healthz", b"panic: x", b"panics",
+             b"healthz ok", b"fine"] * 4
+    got = p.log_filter.match_lines(lines)
+    want = [(bool(_re.search(rb"ERROR", ln) or _re.search(rb"\bpanic\b", ln))
+             and not _re.search(rb"healthz", ln)) for ln in lines]
+    assert got == want
+    p.close()
