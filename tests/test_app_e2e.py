@@ -287,3 +287,20 @@ def test_watch_new_waits_on_empty_initial_selection(tmp_path, monkeypatch):
     rc = asyncio.run(asyncio.wait_for(scenario(), timeout=20))
     assert rc == 0
     assert "first-pod__c0.log" in os.listdir(out_dir)
+
+
+def test_profile_writes_trace(tmp_path):
+    """--profile captures a JAX profiler trace of the filtered run."""
+    out_dir = str(tmp_path / "logs")
+    trace_dir = str(tmp_path / "trace")
+    fc = FakeCluster.synthetic(n_pods=1, n_containers=1,
+                               lines_per_container=50)
+    opts = parse_args(["-n", "default", "-a", "-t", "50",
+                       "--match", "ERROR", "--backend", "tpu",
+                       "--profile", trace_dir, "-p", out_dir])
+    rc = asyncio.run(app.run_async(opts, backend=fc))
+    assert rc == 0
+    # A trace was serialized (plugins/profile/.../*.trace.json.gz etc.)
+    contents = [str(p) for p in __import__("pathlib").Path(trace_dir).rglob("*")
+                if p.is_file()]
+    assert contents, "profiler trace directory is empty"
