@@ -137,7 +137,11 @@ def device_lps(lines, repeats: int):
         host_prep = len(bodies) / (time.perf_counter() - t0)
         dcls = jax.device_put(cls)
         n_rows = cls.shape[0]
-        kw = {}
+        from klogs_tpu.ops.tune import kernel_kwargs
+
+        # Measured hardware default (mask_block=4) unless the env picks
+        # a variant; the tune sweep below overwrites when enabled.
+        kw = kernel_kwargs(on_hardware=True)
         if os.environ.get("KLOGS_BENCH_TUNE") == "1":
             from klogs_tpu.ops.tune import tune_grouped
 

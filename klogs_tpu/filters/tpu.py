@@ -213,6 +213,13 @@ class NFAEngineFilter(LogFilter):
                     self._dp_aug.byte_class).astype(np.int8)
             else:
                 self._aug_cls_table = None
+            # Degrade memory for the DEFAULTED chain variant
+            # (mask_block=4 on hardware): chain restructurings are
+            # compile-fragile on unproven backends (mask_block=8/16
+            # fail Mosaic on v5e), so a default-variant failure flips
+            # this and the engine continues on the plain chain. An
+            # env-forced variant stays loud.
+            self._chain_fallback = False
             # Two-phase filter: a mandatory-pair candidate mask gates
             # which kernel tiles run (ops/pallas_nfa skip-tiles path).
             # Default OFF: the 2026-07-29 device A/B (BENCH_DEVICE.json)
@@ -294,8 +301,8 @@ class NFAEngineFilter(LogFilter):
                 parts.append((idxs, *self._match_cls_dispatch(sub, width)))
             else:
                 batch, lengths = pack_lines(sub, width)
-                parts.append((idxs, self._match_full(batch, lengths),
-                              None, None))
+                parts.append((idxs, *self._match_full(batch, lengths),
+                              None))
         if long_idx:
             parts.append(
                 (long_idx, self._match_long([bodies[i] for i in long_idx]),
@@ -326,9 +333,8 @@ class NFAEngineFilter(LogFilter):
                 from klogs_tpu.ui import term
 
                 term.warning(
-                    "prefiltered kernel failed at fetch (%s); "
-                    "falling back to plain NFA", str(e)[:120])
-                self._pf_tables = None
+                    "device kernel failed at fetch (%s); "
+                    "retrying on the plain path", str(e)[:120])
                 vals = np.asarray(retry())
                 pf = None
             out[idxs] = vals[: len(idxs)]
@@ -350,10 +356,17 @@ class NFAEngineFilter(LogFilter):
             retry = None
             if getattr(eng, "gated", False):
                 # Degrade path for an opt-in gated kernel that fails
-                # asynchronously: fetch() retries on the plain fn.
+                # asynchronously: fetch() retries on the plain fn (whose
+                # own sync chain-degrade then covers a chain fault).
                 def retry(cls=cls):
                     eng.disable_prefilter()
                     return eng.match_cls(cls, plain=True)
+            elif getattr(eng, "_chain_defaulted", False):
+                # No gating, but the DEFAULTED chain variant can still
+                # fail asynchronously at fetch: degrade and rerun.
+                def retry(cls=cls):
+                    eng.degrade_chain()
+                    return eng.match_cls(cls)
             try:
                 return eng.match_cls(cls), retry, None
             except Exception as e:
@@ -368,10 +381,26 @@ class NFAEngineFilter(LogFilter):
         dpg = self._dp_grouped
         cls = pack_classify(bodies, width, self._cls_table,
                             dpg.begin_class, dpg.end_class, dpg.pad_class)
-        from klogs_tpu.ops.tune import env_overrides
-
         interpret = self._kernel == "interpret"
-        kw = env_overrides()
+        kw, chain_defaulted = self._chain_kwargs(interpret)
+        def plain_retry(record: bool = True):
+            # Rerun without prefilter gating, and without the chain
+            # restructure ONLY if the chain was a default — an
+            # env-forced variant is kept even here (the operator asked
+            # to measure exactly that kernel; if it is the async fault
+            # the rerun fails again and raises loudly, same policy as
+            # the sync path). Bookkeeping rides inside so the generic
+            # fetch-time retry path needs no per-cause branching.
+            if record:
+                if self._pf_tables is not None:
+                    self._pf_tables = None
+                if chain_defaulted:
+                    self._chain_fallback = True
+            rerun_kw = dict(kw, mask_block=1) if chain_defaulted else kw
+            return self._pallas.match_cls_grouped_pallas(
+                dpg, self._g_live, self._g_acc, cls,
+                interpret=interpret, **rerun_kw)
+
         if self._pf_tables is not None and len(self._pf_tables) == 4:
             want_stats = self._stats is not None
             try:
@@ -381,10 +410,7 @@ class NFAEngineFilter(LogFilter):
                     prefilter_tables=self._pf_tables,
                     return_stats=want_stats, **kw)
                 mask, pf = res if want_stats else (res, None)
-                retry = lambda: self._pallas.match_cls_grouped_pallas(
-                    dpg, self._g_live, self._g_acc, cls,
-                    interpret=interpret, **kw)
-                return mask, retry, pf
+                return mask, plain_retry, pf
             except Exception as e:
                 # Gated-kernel compile trouble (Mosaic) must degrade to
                 # the plain NFA, not kill the streaming run.
@@ -394,22 +420,81 @@ class NFAEngineFilter(LogFilter):
                     "prefiltered kernel unavailable (%s); "
                     "falling back to plain NFA", str(e)[:120])
                 self._pf_tables = None
-        return self._pallas.match_cls_grouped_pallas(
-            dpg, self._g_live, self._g_acc, cls,
-            interpret=interpret, **kw), None, None
+        try:
+            mask = self._pallas.match_cls_grouped_pallas(
+                dpg, self._g_live, self._g_acc, cls,
+                interpret=interpret, **kw)
+        except Exception as e:
+            if not chain_defaulted:
+                raise
+            from klogs_tpu.ui import term
 
-    def _match_full(self, batch: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+            term.warning(
+                "default mask_block=%d chain failed on this backend (%s); "
+                "continuing on the plain chain",
+                kw.get("mask_block"), str(e)[:120])
+            return plain_retry(), None, None
+        # A defaulted chain variant can also fail ASYNCHRONOUSLY (device
+        # execution surfaces at fetch); hand fetch() the same retry.
+        return mask, (plain_retry if chain_defaulted else None), None
+
+    def _chain_kwargs(self, interpret: bool):
+        """(kernel kwargs, chain_defaulted): tune.chain_selection plus
+        the degrade memory — after a default-variant failure every later
+        batch runs the plain chain directly."""
+        from klogs_tpu.ops.tune import chain_selection
+
+        kw, defaulted, _ = chain_selection(on_hardware=not interpret)
+        if self._chain_fallback and defaulted:
+            kw["mask_block"] = 1
+            defaulted = False
+        return kw, defaulted
+
+    def _match_full(self, batch: np.ndarray, lengths: np.ndarray):
+        """Byte-consuming full-line path (device-side classify).
+        Returns (device_mask, retry_or_None) — the retry covers an
+        ASYNC failure of a defaulted chain variant surfacing at
+        fetch(), mirroring _match_cls_dispatch."""
         if self._engine is not None:
-            return self._engine.match_batch(batch, lengths)
+            eng = self._engine
+            retry = None
+            if getattr(eng, "gated", False):
+                def retry(batch=batch, lengths=lengths):
+                    eng.disable_prefilter()
+                    return eng.match_batch(batch, lengths)
+            elif getattr(eng, "_chain_defaulted", False):
+                def retry(batch=batch, lengths=lengths):
+                    eng.degrade_chain()
+                    return eng.match_batch(batch, lengths)
+            return eng.match_batch(batch, lengths), retry
         if self._kernel in ("pallas", "interpret"):
-            from klogs_tpu.ops.tune import env_overrides
+            interpret = self._kernel == "interpret"
+            kw, chain_defaulted = self._chain_kwargs(interpret)
 
-            return self._pallas.match_batch_grouped_pallas(
-                self._dp_grouped, self._g_live, self._g_acc, batch, lengths,
-                interpret=(self._kernel == "interpret"),
-                **env_overrides(),
-            )
-        return self._nfa.match_batch(self._dp, batch, lengths)
+            def plain_retry(record: bool = True):
+                if record:
+                    self._chain_fallback = True
+                return self._pallas.match_batch_grouped_pallas(
+                    self._dp_grouped, self._g_live, self._g_acc,
+                    batch, lengths, interpret=interpret,
+                    **dict(kw, mask_block=1))
+
+            try:
+                mask = self._pallas.match_batch_grouped_pallas(
+                    self._dp_grouped, self._g_live, self._g_acc,
+                    batch, lengths, interpret=interpret, **kw)
+            except Exception as e:
+                if not chain_defaulted:
+                    raise
+                from klogs_tpu.ui import term
+
+                term.warning(
+                    "default mask_block=%d chain failed on this backend "
+                    "(%s); continuing on the plain chain",
+                    kw.get("mask_block"), str(e)[:120])
+                return plain_retry(), None
+            return mask, (plain_retry if chain_defaulted else None)
+        return self._nfa.match_batch(self._dp, batch, lengths), None
 
     def _match_long(self, bodies: list[bytes]) -> np.ndarray:
         """Carried-state chunked matching: all long lines advance in

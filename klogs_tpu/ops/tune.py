@@ -177,3 +177,55 @@ def env_overrides() -> dict:
     if os.environ.get("KLOGS_TPU_MASK_BLOCK"):
         out["mask_block"] = int(os.environ["KLOGS_TPU_MASK_BLOCK"])
     return out
+
+
+# Measured hardware default (kernel-variant A/B 2026-07-31,
+# OPERATING_POINT.json "fused_ab"): mask_block=4 pulls each block's four
+# step masks (one-hot + char-mask matmul, state-independent work) off
+# the serial chain, measuring 9.64M lines/s vs 8.42M for the plain chain
+# at the 1M x 64-in-flight operating point on v5e (+13%; fused-groups
+# ties plain, mask_block=8/16 fail Mosaic compile on real hardware).
+HW_DEFAULT_MASK_BLOCK = 4
+
+
+def chain_selection(on_hardware: bool,
+                    allow_fused: bool = True) -> tuple[dict, bool, bool]:
+    """THE chain-variant policy — every consumer (single-chip engine,
+    mesh per-shard, bench) derives its kernel kwargs here so the rules
+    live in one place. Returns ``(kw, chain_defaulted, dropped_fused)``:
+
+    - ``kw``: env_overrides() plus the measured hardware default — on a
+      real TPU backend, when the env picks no conflicting chain variant,
+      mask_block=HW_DEFAULT_MASK_BLOCK. KLOGS_TPU_MASK_BLOCK=1 forces
+      the plain chain; KLOGS_TPU_INTERLEAVE=1 restates the interleave
+      default and does NOT suppress the mask_block default (only
+      interleave>1 actually conflicts — pallas rejects the combo
+      loudly). Interpret/CPU paths keep the plain chain (no hardware
+      pipeline to win on, and hermetic tests should exercise the same
+      default they can verify).
+    - ``chain_defaulted``: the mask_block came from the DEFAULT, not the
+      env — eligible for degrade-to-plain on compile/exec failure. An
+      env-forced variant is never defaulted: the operator asked to
+      measure exactly that kernel, so failures stay loud.
+    - ``dropped_fused``: allow_fused=False (mesh per-shard compute,
+      where one body backs both the plain and gated builds and fused
+      has no gated sibling) removed an env-requested fused=True; the
+      caller must WARN (silently measuring a different kernel corrupts
+      pick-by-measurement). With fused dropped the chain is unpicked
+      again, so the default re-applies."""
+    env = env_overrides()
+    kw = dict(env)
+    dropped_fused = bool(not allow_fused and kw.pop("fused", False))
+    picked_variant = ("mask_block" in kw or kw.get("fused")
+                      or kw.get("interleave", 1) != 1)
+    if on_hardware and not picked_variant:
+        kw["mask_block"] = HW_DEFAULT_MASK_BLOCK
+    chain_defaulted = (kw.get("mask_block", 1) > 1
+                       and "mask_block" not in env)
+    return kw, chain_defaulted, dropped_fused
+
+
+def kernel_kwargs(on_hardware: bool) -> dict:
+    """chain_selection()'s kwargs alone, for callers that manage their
+    own variant sweep (bench tools)."""
+    return chain_selection(on_hardware)[0]

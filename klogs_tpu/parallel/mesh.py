@@ -231,24 +231,42 @@ class MeshEngine:
                 and self.cls_table is not None:
             pf_stacked = self._stack_prefilters(groups, ignore_case, glob, C)
 
-        def per_shard(dp_shard, cls_local, *pf_shard):
-            local = jax.tree_util.tree_map(lambda x: x[0], dp_shard)
-            pf = tuple(x[0] for x in pf_shard) if pf_shard else None
-            # tile_b is a cap; the kernel wrapper pads any local batch up
-            # to a tile multiple, so non-power-of-two shard sizes work.
-            matched = match_cls_grouped_pallas(
-                local, live, acc, cls_local,
-                tile_b=2048, interpret=interpret,
-                prefilter_tables=pf,
-            )
-            return jax.lax.pmax(matched.astype(jnp.int32), "pattern") > 0
+        # Same chain-variant policy as the single-chip hot path
+        # (tune.chain_selection: measured default mask_block=4 on
+        # hardware, env-overridable), minus `fused` — it has no gated
+        # sibling while this one per_shard body backs both the plain and
+        # gated builds, so chain_selection drops it and we warn.
+        from klogs_tpu.ops.tune import chain_selection
+
+        vkw, self._chain_defaulted, dropped_fused = chain_selection(
+            not interpret, allow_fused=False)
+        if dropped_fused:
+            from klogs_tpu.ui import term
+
+            term.warning(
+                "KLOGS_TPU_FUSED_GROUPS=1 has no mesh per-shard variant; "
+                "using the default chain instead")
+        # tile_b is a cap; the kernel wrapper pads any local batch up
+        # to a tile multiple, so non-power-of-two shard sizes work.
+        vkw.setdefault("tile_b", 2048)
+        self._vkw = vkw
 
         try:
             from jax import shard_map
         except ImportError:
             from jax.experimental.shard_map import shard_map
 
-        def build(with_pf: bool):
+        def build(with_pf: bool, vkw=vkw):
+            def per_shard(dp_shard, cls_local, *pf_shard):
+                local = jax.tree_util.tree_map(lambda x: x[0], dp_shard)
+                pf = tuple(x[0] for x in pf_shard) if pf_shard else None
+                matched = match_cls_grouped_pallas(
+                    local, live, acc, cls_local,
+                    interpret=interpret,
+                    prefilter_tables=pf, **vkw,
+                )
+                return jax.lax.pmax(matched.astype(jnp.int32), "pattern") > 0
+
             in_specs = [
                 jax.tree_util.tree_map(lambda _: P("pattern"), stacked),
                 P("data", None),
@@ -265,6 +283,8 @@ class MeshEngine:
                 return jax.jit(
                     lambda dp, cls, pf=pf_stacked: smapped(dp, cls, *pf))
             return jax.jit(smapped)
+
+        self._build = build
 
         # The plain fn always exists: it is both the default path and
         # the degrade target when the opt-in gated kernel fails (same
@@ -351,8 +371,42 @@ class MeshEngine:
                 [cls, np.full((Bp - B, cls.shape[1]), self.pad_class,
                               dtype=cls.dtype)]
             )
-        fn = self._fn if (plain or not self.gated) else self._fn_gated
-        return fn(self.dp, cls)
+        use_gated = not plain and self.gated
+        fn = self._fn_gated if use_gated else self._fn
+        try:
+            return fn(self.dp, cls)
+        except Exception as e:
+            # Chain-variant compile fragility is a known failure mode
+            # (mask_block=8/16 fail Mosaic on v5e). A DEFAULTED variant
+            # failing on the PLAIN fn degrades to the plain chain
+            # instead of killing the run. A gated-fn failure is NOT
+            # attributed to the chain (the prefilter machinery is the
+            # other suspect) — it propagates to the caller's
+            # disable-prefilter retry, whose plain rerun comes back
+            # through here and exercises this degrade if the chain
+            # really is at fault. An env-forced variant stays loud —
+            # the operator asked to measure exactly that kernel.
+            if use_gated or not getattr(self, "_chain_defaulted", False):
+                raise
+            from klogs_tpu.ui import term
+
+            term.warning(
+                "default mask_block=%d chain failed on this backend (%s); "
+                "rebuilding with the plain chain",
+                self._vkw.get("mask_block"), str(e)[:120])
+            self.degrade_chain()
+            return self._fn(self.dp, cls)
+
+    def degrade_chain(self) -> None:
+        """Rebuild both fns on the plain serial chain (mask_block=1) —
+        the degrade target after a defaulted-chain-variant failure
+        (sync, via match_cls; or async at fetch, via the filter's retry
+        closure)."""
+        self._chain_defaulted = False
+        self._vkw = dict(self._vkw, mask_block=1)
+        self._fn = self._build(False, self._vkw)
+        if self.gated:
+            self._fn_gated = self._build(True, self._vkw)
 
     def close(self) -> None:
         pass

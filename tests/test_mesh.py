@@ -185,3 +185,52 @@ def test_exclude_with_mesh_engines():
              and not _re.search(rb"healthz", ln)) for ln in lines]
     assert got == want
     p.close()
+
+
+def test_mesh_defaulted_chain_degrades_to_plain(monkeypatch, capsys):
+    """A DEFAULTED chain variant that fails to compile on the mesh path
+    rebuilds both fns on the plain chain instead of killing the run."""
+    import klogs_tpu.ops.pallas_nfa as pallas_nfa
+    import klogs_tpu.ops.tune as tune
+
+    monkeypatch.setattr(
+        tune, "chain_selection",
+        lambda on_hardware, allow_fused=True: ({"mask_block": 4}, True,
+                                               False))
+    real = pallas_nfa.match_cls_grouped_pallas
+
+    def fragile(*args, **kw):
+        if kw.get("mask_block", 1) > 1:
+            raise RuntimeError("Mosaic rejected the restructured chain")
+        return real(*args, **kw)
+
+    monkeypatch.setattr(pallas_nfa, "match_cls_grouped_pallas", fragile)
+    eng = MeshEngine(["ERROR"], grid=(4, 2), impl="pallas_interpret")
+    assert eng._chain_defaulted
+    f = NFAEngineFilter(["ERROR"], engine=eng)
+    assert f.match_lines([b"ERROR x", b"clean"]) == [True, False]
+    assert "rebuilding with the plain chain" in capsys.readouterr().out
+    assert eng._vkw["mask_block"] == 1
+    # Degrade is sticky: the next batch runs the rebuilt fns directly.
+    assert f.match_lines([b"more ERROR"]) == [True]
+
+
+def test_mesh_drops_fused_loudly_and_reapplies_default(monkeypatch, capsys):
+    """KLOGS_TPU_FUSED_GROUPS=1 has no mesh per-shard variant: dropping
+    it must warn (pick-by-measurement rule), and with the chain then
+    unpicked the measured hardware default re-applies."""
+    from klogs_tpu.ops.tune import HW_DEFAULT_MASK_BLOCK
+
+    monkeypatch.setenv("KLOGS_TPU_FUSED_GROUPS", "1")
+    # impl="pallas" (interpret=False) exercises the hardware branch;
+    # construction only builds the jitted wrappers, nothing compiles.
+    eng = MeshEngine(["ERROR"], grid=(4, 2), impl="pallas")
+    assert "no mesh per-shard variant" in capsys.readouterr().out
+    assert "fused" not in eng._vkw
+    assert eng._vkw["mask_block"] == HW_DEFAULT_MASK_BLOCK
+
+    # On the interpret impl the plain chain is kept (no hardware
+    # default), but the warning still fires.
+    eng2 = MeshEngine(["ERROR"], grid=(4, 2), impl="pallas_interpret")
+    assert "no mesh per-shard variant" in capsys.readouterr().out
+    assert "mask_block" not in eng2._vkw

@@ -201,3 +201,54 @@ def test_many_class_fallback_to_device_classify(monkeypatch):
     assert f._cls_table is None  # host classification declined
     lines = [b"ERROR x", b"fine", b"panic: y", b"code=77", b"code=x"] * 10
     assert f.match_lines(lines) == RegexFilter(pats).match_lines(lines)
+
+
+def test_defaulted_chain_variant_degrades_to_plain(monkeypatch, capsys):
+    """The hardware-default mask_block=4 chain is compile-fragile on
+    unproven backends (K=8/16 already fail Mosaic on v5e): a failure of
+    the DEFAULTED variant must degrade to the plain chain and keep the
+    run alive, and later batches must skip the broken variant."""
+    import klogs_tpu.ops.pallas_nfa as pallas_nfa
+    import klogs_tpu.ops.tune as tune
+    from klogs_tpu.filters.tpu import NFAEngineFilter
+
+    monkeypatch.setattr(
+        tune, "chain_selection",
+        lambda on_hardware, allow_fused=True: ({"mask_block": 4}, True,
+                                               False))
+    real = pallas_nfa.match_cls_grouped_pallas
+    seen = []
+
+    def fragile(*args, **kw):
+        seen.append(kw.get("mask_block", 1))
+        if kw.get("mask_block", 1) > 1:
+            raise RuntimeError("Mosaic rejected the restructured chain")
+        return real(*args, **kw)
+
+    monkeypatch.setattr(pallas_nfa, "match_cls_grouped_pallas", fragile)
+    f = NFAEngineFilter(["ERROR"], kernel="interpret")
+    assert f.match_lines([b"ERROR x", b"clean"]) == [True, False]
+    assert "continuing on the plain chain" in capsys.readouterr().out
+    assert f._chain_fallback
+    # Later batches run the plain chain directly — no repeat failures.
+    assert f.match_lines([b"ERROR y"]) == [True]
+    assert seen[-1] == 1
+
+
+def test_env_forced_chain_variant_stays_loud(monkeypatch):
+    """An operator-forced variant must fail loudly, not silently run a
+    different kernel (the pick-by-measurement rule)."""
+    import klogs_tpu.ops.pallas_nfa as pallas_nfa
+    from klogs_tpu.filters.tpu import NFAEngineFilter
+
+    monkeypatch.setenv("KLOGS_TPU_MASK_BLOCK", "4")
+
+    def fragile(*args, **kw):
+        if kw.get("mask_block", 1) > 1:
+            raise RuntimeError("Mosaic rejected the restructured chain")
+        raise AssertionError("env-forced variant must not silently degrade")
+
+    monkeypatch.setattr(pallas_nfa, "match_cls_grouped_pallas", fragile)
+    f = NFAEngineFilter(["ERROR"], kernel="interpret")
+    with pytest.raises(RuntimeError, match="Mosaic rejected"):
+        f.match_lines([b"ERROR x"])

@@ -55,7 +55,61 @@ def test_env_overrides(monkeypatch):
     assert env_overrides() == {"tile_b": 2048, "interleave": 2}
 
 
+def test_kernel_kwargs_hardware_default(monkeypatch):
+    from klogs_tpu.ops.tune import HW_DEFAULT_MASK_BLOCK, kernel_kwargs
+
+    # Real hardware, no env: the measured default chain variant.
+    assert kernel_kwargs(True) == {"mask_block": HW_DEFAULT_MASK_BLOCK}
+    # Interpret/CPU paths: plain chain.
+    assert kernel_kwargs(False) == {}
+    # KLOGS_TPU_MASK_BLOCK=1 forces the plain chain on hardware.
+    monkeypatch.setenv("KLOGS_TPU_MASK_BLOCK", "1")
+    assert kernel_kwargs(True) == {"mask_block": 1}
+    monkeypatch.setenv("KLOGS_TPU_MASK_BLOCK", "8")
+    assert kernel_kwargs(True) == {"mask_block": 8}
+    monkeypatch.delenv("KLOGS_TPU_MASK_BLOCK")
+    # A CONFLICTING env-picked chain variant suppresses the default
+    # (the combos are rejected loudly by the kernel)...
+    monkeypatch.setenv("KLOGS_TPU_INTERLEAVE", "2")
+    assert kernel_kwargs(True) == {"interleave": 2}
+    # ...but restating the interleave default (=1) does not: only
+    # interleave>1 conflicts with mask_block.
+    monkeypatch.setenv("KLOGS_TPU_INTERLEAVE", "1")
+    assert kernel_kwargs(True) == {
+        "interleave": 1, "mask_block": HW_DEFAULT_MASK_BLOCK}
+    monkeypatch.delenv("KLOGS_TPU_INTERLEAVE")
+    monkeypatch.setenv("KLOGS_TPU_FUSED_GROUPS", "1")
+    assert kernel_kwargs(True) == {"fused": True}
+    monkeypatch.delenv("KLOGS_TPU_FUSED_GROUPS")
+    # A bare tile override is not a chain variant: default still applies.
+    monkeypatch.setenv("KLOGS_TPU_TILE", "4096")
+    assert kernel_kwargs(True) == {
+        "tile_b": 4096, "mask_block": HW_DEFAULT_MASK_BLOCK}
+
+
 def _device_kind():
     import jax
 
     return jax.devices()[0].device_kind
+
+
+def test_chain_selection_flags(monkeypatch):
+    from klogs_tpu.ops.tune import HW_DEFAULT_MASK_BLOCK, chain_selection
+
+    # Default applied -> defaulted (degrade-eligible), no fused drop.
+    assert chain_selection(True) == (
+        {"mask_block": HW_DEFAULT_MASK_BLOCK}, True, False)
+    assert chain_selection(False) == ({}, False, False)
+    # Env-forced mask_block: never defaulted (failures stay loud).
+    monkeypatch.setenv("KLOGS_TPU_MASK_BLOCK", "4")
+    assert chain_selection(True) == ({"mask_block": 4}, False, False)
+    monkeypatch.delenv("KLOGS_TPU_MASK_BLOCK")
+    # Mesh path (allow_fused=False): env fused is dropped LOUDLY and the
+    # chain, unpicked again, gets the hardware default back.
+    monkeypatch.setenv("KLOGS_TPU_FUSED_GROUPS", "1")
+    assert chain_selection(True, allow_fused=False) == (
+        {"mask_block": HW_DEFAULT_MASK_BLOCK}, True, True)
+    # ...but on interpret there is no default to re-apply.
+    assert chain_selection(False, allow_fused=False) == ({}, False, True)
+    # allow_fused=True passes fused through untouched.
+    assert chain_selection(True) == ({"fused": True}, False, False)
