@@ -164,7 +164,8 @@ def print_log_size(log_files: list[str], log_path: str) -> None:
 
 
 async def _watch_for_quit(
-    stop: asyncio.Event, log_path: str, done: "threading.Event"
+    stop: asyncio.Event, message: str, done: "threading.Event",
+    spinner: bool = True,
 ) -> None:
     """pressKeyToExit analog (cmd/root.go:399-421): open the controlling
     terminal (go-tty opens /dev/tty, not stdin), raw-mode key loop until
@@ -173,7 +174,10 @@ async def _watch_for_quit(
     Improvements over the reference: without a controlling terminal we
     warn and stop streaming rather than panicking (root.go:402-403), and
     the reader polls ``done`` so the thread exits (restoring the
-    terminal) when the streams finish on their own."""
+    terminal) when the streams finish on their own. With ``-o
+    stdout|both`` the spinner is replaced by one static line
+    (``spinner=False``): a repainting spinner would garble the live
+    log stream sharing the terminal."""
     loop = asyncio.get_running_loop()
 
     def read_q() -> None:
@@ -194,13 +198,31 @@ async def _watch_for_quit(
                 termios.tcsetattr(fd, termios.TCSADRAIN, old)
 
     try:
-        async with widgets.Spinner(
-            f"Press {term.green('q')} to stop streaming logs in {term.green(log_path)}"
-        ):
+        if spinner:
+            async with widgets.Spinner(message):
+                await loop.run_in_executor(None, read_q)
+        else:
+            term.info("%s", message)
             await loop.run_in_executor(None, read_q)
     except Exception as e:  # no controlling tty, termios failure
         term.warning("No controlling terminal for q-to-quit (%s); stopping", e)
     stop.set()
+
+
+def make_inner_sink_factory(opts: Options):
+    """``-o`` routing for where lines land (PARITY.md: additive beyond
+    the reference, which only writes files): None = reference FileSink
+    behavior; ``stdout`` = stern-style prefixed console stream;
+    ``both`` = tee to file and console."""
+    if opts.output == "files":
+        return None
+    from klogs_tpu.runtime.sink import FileSink
+    from klogs_tpu.runtime.stdout import StdoutSink, TeeSink
+
+    if opts.output == "stdout":
+        return lambda job: StdoutSink(job.pod, job.container)
+    return lambda job: TeeSink(FileSink(job.path),
+                               StdoutSink(job.pod, job.container))
 
 
 def make_pipeline_for(opts: Options):
@@ -235,6 +257,27 @@ async def run_async(
     stop: asyncio.Event | None = None,
     select_keys: Iterable[str] | None = None,
 ) -> int:
+    if opts.output != "files":
+        # Console modes: log lines own stdout (stern-style); all UI
+        # (splash, plan, warnings, prompts) moves to stderr so a piped
+        # `klogs -o stdout | grep` sees only log lines and UI text can
+        # never interleave into the byte stream.
+        import sys as _sys
+
+        term.set_ui_stream(_sys.stderr)
+    try:
+        return await _run_async_inner(opts, backend, stop, select_keys)
+    finally:
+        if opts.output != "files":
+            term.set_ui_stream(None)
+
+
+async def _run_async_inner(
+    opts: Options,
+    backend: ClusterBackend | None = None,
+    stop: asyncio.Event | None = None,
+    select_keys: Iterable[str] | None = None,
+) -> int:
     widgets.splash_screen()
     backend = backend or make_backend(opts)
     profiling = False
@@ -258,12 +301,16 @@ async def run_async(
             print_plan(pods, jobs)
 
         pipeline = make_pipeline_for(opts)
+        inner_factory = make_inner_sink_factory(opts)
         try:
             if pipeline is not None:
                 await pipeline.start()  # remote: verify patterns up front
+                pipeline.inner_factory = inner_factory
             runner = FanoutRunner(
                 backend, namespace, log_opts,
-                sink_factory=pipeline.sink_factory if pipeline else None,
+                sink_factory=(pipeline.sink_factory if pipeline
+                              else inner_factory),
+                create_files=opts.output != "stdout",
             )
             # --watch-new: stern-style dynamic discovery. Only a
             # NON-interactive selection can be re-planned (the user's
@@ -293,8 +340,16 @@ async def run_async(
                 if stop is None:
                     stop = asyncio.Event()
                     watcher_done = threading.Event()
+                    if opts.output == "stdout":
+                        quit_msg = (f"Press {term.green('q')} to stop "
+                                    "streaming logs")
+                    else:
+                        quit_msg = (f"Press {term.green('q')} to stop "
+                                    "streaming logs in "
+                                    f"{term.green(opts.log_path)}")
                     watcher = asyncio.create_task(
-                        _watch_for_quit(stop, opts.log_path, watcher_done)
+                        _watch_for_quit(stop, quit_msg, watcher_done,
+                                        spinner=opts.output == "files")
                     )
                 else:
                     watcher = watcher_done = None
@@ -331,7 +386,10 @@ async def run_async(
             else:
                 await runner.run(jobs)
 
-            print_log_size(log_files, opts.log_path)
+            if opts.output != "stdout":
+                # No files exist in stdout-only mode; the size table
+                # (cmd/root.go:279-309) only describes written files.
+                print_log_size(log_files, opts.log_path)
             if pipeline is not None and opts.stats:
                 pipeline.print_summary()
             return 0

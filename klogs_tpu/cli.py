@@ -20,6 +20,8 @@ New (north-star) flags, absent from the reference:
 
   --match           repeatable regex; only matching lines are written
   -I/--ignore-case  case-insensitive --match patterns
+  -o/--output       files (reference behavior) | stdout (stern-style
+                    prefixed console stream, no files) | both
   --backend         filter engine: cpu (host regex) | tpu (batch NFA)
   --remote          gate writes via a klogs-filterd service (gRPC)
   --profile         write a JAX profiler trace of the run to DIR
@@ -58,6 +60,7 @@ class Options:
     profile: str | None = None
     cluster: str = "kube"
     watch_new: bool = False
+    output: str = "files"
 
 
 USE = "klogs"
@@ -160,6 +163,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="Print lines/sec, matched %%, and batch-latency summary",
     )
     p.add_argument(
+        "-o",
+        "--output",
+        choices=["files", "stdout", "both"],
+        default="files",
+        help="Where log lines go: per-container files (reference "
+        "behavior), a pod/container-prefixed stdout stream "
+        "(stern-style), or both",
+    )
+    p.add_argument(
         "--exclude",
         action="append",
         default=[],
@@ -213,6 +225,7 @@ def parse_args(argv: list[str] | None = None) -> Options:
         profile=ns.profile,
         cluster=ns.cluster,
         watch_new=ns.watch_new,
+        output=ns.output,
     )
 
 

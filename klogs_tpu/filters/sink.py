@@ -143,11 +143,16 @@ class FilterPipeline:
     patterns: list[str] | None = None
     ignore_case: bool = False
     exclude: list[str] | None = None
+    # Where gated lines land; None = the reference behavior (a FileSink
+    # on job.path). ``-o stdout|both`` injects console/tee factories.
+    inner_factory: "Callable[[StreamJob], Sink] | None" = None
     _live_sinks: "set[FilteredSink]" = dataclasses_field(default_factory=set)
 
     def sink_factory(self, job: StreamJob) -> Sink:
+        inner = (self.inner_factory(job) if self.inner_factory is not None
+                 else FileSink(job.path))
         sink = FilteredSink(
-            FileSink(job.path),
+            inner,
             self.log_filter,
             self.stats,
             batch_lines=self.batch_lines,

@@ -98,6 +98,7 @@ class FanoutRunner:
         sink_factory: SinkFactory | None = None,
         open_burst: int = DEFAULT_OPEN_BURST,
         max_reconnects: int = DEFAULT_MAX_RECONNECTS,
+        create_files: bool = True,
     ):
         self.backend = backend
         self.namespace = namespace
@@ -108,6 +109,9 @@ class FanoutRunner:
         self._stopping = False
         self._stop_event = asyncio.Event()
         self.max_reconnects = max_reconnects
+        # -o stdout streams to the console only: job paths stay as
+        # stable (pod, container) identities but no file is touched.
+        self.create_files = create_files
 
     async def _worker(self, job: StreamJob) -> StreamResult:
         result = StreamResult(job=job)
@@ -225,9 +229,10 @@ class FanoutRunner:
         except asyncio.TimeoutError:
             return not self._stopping
 
-    @staticmethod
-    def _create_file(job: StreamJob) -> None:
+    def _create_file(self, job: StreamJob) -> None:
         # Create (truncate) the log file up front (cmd/root.go:245-257).
+        if not self.create_files:
+            return
         os.makedirs(os.path.dirname(job.path) or ".", exist_ok=True)
         open(job.path, "wb").close()
 

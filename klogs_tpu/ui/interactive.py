@@ -99,7 +99,7 @@ def interactive_select(
     keys: Iterable[str] | None = None, out=None,
 ) -> str:
     """Single choice (namespace picker, cmd/root.go:117-122)."""
-    out = out or sys.stdout
+    out = out or term.ui_stream()
     key_iter = _keys_or_tty(keys)
     cursor, top = 0, 0
     print(f"{default_text}:", file=out)
@@ -124,7 +124,7 @@ def interactive_multiselect(
 ) -> list[str]:
     """Multi choice (pod picker, cmd/root.go:167-182): Space toggles,
     Enter confirms, no filter, window of MAX_HEIGHT."""
-    out = out or sys.stdout
+    out = out or term.ui_stream()
     key_iter = _keys_or_tty(keys)
     cursor, top = 0, 0
     selected: set[int] = set()

@@ -33,6 +33,23 @@ def set_colors(enabled: bool | None) -> None:
     _FORCED = enabled
 
 
+_UI_STREAM = None
+
+
+def set_ui_stream(stream) -> None:
+    """Route all UI output (severity printers, widgets) to ``stream``;
+    None restores sys.stdout. ``-o stdout|both`` points this at stderr
+    so log lines own stdout — a piped ``klogs -o stdout | grep`` sees
+    only log lines, and UI text can never interleave into (or reorder
+    around) the byte stream sharing the fd."""
+    global _UI_STREAM
+    _UI_STREAM = stream
+
+
+def ui_stream():
+    return _UI_STREAM if _UI_STREAM is not None else sys.stdout
+
+
 def _sgr(code: str, text: str) -> str:
     if not colors_enabled():
         return text
@@ -76,7 +93,7 @@ class Printer:
         self.stream = stream
 
     def __call__(self, fmt: str, *args) -> None:
-        out = self.stream or sys.stdout
+        out = self.stream or ui_stream()
         msg = (fmt % args) if args else fmt
         badge = _sgr(self.code, f" {self.prefix} ")
         print(f"{badge} {msg}", file=out)

@@ -24,7 +24,7 @@ _BIG = {
 
 
 def splash_screen(out=None) -> None:
-    out = out or sys.stdout
+    out = out or term.ui_stream()
     rows = ["", "", "", "", ""]
     for i, ch in enumerate("KLogs"):
         glyph = _BIG[ch]
@@ -36,7 +36,7 @@ def splash_screen(out=None) -> None:
 
 def render_tree(root: str, children: list[str], out=None) -> None:
     """One pod tree: root label + branch per container."""
-    out = out or sys.stdout
+    out = out or term.ui_stream()
     print(root, file=out)
     for i, child in enumerate(children):
         branch = "└─" if i == len(children) - 1 else "├─"
@@ -45,7 +45,7 @@ def render_tree(root: str, children: list[str], out=None) -> None:
 
 def render_table(data: list[list[str]], out=None) -> None:
     """Boxed table with a header row (pterm WithHasHeader().WithBoxed())."""
-    out = out or sys.stdout
+    out = out or term.ui_stream()
     if not data:
         return
     ncols = max(len(r) for r in data)
@@ -86,7 +86,7 @@ class Spinner:
 
     def __init__(self, text: str, out=None):
         self.text = text
-        self.out = out or sys.stdout
+        self.out = out or term.ui_stream()
         self._task: asyncio.Task | None = None
 
     async def _spin(self) -> None:

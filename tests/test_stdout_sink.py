@@ -1,0 +1,196 @@
+"""-o stdout|both: stern-style console output (additive beyond the
+reference, which only writes files — writeLogToDisk, cmd/root.go:359-374).
+
+Unit coverage for StdoutSink/TeeSink framing and prefixing, plus e2e
+runs through the app orchestration against FakeCluster."""
+
+import asyncio
+import io
+import os
+
+import pytest
+
+from klogs_tpu.runtime.sink import FileSink
+from klogs_tpu.runtime.stdout import StdoutSink, TeeSink, pod_color_code
+from klogs_tpu.ui import term
+
+
+def run_sink(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(autouse=True)
+def no_colors():
+    term.set_colors(False)
+    yield
+    term.set_colors(None)
+
+
+class TestStdoutSink:
+    def test_prefixes_each_line(self):
+        out = io.BytesIO()
+        s = StdoutSink("pod-1", "main", out=out)
+
+        async def go():
+            await s.write(b"alpha\nbeta\n")
+            await s.close()
+
+        run_sink(go())
+        assert out.getvalue() == b"pod-1 main alpha\npod-1 main beta\n"
+
+    def test_frames_across_chunk_boundaries(self):
+        out = io.BytesIO()
+        s = StdoutSink("p", "c", out=out)
+
+        async def go():
+            await s.write(b"par")
+            await s.write(b"tial\nsecond li")
+            await s.write(b"ne\n")
+            await s.close()
+
+        run_sink(go())
+        assert out.getvalue() == b"p c partial\np c second line\n"
+
+    def test_unterminated_tail_is_newline_terminated_at_close(self):
+        out = io.BytesIO()
+        s = StdoutSink("p", "c", out=out)
+
+        async def go():
+            await s.write(b"no newline at eof")
+            await s.close()
+
+        run_sink(go())
+        assert out.getvalue() == b"p c no newline at eof\n"
+
+    def test_bytes_written_counts_emitted_bytes(self):
+        out = io.BytesIO()
+        s = StdoutSink("p", "c", out=out)
+
+        async def go():
+            await s.write(b"x\n")
+            await s.close()
+
+        run_sink(go())
+        assert s.bytes_written == len(b"p c x\n")
+
+    def test_colored_prefix_when_colors_enabled(self):
+        term.set_colors(True)
+        out = io.BytesIO()
+        s = StdoutSink("pod-1", "main", out=out)
+
+        async def go():
+            await s.write(b"hello\n")
+            await s.close()
+
+        run_sink(go())
+        code = pod_color_code("pod-1")
+        assert out.getvalue() == (
+            f"\x1b[{code}mpod-1 main\x1b[0m hello\n".encode())
+
+    def test_pod_color_is_stable_and_pod_keyed(self):
+        assert pod_color_code("api-7f9") == pod_color_code("api-7f9")
+        # Different pods usually differ; at minimum the code is a valid
+        # SGR from the palette.
+        assert pod_color_code("other").isdigit()
+
+    def test_close_idempotent(self):
+        out = io.BytesIO()
+        s = StdoutSink("p", "c", out=out)
+
+        async def go():
+            await s.write(b"tail")
+            await s.close()
+            await s.close()
+
+        run_sink(go())
+        assert out.getvalue().count(b"tail") == 1
+
+
+class TestTeeSink:
+    def test_fans_out_and_reports_first_sink_bytes(self, tmp_path):
+        path = str(tmp_path / "a.log")
+        out = io.BytesIO()
+        tee = TeeSink(FileSink(path), StdoutSink("p", "c", out=out))
+
+        async def go():
+            await tee.write(b"line\n")
+            await tee.flush()
+            await tee.close()
+
+        run_sink(go())
+        with open(path, "rb") as f:
+            assert f.read() == b"line\n"  # file copy is byte-identical
+        assert out.getvalue() == b"p c line\n"  # console copy prefixed
+        assert tee.bytes_written == len(b"line\n")
+
+    def test_rejects_empty(self):
+        with pytest.raises(ValueError):
+            TeeSink()
+
+
+class TestOutputModesE2E:
+    def _run(self, argv, capsysbinary):
+        from klogs_tpu import app
+        from klogs_tpu.cli import parse_args
+        from klogs_tpu.cluster.fake import FakeCluster
+
+        fc = FakeCluster.synthetic(
+            n_pods=2, n_containers=1, lines_per_container=20)
+        opts = parse_args(argv)
+        rc = asyncio.run(app.run_async(opts, backend=fc))
+        assert rc == 0
+        captured = capsysbinary.readouterr()
+        return captured.out, captured.err
+
+    def test_stdout_mode_streams_prefixed_and_writes_no_files(
+            self, tmp_path, capsysbinary):
+        out_dir = str(tmp_path / "logs")
+        out, err = self._run(
+            ["-n", "default", "-a", "-t", "5", "-p", out_dir,
+             "-o", "stdout"], capsysbinary)
+        assert not os.path.exists(out_dir)  # no files, not even empty ones
+        assert out.count(b"pod-0000 c0 ") == 5
+        assert out.count(b"pod-0001 c0 ") == 5
+        assert b"Logs saved to" not in out + err  # size table is files-only
+        # Console modes: log lines own stdout; ALL UI (splash, plan,
+        # size table) moves to stderr so `klogs -o stdout | grep` pipes
+        # pure log lines — every stdout line is a prefixed log line.
+        assert all(ln.startswith((b"pod-0000 c0 ", b"pod-0001 c0 "))
+                   for ln in out.splitlines())
+        assert b"Found 2 Pod(s) 2 Container(s)" in err
+
+    def test_stdout_mode_with_match_gates_lines(
+            self, tmp_path, capsysbinary):
+        out_dir = str(tmp_path / "logs")
+        out, _ = self._run(
+            ["-n", "default", "-a", "-t", "20", "-p", out_dir,
+             "-o", "stdout", "--match", "ERROR"], capsysbinary)
+        assert not os.path.exists(out_dir)
+        # LEVELS cycle 4 ways: 5 of 20 lines are ERROR per container.
+        body = [ln for ln in out.splitlines()
+                if ln.startswith(b"pod-0000 c0 ")]
+        assert len(body) == 5
+        assert all(b" ERROR " in ln for ln in body)
+
+    def test_both_mode_writes_files_and_console(
+            self, tmp_path, capsysbinary):
+        out_dir = str(tmp_path / "logs")
+        out, err = self._run(
+            ["-n", "default", "-a", "-t", "4", "-p", out_dir,
+             "-o", "both"], capsysbinary)
+        files = sorted(os.listdir(out_dir))
+        assert files == ["pod-0000__c0.log", "pod-0001__c0.log"]
+        with open(os.path.join(out_dir, files[0]), "rb") as f:
+            lines = f.read().splitlines()
+        assert len(lines) == 4
+        assert not lines[0].startswith(b"pod-0000 c0 ")  # file: no prefix
+        assert out.count(b"pod-0000 c0 ") == 4  # console: prefixed
+        assert b"Logs saved to" in err  # size table on stderr (UI stream)
+
+    def test_ui_stream_restored_after_run(self, tmp_path, capsysbinary):
+        import sys
+
+        out_dir = str(tmp_path / "logs")
+        self._run(["-n", "default", "-a", "-t", "2", "-p", out_dir,
+                   "-o", "stdout"], capsysbinary)
+        assert term.ui_stream() is sys.stdout
