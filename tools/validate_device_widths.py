@@ -1,0 +1,38 @@
+"""On-device validation: the adopted mask_block=4 default must compile
+and agree with the host-regex oracle at EVERY production width bucket
+(each bucket is a distinct Mosaic compile: T grows, tile shrinks)."""
+import random, time
+random.seed(7)
+from klogs_tpu.filters.cpu import RegexFilter
+from klogs_tpu.filters.tpu import NFAEngineFilter
+
+pats = ["ERROR", r"code=\d00", r"pod-\d+ crash", "timeout.*retry",
+        r"^WARN", r"(fatal|panic):", r"lat=[0-9]{3,}ms", "needle"]
+NEEDLES = ["ERROR", "code=700", "pod-42 crash", "timeout x y retry",
+           "fatal:", "panic:", "lat=4567ms", "needle", "WARN lead"]
+f = NFAEngineFilter(pats, kernel="pallas")
+oracle = RegexFilter(pats)
+for width in (100, 250, 500, 1000, 2000, 4000):
+    lines = []
+    for i in range(512):
+        filler = "".join(random.choice("abcdefgh ")
+                         for _ in range(width))
+        if i % 3 == 0:
+            n = random.choice(NEEDLES)
+            if n.startswith("WARN"):
+                body = n + filler
+            else:
+                pos = random.randrange(max(1, width - len(n)))
+                body = filler[:pos] + n + filler[pos:]
+        else:
+            body = filler
+        lines.append(body[:width].encode() + b"\n")
+    t0 = time.perf_counter()
+    got = f.match_lines(lines)
+    dt = time.perf_counter() - t0
+    want = oracle.match_lines(lines)
+    assert got == want, f"DIVERGENCE at width {width}"
+    assert sum(got) > 100, f"vacuous check at width {width}"
+    print(f"width {width:5d}: ok ({sum(got)}/512 matched, {dt*1e3:.0f} ms)",
+          flush=True)
+print("all width buckets agree with the oracle under mask_block=4")
