@@ -113,8 +113,15 @@ async def select_pods(
 
 
 def build_log_options(opts: Options) -> LogOptions:
-    """getLopOpts analog (cmd/root.go:201-221)."""
-    lo = LogOptions(follow=opts.follow)
+    """getLopOpts analog (cmd/root.go:201-221), plus the kubectl-parity
+    additions --previous/--timestamps (PodLogOptions.Previous/
+    .Timestamps — server-side, like since/tail/follow)."""
+    if opts.previous and opts.follow:
+        # kubectl parity: "only one of follow or previous may be true".
+        term.fatal("--previous is incompatible with -f/--follow "
+                   "(a terminated instance cannot stream)")
+    lo = LogOptions(follow=opts.follow, previous=opts.previous,
+                    timestamps=opts.timestamps)
     if opts.since:
         try:
             lo.since_seconds = int(parse_duration(opts.since))

@@ -22,6 +22,10 @@ New (north-star) flags, absent from the reference:
   -I/--ignore-case  case-insensitive --match patterns
   -o/--output       files (reference behavior) | stdout (stern-style
                     prefixed console stream, no files) | both
+  --previous        logs of the previous terminated container instance
+                    (kubectl -p parity; PodLogOptions.Previous)
+  --timestamps      server-side RFC3339 timestamp prefix per line
+                    (kubectl parity; PodLogOptions.Timestamps)
   --backend         filter engine: cpu (host regex) | tpu (batch NFA)
   --remote          gate writes via a klogs-filterd service (gRPC)
   --profile         write a JAX profiler trace of the run to DIR
@@ -61,6 +65,8 @@ class Options:
     cluster: str = "kube"
     watch_new: bool = False
     output: str = "files"
+    previous: bool = False
+    timestamps: bool = False
 
 
 USE = "klogs"
@@ -172,6 +178,18 @@ def build_parser() -> argparse.ArgumentParser:
         "(stern-style), or both",
     )
     p.add_argument(
+        "--previous",
+        action="store_true",
+        help="Get logs of the PREVIOUS terminated container instance "
+        "(kubectl logs -p); incompatible with -f",
+    )
+    p.add_argument(
+        "--timestamps",
+        action="store_true",
+        help="Prefix each log line with its server-side RFC3339 "
+        "timestamp (kubectl logs --timestamps)",
+    )
+    p.add_argument(
         "--exclude",
         action="append",
         default=[],
@@ -226,6 +244,8 @@ def parse_args(argv: list[str] | None = None) -> Options:
         cluster=ns.cluster,
         watch_new=ns.watch_new,
         output=ns.output,
+        previous=ns.previous,
+        timestamps=ns.timestamps,
     )
 
 
@@ -237,6 +257,14 @@ def main(argv: list[str] | None = None) -> int:
     if opts.print_version:
         term.info("Version: %s", BUILD_VERSION)
         return 0
+
+    # Statically invalid combos are rejected before any cluster work
+    # (kubectl parity: "only one of follow or previous may be specified");
+    # app.build_log_options keeps a backstop for library callers.
+    if opts.previous and opts.follow:
+        term.error("--previous is incompatible with -f/--follow "
+                   "(a terminated instance cannot stream)")
+        return 1
 
     from klogs_tpu.app import run
     from klogs_tpu.cluster.backend import ClusterError

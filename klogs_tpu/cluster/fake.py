@@ -59,6 +59,10 @@ class FakeContainer:
     faults: Faults = field(default_factory=Faults)
     # Next sequence number for follow-mode generation.
     next_seq: int = 0
+    # History of the PREVIOUS terminated instance (PodLogOptions.
+    # Previous); empty = no previous instance, matching the apiserver's
+    # 400 on `previous=true` for a never-restarted container.
+    previous_lines: list[tuple[float, bytes]] = field(default_factory=list)
 
 
 @dataclass
@@ -93,14 +97,25 @@ class FakeLogStream(LogStream):
     async def close(self) -> None:
         self._closed.set()
 
+    def _stamp(self, ts: float, ln: bytes) -> bytes:
+        """PodLogOptions.Timestamps: kubelet prefixes each line with an
+        RFC3339Nano timestamp and one space."""
+        if not self._opts.timestamps:
+            return ln
+        frac = int((ts % 1) * 1e9)
+        stamp = time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(ts))
+        return f"{stamp}.{frac:09d}Z ".encode() + ln
+
     def _select_history(self) -> list[bytes]:
-        lines = self._c.lines
+        # previous=true reads the terminated prior instance's history
+        # (PodLogOptions.Previous); a previous stream never follows.
+        lines = self._c.previous_lines if self._opts.previous else self._c.lines
         if self._opts.since_seconds is not None:
             cutoff = self._clock() - self._opts.since_seconds
             lines = [(ts, ln) for ts, ln in lines if ts >= cutoff]
         if self._opts.tail_lines is not None and self._opts.tail_lines >= 0:
             lines = lines[len(lines) - min(self._opts.tail_lines, len(lines)):]
-        return [ln for _, ln in lines]
+        return [self._stamp(ts, ln) for ts, ln in lines]
 
     async def _chunks(self) -> AsyncIterator[bytes]:
         f = self._c.faults
@@ -138,8 +153,8 @@ class FakeLogStream(LogStream):
             yield bytes(buf)
             buf.clear()
 
-        if not self._opts.follow:
-            return
+        if not self._opts.follow or self._opts.previous:
+            return  # a terminated prior instance cannot produce new lines
 
         # Follow mode: generate lines until the stream is closed.
         while not self._closed.is_set():
@@ -158,7 +173,9 @@ class FakeLogStream(LogStream):
                 )
             seq = self._c.next_seq
             self._c.next_seq += 1
-            line = synthetic_line(self._pod, self._c.name, seq, self._clock())
+            now = self._clock()
+            line = self._stamp(now, synthetic_line(
+                self._pod, self._c.name, seq, now))
             emitted += 1
             yield line
 
@@ -293,5 +310,12 @@ class FakeCluster(ClusterBackend):
         if fc.faults.fail_open:
             raise StreamError(
                 f"error getting logs for container {opts.container}: injected"
+            )
+        if opts.previous and not fc.previous_lines:
+            # apiserver parity: 400 "previous terminated container ...
+            # not found" for a container that never restarted.
+            raise StreamError(
+                f"previous terminated container {opts.container!r} in pod "
+                f"{pod!r} not found"
             )
         return FakeLogStream(fc, pod, opts, self.clock, self.chunk_size)

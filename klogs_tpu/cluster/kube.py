@@ -168,6 +168,10 @@ class KubeBackend(ClusterBackend):
             params["sinceSeconds"] = str(opts.since_seconds)
         if opts.tail_lines is not None:
             params["tailLines"] = str(opts.tail_lines)
+        if opts.previous:
+            params["previous"] = "true"
+        if opts.timestamps:
+            params["timestamps"] = "true"
         try:
             resp = None
             for attempt in (0, 1):

@@ -33,6 +33,12 @@ class LogOptions:
     tail_lines: int | None = None
     follow: bool = False
     container: str = ""
+    # kubectl-parity options absent from the reference (its getLopOpts,
+    # cmd/root.go:201-221, maps only since/tail/follow): logs of the
+    # PREVIOUS terminated container instance (PodLogOptions.Previous)
+    # and server-side RFC3339 line timestamps (PodLogOptions.Timestamps).
+    previous: bool = False
+    timestamps: bool = False
 
 
 def match_label_selector(labels: dict[str, str], selector: str) -> bool:

@@ -120,6 +120,8 @@ class FanoutRunner:
             tail_lines=self.log_opts.tail_lines,
             follow=self.log_opts.follow,
             container=job.container,
+            previous=self.log_opts.previous,
+            timestamps=self.log_opts.timestamps,
         )
         sink = self.sink_factory(job)
         attempt = 0
@@ -204,6 +206,10 @@ class FanoutRunner:
                     tail_lines=None,  # tail would re-dump history after a cut
                     follow=True,
                     container=job.container,
+                    # previous never reaches here (previous+follow is
+                    # rejected at option build), but timestamps must
+                    # survive a reconnect.
+                    timestamps=self.log_opts.timestamps,
                 )
         finally:
             await sink.close()

@@ -304,3 +304,41 @@ def test_profile_writes_trace(tmp_path):
     contents = [str(p) for p in __import__("pathlib").Path(trace_dir).rglob("*")
                 if p.is_file()]
     assert contents, "profiler trace directory is empty"
+
+
+class TestPreviousAndTimestamps:
+    def test_previous_writes_prior_instance_logs(self, tmp_path, capsys):
+        out_dir = str(tmp_path / "logs")
+        fc = FakeCluster()
+        pod = fc.add_pod("default", "web", containers=["nginx"],
+                         lines_per_container=5)
+        pod.containers["nginx"].previous_lines = [
+            (1.0, b"prev-crash line A\n"), (2.0, b"prev-crash line B\n")]
+        _, rc = run_app(["-n", "default", "-a", "-p", out_dir,
+                         "--previous"], fc)
+        assert rc == 0
+        with open(os.path.join(out_dir, "web__nginx.log"), "rb") as f:
+            assert f.read() == b"prev-crash line A\nprev-crash line B\n"
+
+    def test_previous_with_follow_is_fatal(self, tmp_path, capsys):
+        from klogs_tpu.ui.term import FatalError
+
+        with pytest.raises(FatalError):
+            run_app(["-n", "default", "-a", "-p",
+                     str(tmp_path / "logs"), "--previous", "-f"],
+                    make_cluster())
+        assert "incompatible" in capsys.readouterr().out
+
+    def test_timestamps_prefix_in_files(self, tmp_path, capsys):
+        import re as _re
+
+        out_dir = str(tmp_path / "logs")
+        _, rc = run_app(["-n", "default", "-a", "-t", "3", "-p", out_dir,
+                         "--timestamps"], make_cluster())
+        assert rc == 0
+        with open(os.path.join(out_dir, "pod-0000__c0.log"), "rb") as f:
+            lines = f.read().splitlines()
+        assert len(lines) == 3
+        for ln in lines:
+            assert _re.match(
+                rb"^\d{4}-\d\d-\d\dT\d\d:\d\d:\d\d\.\d{9}Z ", ln), ln

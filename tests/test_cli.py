@@ -60,3 +60,29 @@ def test_ignore_case_flag():
     opts = parse_args(["-a", "--match", "error", "-I"])
     assert opts.ignore_case
     assert not parse_args(["-a"]).ignore_case
+
+
+def test_previous_and_timestamps_flags():
+    from klogs_tpu.cli import parse_args
+
+    opts = parse_args(["-a", "--previous", "--timestamps"])
+    assert opts.previous and opts.timestamps
+    d = parse_args(["-a"])
+    assert not d.previous and not d.timestamps
+
+
+def test_output_flag():
+    from klogs_tpu.cli import parse_args
+
+    assert parse_args(["-a"]).output == "files"
+    assert parse_args(["-a", "-o", "stdout"]).output == "stdout"
+    assert parse_args(["-a", "--output", "both"]).output == "both"
+
+
+def test_previous_with_follow_rejected_before_cluster_work(capsys):
+    # Statically invalid combo exits 1 at the CLI boundary — no
+    # namespace resolution or pod selection happens first.
+    assert main(["--previous", "-f", "-a", "--cluster", "fake"]) == 1
+    out = capsys.readouterr().out
+    assert "incompatible" in out
+    assert "Using Namespace" not in out  # nothing ran

@@ -2,6 +2,7 @@
 (since/tail/follow semantics of cmd/root.go:201-221), plus fault injection."""
 
 import asyncio
+import re
 
 import pytest
 
@@ -195,3 +196,76 @@ class TestFaults:
 
         data = run(scenario())
         assert len(data.splitlines()) == 2
+
+
+class TestPreviousAndTimestamps:
+    """kubectl-parity server-side options (PodLogOptions.Previous /
+    .Timestamps) on the hermetic backend."""
+
+    def make(self):
+        fc = FakeCluster(clock=lambda: 1_000_000.0, chunk_size=7)
+        pod = fc.add_pod("default", "web", containers=["nginx"],
+                         lines_per_container=3)
+        prev = pod.containers["nginx"]
+        for i in range(2):
+            prev.previous_lines.append(
+                (999_000.0 + i, b"prev-instance seq=%d\n" % i))
+        return fc
+
+    def test_previous_selects_prior_instance_history(self):
+        fc = self.make()
+        data = run(read_all(run(fc.open_log_stream(
+            "default", "web",
+            LogOptions(container="nginx", previous=True)))))
+        assert data == b"prev-instance seq=0\nprev-instance seq=1\n"
+
+    def test_previous_without_restart_errors_like_apiserver(self):
+        fc = FakeCluster()
+        fc.add_pod("default", "web", containers=["nginx"],
+                   lines_per_container=3)
+        with pytest.raises(StreamError, match="previous terminated"):
+            run(fc.open_log_stream(
+                "default", "web",
+                LogOptions(container="nginx", previous=True)))
+
+    def test_previous_never_follows(self):
+        fc = self.make()
+        # follow=True + previous: history then EOF (terminated instance
+        # cannot generate); read_all returning proves no infinite stream.
+        data = run(read_all(run(fc.open_log_stream(
+            "default", "web",
+            LogOptions(container="nginx", previous=True, follow=True)))))
+        assert data.count(b"\n") == 2
+
+    def test_timestamps_prefix_history_lines(self):
+        fc = self.make()
+        data = run(read_all(run(fc.open_log_stream(
+            "default", "web",
+            LogOptions(container="nginx", timestamps=True)))))
+        lines = data.splitlines()
+        assert len(lines) == 3
+        # clock=1e6: 1970-01-12T13:46:40 + spacing; RFC3339Nano + space.
+        for ln in lines:
+            assert re.match(
+                rb"^1970-01-12T13:46:\d\d\.\d{9}Z ", ln), ln
+
+    def test_timestamps_prefix_follow_lines(self):
+        fc = FakeCluster(clock=lambda: 1_000_000.0)
+        fc.add_pod("default", "web", containers=["nginx"],
+                   lines_per_container=0, follow_interval_s=0.005)
+
+        async def read_some():
+            s = await fc.open_log_stream(
+                "default", "web",
+                LogOptions(container="nginx", follow=True,
+                           timestamps=True))
+            data = b""
+            async for chunk in s:
+                data += chunk
+                if data.count(b"\n") >= 2:
+                    await s.close()
+            return data
+
+        data = run(read_some())
+        for ln in data.splitlines():
+            assert ln.startswith(b"1970-01-12T13:46:40."), ln

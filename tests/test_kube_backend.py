@@ -84,6 +84,12 @@ def make_app():
         container = request.query.get("container", "")
         tail = request.query.get("tailLines")
         lines = [f"{pod}/{container} line {i}\n".encode() for i in range(10)]
+        if request.query.get("previous") == "true":
+            lines = [f"{pod}/{container} prev {i}\n".encode()
+                     for i in range(2)]
+        if request.query.get("timestamps") == "true":
+            lines = [b"2026-07-31T00:00:00.000000000Z " + ln
+                     for ln in lines]
         if tail is not None:
             lines = lines[-int(tail):]
         resp = web.StreamResponse()
@@ -175,6 +181,26 @@ def test_log_stream_with_options(tmp_path):
             data += chunk
         await s.close()
         assert b"follow 2" in data
+
+        # kubectl-parity query params: previous + timestamps ride the
+        # log GET (PodLogOptions.Previous / .Timestamps).
+        s = await b.open_log_stream(
+            "default", "api-1",
+            LogOptions(container="srv", previous=True))
+        data = b""
+        async for chunk in s:
+            data += chunk
+        await s.close()
+        assert data == b"api-1/srv prev 0\napi-1/srv prev 1\n"
+
+        s = await b.open_log_stream(
+            "default", "api-1",
+            LogOptions(container="srv", timestamps=True, tail_lines=1))
+        data = b""
+        async for chunk in s:
+            data += chunk
+        await s.close()
+        assert data == b"2026-07-31T00:00:00.000000000Z api-1/srv line 9\n"
 
     asyncio.run(with_backend(tmp_path, fn))
 
