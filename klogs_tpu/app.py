@@ -302,10 +302,33 @@ async def _run_async_inner(
         namespace = await resolve_namespace(backend, opts, select_keys)
         pods = await select_pods(backend, namespace, opts, select_keys)
         log_opts = build_log_options(opts)
-        jobs = plan_jobs(pods, opts.log_path, opts.init_containers)
+        container_re = None
+        if opts.container:
+            import re as _re
+
+            try:
+                container_re = _re.compile(opts.container)
+            except _re.error as e:
+                term.fatal("invalid -c/--container pattern %r: %s",
+                           opts.container, e)
+        jobs = plan_jobs(pods, opts.log_path, opts.init_containers,
+                         container_re=container_re)
         log_files = [j.path for j in jobs]
+        if container_re is not None and pods and not jobs:
+            # A filter miss must be distinguishable from an empty
+            # cluster (≙ the empty-label-result error that continues,
+            # cmd/root.go:392-394).
+            term.error("No containers matching -c %r in %d selected "
+                       "pod(s)", opts.container, len(pods))
         if jobs:
             print_plan(pods, jobs)
+        if opts.timestamps and (opts.match or opts.exclude):
+            # grep-parity semantics: the server-side stamp is part of
+            # the line the filter sees (as it would be for kubectl
+            # --timestamps | grep). Say so once — a ^-anchored pattern
+            # silently matching nothing is a support ticket.
+            term.info("note: --timestamps prefixes are part of the line "
+                      "--match/--exclude see (anchor accordingly)")
 
         pipeline = make_pipeline_for(opts)
         inner_factory = make_inner_sink_factory(opts)
@@ -330,7 +353,8 @@ async def _run_async_inner(
                         pods = await select_noninteractive(
                             backend, namespace, opts, quiet=True)
                         return plan_jobs(pods, opts.log_path,
-                                         opts.init_containers)
+                                         opts.init_containers,
+                                         container_re=container_re)
                 else:
                     term.warning(
                         "--watch-new needs -a or -l (an interactive pod "

@@ -22,6 +22,8 @@ New (north-star) flags, absent from the reference:
   -I/--ignore-case  case-insensitive --match patterns
   -o/--output       files (reference behavior) | stdout (stern-style
                     prefixed console stream, no files) | both
+  -c/--container    only containers whose name matches this regex
+                    (stern parity; the reference streams all containers)
   --previous        logs of the previous terminated container instance
                     (kubectl -p parity; PodLogOptions.Previous)
   --timestamps      server-side RFC3339 timestamp prefix per line
@@ -67,6 +69,7 @@ class Options:
     output: str = "files"
     previous: bool = False
     timestamps: bool = False
+    container: str = ""
 
 
 USE = "klogs"
@@ -178,6 +181,14 @@ def build_parser() -> argparse.ArgumentParser:
         "(stern-style), or both",
     )
     p.add_argument(
+        "-c",
+        "--container",
+        default="",
+        metavar="REGEX",
+        help="Only stream containers whose name matches this regex "
+        "(stern-style; default: all containers)",
+    )
+    p.add_argument(
         "--previous",
         action="store_true",
         help="Get logs of the PREVIOUS terminated container instance "
@@ -246,6 +257,7 @@ def parse_args(argv: list[str] | None = None) -> Options:
         output=ns.output,
         previous=ns.previous,
         timestamps=ns.timestamps,
+        container=ns.container,
     )
 
 
@@ -265,6 +277,15 @@ def main(argv: list[str] | None = None) -> int:
         term.error("--previous is incompatible with -f/--follow "
                    "(a terminated instance cannot stream)")
         return 1
+    if opts.container:
+        import re
+
+        try:
+            re.compile(opts.container)
+        except re.error as e:
+            term.error("invalid -c/--container pattern %r: %s",
+                       opts.container, e)
+            return 1
 
     from klogs_tpu.app import run
     from klogs_tpu.cluster.backend import ClusterError

@@ -15,6 +15,7 @@ sink) instead of exiting the process with goroutines still running
 
 import asyncio
 import os
+import re
 import time
 from dataclasses import dataclass
 from typing import Callable
@@ -60,7 +61,8 @@ SinkFactory = Callable[[StreamJob], Sink]
 
 
 def plan_jobs(
-    pods: list[PodInfo], log_path: str, include_init: bool
+    pods: list[PodInfo], log_path: str, include_init: bool,
+    container_re: "re.Pattern | None" = None,
 ) -> list[StreamJob]:
     """File creation order matches the reference: per pod, init
     containers first (if -i), then regular (cmd/root.go:240-262).
@@ -69,20 +71,29 @@ def plan_jobs(
     once (label union keeps reference semantics, cmd/root.go:458-460)
     but must stream only once — two workers on one path would truncate
     and interleave the same file, so duplicate (pod, container) pairs
-    are dropped here."""
+    are dropped here.
+
+    ``container_re`` (stern-style ``-c``; additive, the reference
+    streams every container unconditionally) keeps only containers
+    whose NAME it re.search-matches — applied here so static plans and
+    --watch-new discovery select identically."""
     jobs = []
     seen: set[tuple[str, str, bool]] = set()
+
+    def want(name: str) -> bool:
+        return container_re is None or bool(container_re.search(name))
+
     for pod in pods:
         if include_init:
             for c in pod.init_containers:
                 key = (pod.name, c.name, True)
-                if key not in seen:
+                if key not in seen and want(c.name):
                     seen.add(key)
                     jobs.append(StreamJob(pod.name, c.name, True,
                                           os.path.join(log_path, log_file_name(pod.name, c.name))))
         for c in pod.containers:
             key = (pod.name, c.name, False)
-            if key not in seen:
+            if key not in seen and want(c.name):
                 seen.add(key)
                 jobs.append(StreamJob(pod.name, c.name, False,
                                       os.path.join(log_path, log_file_name(pod.name, c.name))))

@@ -342,3 +342,39 @@ class TestPreviousAndTimestamps:
         for ln in lines:
             assert _re.match(
                 rb"^\d{4}-\d\d-\d\dT\d\d:\d\d:\d\d\.\d{9}Z ", ln), ln
+
+
+class TestContainerFilter:
+    def test_container_regex_selects_streams(self, tmp_path, capsys):
+        out_dir = str(tmp_path / "logs")
+        _, rc = run_app(["-n", "default", "-a", "-t", "3", "-p", out_dir,
+                         "-c", "c1"], make_cluster())
+        assert rc == 0
+        files = sorted(os.listdir(out_dir))
+        assert files == [f"pod-000{i}__c1.log" for i in range(4)]
+        assert "Found 4 Pod(s) 4 Container(s)" in capsys.readouterr().out
+
+    def test_bad_container_regex_is_fatal(self, tmp_path, capsys):
+        from klogs_tpu.ui.term import FatalError
+
+        with pytest.raises(FatalError):
+            run_app(["-n", "default", "-a", "-p", str(tmp_path / "logs"),
+                     "-c", "("], make_cluster())
+        assert "invalid -c/--container" in capsys.readouterr().out
+
+    def test_container_regex_miss_prints_error(self, tmp_path, capsys):
+        _, rc = run_app(["-n", "default", "-a", "-p",
+                         str(tmp_path / "logs"), "-c", "ngnix"],
+                        make_cluster())
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "No containers matching -c 'ngnix'" in out
+        assert "No logs saved" in out
+
+    def test_timestamps_with_match_prints_anchor_note(
+            self, tmp_path, capsys):
+        _, rc = run_app(["-n", "default", "-a", "-t", "2", "-p",
+                         str(tmp_path / "logs"), "--timestamps",
+                         "--match", "ERROR"], make_cluster())
+        assert rc == 0
+        assert "are part of the line" in capsys.readouterr().out

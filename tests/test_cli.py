@@ -86,3 +86,17 @@ def test_previous_with_follow_rejected_before_cluster_work(capsys):
     out = capsys.readouterr().out
     assert "incompatible" in out
     assert "Using Namespace" not in out  # nothing ran
+
+
+def test_container_flag():
+    from klogs_tpu.cli import parse_args
+
+    assert parse_args(["-a", "-c", "^app-"]).container == "^app-"
+    assert parse_args(["-a"]).container == ""
+
+
+def test_bad_container_regex_rejected_at_cli_boundary(capsys):
+    assert main(["-a", "--cluster", "fake", "-c", "("]) == 1
+    out = capsys.readouterr().out
+    assert "invalid -c/--container" in out
+    assert "Using Namespace" not in out  # nothing ran

@@ -164,3 +164,20 @@ class TestFollowStop:
         assert [(r.job.pod, r.job.container) for r in premature] == [
             ("pod-0002", "c0")]
         assert "ended prematurely" in capsys.readouterr().out
+
+
+def test_plan_jobs_container_regex_filter(tmp_path):
+    import re
+
+    from klogs_tpu.cluster.fake import FakeCluster
+
+    fc = FakeCluster()
+    fc.add_pod("default", "web", containers=["nginx", "sidecar"],
+               init_containers=["setup"])
+    pods = run(fc.list_pods("default"))
+    jobs = plan_jobs(pods, str(tmp_path), include_init=True,
+                     container_re=re.compile(r"^(nginx|set)"))
+    assert [(j.pod, j.container, j.init) for j in jobs] == [
+        ("web", "setup", True), ("web", "nginx", False)]
+    # No filter: everything (unchanged default).
+    assert len(plan_jobs(pods, str(tmp_path), include_init=True)) == 3
