@@ -302,26 +302,42 @@ async def _run_async_inner(
         namespace = await resolve_namespace(backend, opts, select_keys)
         pods = await select_pods(backend, namespace, opts, select_keys)
         log_opts = build_log_options(opts)
-        container_re = None
-        if opts.container:
-            import re as _re
+        container_re = exclude_container_re = None
+        import re as _re
 
+        # Backstop for library callers; cli.main rejects earlier.
+        if opts.container:
             try:
                 container_re = _re.compile(opts.container)
             except _re.error as e:
                 term.fatal("invalid -c/--container pattern %r: %s",
                            opts.container, e)
+        if opts.exclude_container:
+            try:
+                exclude_container_re = _re.compile(opts.exclude_container)
+            except _re.error as e:
+                term.fatal("invalid -E/--exclude-container pattern %r: %s",
+                           opts.exclude_container, e)
         jobs = plan_jobs(pods, opts.log_path, opts.init_containers,
-                         container_re=container_re)
+                         container_re=container_re,
+                         exclude_container_re=exclude_container_re)
         log_files = [j.path for j in jobs]
-        if container_re is not None and pods and not jobs:
+        if (container_re is not None or exclude_container_re is not None) \
+                and pods and not jobs:
             # A filter miss must be distinguishable from an empty
             # cluster (≙ the empty-label-result error that continues,
             # cmd/root.go:392-394).
-            term.error("No containers matching -c %r in %d selected "
-                       "pod(s)", opts.container, len(pods))
+            term.error("No containers left after -c/-E filtering in %d "
+                       "selected pod(s)", len(pods))
         if jobs:
-            print_plan(pods, jobs)
+            if container_re is not None or exclude_container_re is not None:
+                # With -c/-E active, pods whose containers were all
+                # filtered out contribute no streams — counting or
+                # rendering them would misstate the plan.
+                streaming = {j.pod for j in jobs}
+                print_plan([p for p in pods if p.name in streaming], jobs)
+            else:
+                print_plan(pods, jobs)
         if opts.timestamps and (opts.match or opts.exclude):
             # grep-parity semantics: the server-side stamp is part of
             # the line the filter sees (as it would be for kubectl
@@ -352,9 +368,10 @@ async def _run_async_inner(
                     async def plan_new() -> list[StreamJob]:
                         pods = await select_noninteractive(
                             backend, namespace, opts, quiet=True)
-                        return plan_jobs(pods, opts.log_path,
-                                         opts.init_containers,
-                                         container_re=container_re)
+                        return plan_jobs(
+                            pods, opts.log_path, opts.init_containers,
+                            container_re=container_re,
+                            exclude_container_re=exclude_container_re)
                 else:
                     term.warning(
                         "--watch-new needs -a or -l (an interactive pod "

@@ -24,6 +24,7 @@ New (north-star) flags, absent from the reference:
                     prefixed console stream, no files) | both
   -c/--container    only containers whose name matches this regex
                     (stern parity; the reference streams all containers)
+  -E/--exclude-container  drop containers whose name matches this regex
   --previous        logs of the previous terminated container instance
                     (kubectl -p parity; PodLogOptions.Previous)
   --timestamps      server-side RFC3339 timestamp prefix per line
@@ -70,6 +71,7 @@ class Options:
     previous: bool = False
     timestamps: bool = False
     container: str = ""
+    exclude_container: str = ""
 
 
 USE = "klogs"
@@ -189,6 +191,15 @@ def build_parser() -> argparse.ArgumentParser:
         "(stern-style; default: all containers)",
     )
     p.add_argument(
+        "-E",
+        "--exclude-container",
+        default="",
+        dest="exclude_container",
+        metavar="REGEX",
+        help="Drop containers whose name matches this regex "
+        "(stern-style; composes with -c)",
+    )
+    p.add_argument(
         "--previous",
         action="store_true",
         help="Get logs of the PREVIOUS terminated container instance "
@@ -258,6 +269,7 @@ def parse_args(argv: list[str] | None = None) -> Options:
         previous=ns.previous,
         timestamps=ns.timestamps,
         container=ns.container,
+        exclude_container=ns.exclude_container,
     )
 
 
@@ -277,15 +289,16 @@ def main(argv: list[str] | None = None) -> int:
         term.error("--previous is incompatible with -f/--follow "
                    "(a terminated instance cannot stream)")
         return 1
-    if opts.container:
-        import re
+    for flag, pat in (("-c/--container", opts.container),
+                      ("-E/--exclude-container", opts.exclude_container)):
+        if pat:
+            import re
 
-        try:
-            re.compile(opts.container)
-        except re.error as e:
-            term.error("invalid -c/--container pattern %r: %s",
-                       opts.container, e)
-            return 1
+            try:
+                re.compile(pat)
+            except re.error as e:
+                term.error("invalid %s pattern %r: %s", flag, pat, e)
+                return 1
 
     from klogs_tpu.app import run
     from klogs_tpu.cluster.backend import ClusterError

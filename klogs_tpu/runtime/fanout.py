@@ -63,6 +63,7 @@ SinkFactory = Callable[[StreamJob], Sink]
 def plan_jobs(
     pods: list[PodInfo], log_path: str, include_init: bool,
     container_re: "re.Pattern | None" = None,
+    exclude_container_re: "re.Pattern | None" = None,
 ) -> list[StreamJob]:
     """File creation order matches the reference: per pod, init
     containers first (if -i), then regular (cmd/root.go:240-262).
@@ -73,15 +74,19 @@ def plan_jobs(
     and interleave the same file, so duplicate (pod, container) pairs
     are dropped here.
 
-    ``container_re`` (stern-style ``-c``; additive, the reference
-    streams every container unconditionally) keeps only containers
-    whose NAME it re.search-matches — applied here so static plans and
-    --watch-new discovery select identically."""
+    ``container_re`` / ``exclude_container_re`` (stern-style ``-c`` /
+    ``-E``; additive, the reference streams every container
+    unconditionally) keep only containers whose NAME re.search-matches
+    the include (when given) and not the exclude — applied here so
+    static plans and --watch-new discovery select identically."""
     jobs = []
     seen: set[tuple[str, str, bool]] = set()
 
     def want(name: str) -> bool:
-        return container_re is None or bool(container_re.search(name))
+        if container_re is not None and not container_re.search(name):
+            return False
+        return (exclude_container_re is None
+                or not exclude_container_re.search(name))
 
     for pod in pods:
         if include_init:

@@ -360,7 +360,7 @@ class TestContainerFilter:
         with pytest.raises(FatalError):
             run_app(["-n", "default", "-a", "-p", str(tmp_path / "logs"),
                      "-c", "("], make_cluster())
-        assert "invalid -c/--container" in capsys.readouterr().out
+        assert "invalid -c/--container pattern" in capsys.readouterr().out
 
     def test_container_regex_miss_prints_error(self, tmp_path, capsys):
         _, rc = run_app(["-n", "default", "-a", "-p",
@@ -368,7 +368,7 @@ class TestContainerFilter:
                         make_cluster())
         assert rc == 0
         out = capsys.readouterr().out
-        assert "No containers matching -c 'ngnix'" in out
+        assert "No containers left after -c/-E filtering" in out
         assert "No logs saved" in out
 
     def test_timestamps_with_match_prints_anchor_note(
@@ -378,3 +378,34 @@ class TestContainerFilter:
                          "--match", "ERROR"], make_cluster())
         assert rc == 0
         assert "are part of the line" in capsys.readouterr().out
+
+    def test_exclude_container_regex(self, tmp_path, capsys):
+        out_dir = str(tmp_path / "logs")
+        _, rc = run_app(["-n", "default", "-a", "-t", "2", "-p", out_dir,
+                         "-E", "c0"], make_cluster())
+        assert rc == 0
+        files = sorted(os.listdir(out_dir))
+        assert files == [f"pod-000{i}__c1.log" for i in range(4)]
+
+    def test_include_exclude_container_compose(self, tmp_path, capsys):
+        out_dir = str(tmp_path / "logs")
+        _, rc = run_app(["-n", "default", "-a", "-t", "2", "-p", out_dir,
+                         "-c", "c", "-E", "c1"], make_cluster())
+        assert rc == 0
+        assert sorted(os.listdir(out_dir)) == [
+            f"pod-000{i}__c0.log" for i in range(4)]
+
+    def test_plan_counts_only_streaming_pods(self, tmp_path, capsys):
+        out_dir = str(tmp_path / "logs")
+        fc = FakeCluster()
+        fc.add_pod("default", "api", containers=["srv", "sidecar"],
+                   lines_per_container=2)
+        fc.add_pod("default", "db", containers=["pg"],
+                   lines_per_container=2)
+        _, rc = run_app(["-n", "default", "-a", "-p", out_dir,
+                         "-c", "sidecar"], fc)
+        assert rc == 0
+        out = capsys.readouterr().out
+        # db has no matching container: it must not inflate the plan.
+        assert "Found 1 Pod(s) 1 Container(s)" in out
+        assert "db" not in out.split("Acquiring")[0].split("Found")[1]

@@ -100,3 +100,11 @@ def test_bad_container_regex_rejected_at_cli_boundary(capsys):
     out = capsys.readouterr().out
     assert "invalid -c/--container" in out
     assert "Using Namespace" not in out  # nothing ran
+
+
+def test_exclude_container_flag_and_validation(capsys):
+    from klogs_tpu.cli import parse_args
+
+    assert parse_args(["-a", "-E", "istio"]).exclude_container == "istio"
+    assert main(["-a", "--cluster", "fake", "-E", "["]) == 1
+    assert "invalid -E/--exclude-container" in capsys.readouterr().out
