@@ -1,0 +1,90 @@
+"""Pattern-count and line-width scaling at the ADOPTED kernel defaults
+(tune.kernel_kwargs: mask_block=4 on hardware).
+
+Refreshes BENCH_DEVICE.json's scaling_2026_07_29 rows, which were taken
+on the plain chain: device cost should stay linear in pattern GROUPS
+(grouped compilation) and byte throughput ~flat in width (VMEM tile cap
+trades lanes for columns); this checks the restructured chain preserves
+both properties. Methodology mirrors bench.py's pipelined measurement:
+host-classified batch resident on device, N dispatches in flight, one
+sync.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    import jax
+
+    import bench as B
+    from klogs_tpu.filters.tpu import pack_classify
+    from klogs_tpu.ops import nfa
+    from klogs_tpu.ops.pallas_nfa import match_cls_grouped_pallas
+    from klogs_tpu.ops.tune import kernel_kwargs
+
+    print("attached:", jax.devices()[0], flush=True)
+    kw = kernel_kwargs(on_hardware=True)
+    print("kernel kwargs:", kw, flush=True)
+    N, NF = 524288, 32
+    lines = [ln.rstrip(b"\n") for ln in B.make_lines(N)]
+    out = {"date": time.strftime("%Y-%m-%d"), "kernel_kwargs": kw,
+           "batch": N, "n_flight": NF, "patterns": [], "widths": []}
+
+    def pipelined(dp, live, acc, dcls):
+        run = lambda: match_cls_grouped_pallas(dp, live, acc, dcls, **kw)
+        run().block_until_ready()
+        best = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            outs = [run() for _ in range(NF)]
+            outs[-1].block_until_ready()
+            best = max(best, NF * dcls.shape[0] / (time.perf_counter() - t0))
+        return best
+
+    for k in (8, 16, 32, 64):
+        pats = (B.PATTERNS * ((k // len(B.PATTERNS)) + 1))[:k] \
+            if k > len(B.PATTERNS) else B.PATTERNS[:k]
+        if k > len(B.PATTERNS):  # make repeats distinct patterns
+            pats = B.PATTERNS + [p + r"x{0}" for p in B.PATTERNS[: k - 32]]
+        dp, live, acc = nfa.compile_grouped(pats)
+        table = np.asarray(dp.byte_class).astype(np.int8)
+        cls = pack_classify(lines, 128, table, dp.begin_class,
+                            dp.end_class, dp.pad_class)
+        dcls = jax.device_put(cls)
+        lps = pipelined(dp, live, acc, dcls)
+        g = dp.follow.shape[0]
+        out["patterns"].append({"k": k, "groups": g, "lps": round(lps, 1)})
+        print(f"patterns {k:3d} ({g} groups): {lps:,.0f} lines/s", flush=True)
+
+    dp, live, acc = nfa.compile_grouped(B.PATTERNS)
+    table = np.asarray(dp.byte_class).astype(np.int8)
+    for width in (128, 256, 512, 1024):
+        wl = [(ln * ((width // len(ln)) + 1))[:width] for ln in lines[: N // (width // 128)]]
+        cls = pack_classify(wl, width, table, dp.begin_class,
+                            dp.end_class, dp.pad_class)
+        dcls = jax.device_put(cls)
+        lps = pipelined(dp, live, acc, dcls)
+        mbs = lps * width / 1e6
+        out["widths"].append({"width": width, "lps": round(lps, 1),
+                              "mb_s": round(mbs, 1)})
+        print(f"width {width:5d}B: {lps:,.0f} lines/s = {mbs:,.0f} MB/s",
+              flush=True)
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_DEVICE.json")
+    with open(path) as f:
+        dev = json.load(f)
+    dev["scaling_mask_block4"] = out
+    with open(path, "w") as f:
+        json.dump(dev, f, indent=1)
+    print("wrote", path, flush=True)
+
+
+if __name__ == "__main__":
+    main()
