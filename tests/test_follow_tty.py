@@ -87,3 +87,64 @@ def test_follow_quits_on_q_via_pty(tmp_path):
         except (ProcessLookupError, ChildProcessError):
             pass
         os.close(master)
+
+
+def test_follow_stdout_mode_quits_on_q_via_pty(tmp_path):
+    """-o stdout in follow mode: prefixed lines stream, the static
+    press-q hint replaces the spinner (no repaint garbling the stream),
+    q quits cleanly, and no files are created."""
+    pid, master = pty.fork()
+    if pid == 0:
+        os.environ["NO_COLOR"] = "1"
+        os.environ["KLOGS_FAKE_PODS"] = "2"
+        os.environ["KLOGS_FAKE_CONTAINERS"] = "1"
+        os.execv(sys.executable, [
+            sys.executable, "-m", "klogs_tpu.cli",
+            "-n", "default", "-a", "-f", "--cluster", "fake",
+            "-o", "stdout", "-p", str(tmp_path / "logs"),
+        ])
+        os._exit(97)
+
+    out = b""
+    try:
+        end = time.time() + 60
+        while time.time() < end and (
+                b"to stop streaming" not in out
+                or out.count(b"pod-0000 c0 ") < 3):
+            r, _, _ = select.select([master], [], [], 0.3)
+            if r:
+                try:
+                    out += os.read(master, 65536)
+                except OSError:
+                    break
+        assert b"to stop streaming" in out, out[-500:]
+        assert out.count(b"pod-0000 c0 ") >= 3, out[-500:]
+        time.sleep(0.5)
+        status = None
+        end = time.time() + 30
+        while time.time() < end:
+            try:
+                os.write(master, b"q")
+            except OSError:
+                pass
+            r, _, _ = select.select([master], [], [], 0.3)
+            if r:
+                try:
+                    out += os.read(master, 65536)
+                except OSError:
+                    pass
+            done, st = os.waitpid(pid, os.WNOHANG)
+            if done:
+                status = st
+                break
+        assert status is not None, b"child never quit on q: " + out[-500:]
+        assert os.waitstatus_to_exitcode(status) == 0, out[-800:]
+        assert b"Logs saved to" not in out  # no size table in stdout mode
+        assert not (tmp_path / "logs").exists()  # no files at all
+    finally:
+        try:
+            os.kill(pid, signal.SIGKILL)
+            os.waitpid(pid, 0)
+        except (ProcessLookupError, ChildProcessError):
+            pass
+        os.close(master)
