@@ -9,6 +9,7 @@ package globals (cmd/root.go:36-49).
 
 import asyncio
 import os
+import signal
 import threading
 from typing import Iterable
 
@@ -380,13 +381,47 @@ async def _run_async_inner(
                 term.warning("--watch-new only applies with -f; ignoring")
             # With discovery active, an EMPTY initial selection still
             # waits (the point of starting the watch before deploying).
+            interrupted = False
             if opts.follow and (jobs or plan_new is not None):
                 flusher = (
                     asyncio.create_task(pipeline.run_deadline_flusher())
                     if pipeline is not None else None
                 )
+                sigint_installed = False
                 if stop is None:
                     stop = asyncio.Event()
+                    # Ctrl-C parity+: the reference exits with streams
+                    # still running and buffers unflushed (SURVEY §3.3
+                    # quirk class). First SIGINT = graceful stop (same
+                    # teardown as q: close streams, flush every sink,
+                    # render the size table) but still exit 130 like
+                    # kubectl; second SIGINT = give up immediately.
+                    loop = asyncio.get_running_loop()
+
+                    def on_sigint() -> None:
+                        nonlocal interrupted
+                        if interrupted:
+                            # Force quit must NOT re-enter the event
+                            # loop (a raised KeyboardInterrupt funnels
+                            # through asyncio.run's cleanup, which can
+                            # block on the very await that wedged the
+                            # graceful path — e.g. backend.close on a
+                            # dead tunnel). Die by signal, like the
+                            # default handler would.
+                            signal.signal(signal.SIGINT, signal.SIG_DFL)
+                            os.kill(os.getpid(), signal.SIGINT)
+                            return
+                        interrupted = True
+                        term.warning(
+                            "Interrupt: stopping streams (Ctrl-C again "
+                            "to force quit)")
+                        stop.set()
+
+                    try:
+                        loop.add_signal_handler(signal.SIGINT, on_sigint)
+                        sigint_installed = True
+                    except (NotImplementedError, RuntimeError):
+                        pass  # non-main thread / platform without support
                     watcher_done = threading.Event()
                     if opts.output == "stdout":
                         quit_msg = (f"Press {term.green('q')} to stop "
@@ -420,6 +455,9 @@ async def _run_async_inner(
                     # table too.
                     log_files = [r.job.path for r in results]
                 finally:
+                    if sigint_installed:
+                        asyncio.get_running_loop().remove_signal_handler(
+                            signal.SIGINT)
                     if watcher is not None:
                         # Unblock the /dev/tty reader thread so the
                         # terminal is restored and the process can exit.
@@ -440,7 +478,9 @@ async def _run_async_inner(
                 print_log_size(log_files, opts.log_path)
             if pipeline is not None and opts.stats:
                 pipeline.print_summary()
-            return 0
+            # Interrupted-but-graceful: everything is flushed and
+            # reported, yet scripts still see the conventional 130.
+            return 130 if interrupted else 0
         finally:
             # Close inside the loop even on error/Ctrl-C paths — an
             # unawaited grpc channel or in-flight batch task would be

@@ -148,3 +148,137 @@ def test_follow_stdout_mode_quits_on_q_via_pty(tmp_path):
         except (ProcessLookupError, ChildProcessError):
             pass
         os.close(master)
+
+
+def test_follow_sigint_graceful_flush(tmp_path):
+    """First Ctrl-C in follow mode = graceful stop: streams close,
+    sinks flush, the size table renders — but the exit code stays the
+    conventional 130. (The reference exits with streams running and
+    buffers unflushed; SURVEY §3.3.) Needs a real pty: without a
+    controlling terminal the q-watcher stops the run immediately."""
+    pid, master = pty.fork()
+    if pid == 0:
+        os.environ["NO_COLOR"] = "1"
+        os.environ["KLOGS_FAKE_PODS"] = "2"
+        os.environ["KLOGS_FAKE_CONTAINERS"] = "1"
+        os.execv(sys.executable, [
+            sys.executable, "-m", "klogs_tpu.cli",
+            "-n", "default", "-a", "-f", "--cluster", "fake",
+            "-p", str(tmp_path / "logs"),
+        ])
+        os._exit(97)
+
+    out = b""
+    try:
+        end = time.time() + 60
+        while time.time() < end and b"to stop streaming" not in out:
+            r, _, _ = select.select([master], [], [], 0.3)
+            if r:
+                try:
+                    out += os.read(master, 65536)
+                except OSError:
+                    break
+        assert b"to stop streaming" in out, out[-500:]
+        time.sleep(0.5)
+        os.kill(pid, signal.SIGINT)
+        status = None
+        end = time.time() + 30
+        while time.time() < end:
+            r, _, _ = select.select([master], [], [], 0.3)
+            if r:
+                try:
+                    out += os.read(master, 65536)
+                except OSError:
+                    pass
+            done, st = os.waitpid(pid, os.WNOHANG)
+            if done:
+                status = st
+                break
+        assert status is not None, b"child never exited: " + out[-500:]
+        while True:
+            r, _, _ = select.select([master], [], [], 0.2)
+            if not r:
+                break
+            try:
+                chunk = os.read(master, 65536)
+            except OSError:
+                break
+            if not chunk:
+                break
+            out += chunk
+        assert os.waitstatus_to_exitcode(status) == 130, out[-800:]
+        assert b"Interrupt: stopping streams" in out
+        assert b"Logs saved to" in out  # size table rendered post-flush
+        logs = list((tmp_path / "logs").glob("*__*.log"))
+        assert logs and all(p.stat().st_size > 0 for p in logs)
+    finally:
+        try:
+            os.kill(pid, signal.SIGKILL)
+            os.waitpid(pid, 0)
+        except (ProcessLookupError, ChildProcessError):
+            pass
+        os.close(master)
+
+
+def test_follow_double_sigint_force_quits(tmp_path):
+    """Second Ctrl-C must kill the process by signal even if graceful
+    teardown could wedge — it must not re-enter the event loop."""
+    pid, master = pty.fork()
+    if pid == 0:
+        os.environ["NO_COLOR"] = "1"
+        os.environ["KLOGS_FAKE_PODS"] = "1"
+        os.environ["KLOGS_FAKE_CONTAINERS"] = "1"
+        # Slow streams keep the graceful drain busy long enough for the
+        # second signal to land mid-teardown.
+        os.execv(sys.executable, [
+            sys.executable, "-m", "klogs_tpu.cli",
+            "-n", "default", "-a", "-f", "--cluster", "fake",
+            "-p", str(tmp_path / "logs"),
+        ])
+        os._exit(97)
+
+    out = b""
+    try:
+        end = time.time() + 60
+        while time.time() < end and b"to stop streaming" not in out:
+            r, _, _ = select.select([master], [], [], 0.3)
+            if r:
+                try:
+                    out += os.read(master, 65536)
+                except OSError:
+                    break
+        assert b"to stop streaming" in out, out[-500:]
+        time.sleep(0.3)
+        os.kill(pid, signal.SIGINT)
+        time.sleep(0.2)  # let the first handler run
+        try:
+            os.kill(pid, signal.SIGINT)
+        except ProcessLookupError:
+            pass  # already exited gracefully — acceptable on a fast box
+        status = None
+        end = time.time() + 30
+        while time.time() < end:
+            r, _, _ = select.select([master], [], [], 0.3)
+            if r:
+                try:
+                    out += os.read(master, 65536)
+                except OSError:
+                    pass
+            done, st = os.waitpid(pid, os.WNOHANG)
+            if done:
+                status = st
+                break
+        assert status is not None, b"child never exited: " + out[-500:]
+        code = (os.waitstatus_to_exitcode(status)
+                if not os.WIFSIGNALED(status) else
+                -os.WTERMSIG(status))
+        # Either the force-quit signal death (-SIGINT) or, if teardown
+        # won the race, the graceful 130.
+        assert code in (-signal.SIGINT, 130), (code, out[-500:])
+    finally:
+        try:
+            os.kill(pid, signal.SIGKILL)
+            os.waitpid(pid, 0)
+        except (ProcessLookupError, ChildProcessError):
+            pass
+        os.close(master)
