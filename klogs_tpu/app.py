@@ -225,12 +225,18 @@ def make_inner_sink_factory(opts: Options):
     if opts.output == "files":
         return None
     from klogs_tpu.runtime.sink import FileSink
-    from klogs_tpu.runtime.stdout import StdoutSink, TeeSink
+    from klogs_tpu.runtime.stdout import (
+        StdoutSink,
+        TeeSink,
+        compile_highlights,
+    )
 
+    hl = compile_highlights(opts.match, opts.ignore_case)
     if opts.output == "stdout":
-        return lambda job: StdoutSink(job.pod, job.container)
-    return lambda job: TeeSink(FileSink(job.path),
-                               StdoutSink(job.pod, job.container))
+        return lambda job: StdoutSink(job.pod, job.container, highlight=hl)
+    return lambda job: TeeSink(
+        FileSink(job.path),
+        StdoutSink(job.pod, job.container, highlight=hl))
 
 
 def make_pipeline_for(opts: Options):

@@ -33,20 +33,48 @@ def pod_color_code(pod: str) -> str:
     return _POD_COLOR_CODES[zlib.crc32(pod.encode()) % len(_POD_COLOR_CODES)]
 
 
+_HL_ON = b"\x1b[1;31m"
+_HL_OFF = b"\x1b[0m"
+
+
+def compile_highlights(patterns, ignore_case: bool = False) -> list:
+    """--match patterns as bytes regexes for console highlighting.
+    Only used when colors are on; a pattern Python `re` cannot take
+    (shouldn't happen — the NFA subset is property-tested against re)
+    is skipped rather than breaking the stream."""
+    import re
+
+    out = []
+    for p in patterns or ():
+        try:
+            out.append(re.compile(p.encode(),
+                                  re.IGNORECASE if ignore_case else 0))
+        except (re.error, UnicodeEncodeError):
+            pass
+    return out
+
+
 class StdoutSink(Sink):
     """Line-prefixed console sink for one (pod, container) stream.
 
     Flushes after every emitted line batch: the console is a live
     surface (think ``-f``), not a bulk file copy, and stdout's own
     buffering would otherwise hold lines for seconds on quiet streams.
+
+    ``highlight`` (compile_highlights output) wraps each --match hit in
+    bold red, stern-style — only consulted when colors are on.
     """
 
-    def __init__(self, pod: str, container: str, out=None):
+    def __init__(self, pod: str, container: str, out=None,
+                 highlight: list | None = None):
         self._framer = LineFramer()
         self._out = out if out is not None else sys.stdout.buffer
         prefix = f"{pod} {container}"
         if term.colors_enabled():
             prefix = f"\x1b[{pod_color_code(pod)}m{prefix}\x1b[0m"
+            self._highlight = highlight or []
+        else:
+            self._highlight = []
         self._prefix = (prefix + " ").encode()
         self._bytes = 0
         self._closed = False
@@ -54,9 +82,41 @@ class StdoutSink(Sink):
     async def write(self, chunk: bytes) -> None:
         self._emit(self._framer.feed(chunk))
 
+    def _decorate(self, ln: bytes) -> bytes:
+        # Spans are computed on the RAW body (newline excluded, matching
+        # RegexFilter's rstrip semantics) and the SGR codes inserted in
+        # one pass afterwards — sequential re.sub would let later
+        # patterns match inside earlier patterns' escape codes, and a
+        # whitespace match swallowing the newline would strand the reset
+        # on the next visual row.
+        body = ln[:-1] if ln.endswith(b"\n") else ln
+        spans = []
+        for rx in self._highlight:
+            for m in rx.finditer(body):
+                if m.group(0):  # zero-width (e.g. `a*`) adds nothing
+                    spans.append((m.start(), m.end()))
+        if not spans:
+            return ln
+        spans.sort()
+        merged = [list(spans[0])]
+        for s, e in spans[1:]:
+            if s <= merged[-1][1]:
+                merged[-1][1] = max(merged[-1][1], e)
+            else:
+                merged.append([s, e])
+        out = bytearray()
+        prev = 0
+        for s, e in merged:
+            out += body[prev:s] + _HL_ON + body[s:e] + _HL_OFF
+            prev = e
+        out += body[prev:]
+        return bytes(out) + ln[len(body):]
+
     def _emit(self, lines: list) -> None:
         if not lines:
             return
+        if self._highlight:
+            lines = [self._decorate(ln) for ln in lines]
         buf = b"".join(self._prefix + ln for ln in lines)
         self._out.write(buf)
         self._out.flush()

@@ -194,3 +194,118 @@ class TestOutputModesE2E:
         self._run(["-n", "default", "-a", "-t", "2", "-p", out_dir,
                    "-o", "stdout"], capsysbinary)
         assert term.ui_stream() is sys.stdout
+
+
+class TestHighlight:
+    def test_match_hits_wrapped_in_color(self):
+        from klogs_tpu.runtime.stdout import compile_highlights
+
+        term.set_colors(True)
+        out = io.BytesIO()
+        s = StdoutSink("p", "c", out=out,
+                       highlight=compile_highlights(["ERR[A-Z]*"]))
+
+        async def go():
+            await s.write(b"an ERROR happened\n")
+            await s.close()
+
+        run_sink(go())
+        data = out.getvalue()
+        assert b"\x1b[1;31mERROR\x1b[0m" in data
+
+    def test_zero_width_pattern_is_safe(self):
+        from klogs_tpu.runtime.stdout import compile_highlights
+
+        term.set_colors(True)
+        out = io.BytesIO()
+        s = StdoutSink("p", "c", out=out,
+                       highlight=compile_highlights(["a*"]))
+
+        async def go():
+            await s.write(b"bab\n")
+            await s.close()
+
+        run_sink(go())
+        # Only the real 'a' is wrapped; zero-width matches add nothing.
+        assert out.getvalue().count(b"\x1b[1;31m") == 1
+
+    def test_highlight_off_without_colors(self):
+        from klogs_tpu.runtime.stdout import compile_highlights
+
+        out = io.BytesIO()  # autouse fixture forces colors off
+        s = StdoutSink("p", "c", out=out,
+                       highlight=compile_highlights(["ERROR"]))
+
+        async def go():
+            await s.write(b"an ERROR happened\n")
+            await s.close()
+
+        run_sink(go())
+        assert b"\x1b[" not in out.getvalue()
+
+    def test_ignore_case(self):
+        from klogs_tpu.runtime.stdout import compile_highlights
+
+        term.set_colors(True)
+        out = io.BytesIO()
+        s = StdoutSink("p", "c", out=out,
+                       highlight=compile_highlights(["error"], True))
+
+        async def go():
+            await s.write(b"an ERROR happened\n")
+            await s.close()
+
+        run_sink(go())
+        assert b"\x1b[1;31mERROR\x1b[0m" in out.getvalue()
+
+    def test_multiple_patterns_never_match_inside_escapes(self):
+        from klogs_tpu.runtime.stdout import compile_highlights
+
+        term.set_colors(True)
+        out = io.BytesIO()
+        s = StdoutSink("p", "c", out=out,
+                       highlight=compile_highlights(["ERROR", r"[0-9]+"]))
+
+        async def go():
+            await s.write(b"ERROR code 42\n")
+            await s.close()
+
+        run_sink(go())
+        data = out.getvalue()
+        # Exactly two highlighted regions; no digits of the SGR codes
+        # themselves got re-wrapped (the old sequential-sub corruption).
+        assert data.count(b"\x1b[1;31m") == 2
+        assert b"\x1b[1;31mERROR\x1b[0m" in data
+        assert b"\x1b[1;31m42\x1b[0m" in data
+        assert b"\x1b[\x1b[" not in data
+
+    def test_whitespace_match_does_not_swallow_newline(self):
+        from klogs_tpu.runtime.stdout import compile_highlights
+
+        term.set_colors(True)
+        out = io.BytesIO()
+        s = StdoutSink("p", "c", out=out,
+                       highlight=compile_highlights([r"ERROR\s*"]))
+
+        async def go():
+            await s.write(b"an ERROR\n")
+            await s.close()
+
+        run_sink(go())
+        # Reset lands BEFORE the newline; red never bleeds to the next row.
+        assert out.getvalue().endswith(b"\x1b[1;31mERROR\x1b[0m\n")
+
+    def test_overlapping_spans_merge(self):
+        from klogs_tpu.runtime.stdout import compile_highlights
+
+        term.set_colors(True)
+        out = io.BytesIO()
+        s = StdoutSink("p", "c", out=out,
+                       highlight=compile_highlights(["ERRO", "RROR"]))
+
+        async def go():
+            await s.write(b"xERRORx\n")
+            await s.close()
+
+        run_sink(go())
+        assert b"\x1b[1;31mERROR\x1b[0m" in out.getvalue()
