@@ -223,20 +223,27 @@ def make_inner_sink_factory(opts: Options):
     behavior; ``stdout`` = stern-style prefixed console stream;
     ``both`` = tee to file and console."""
     if opts.output == "files":
+        if opts.format != "text":
+            term.warning("--format %s only applies with -o stdout|both; "
+                         "ignoring", opts.format)
         return None
     from klogs_tpu.runtime.sink import FileSink
     from klogs_tpu.runtime.stdout import (
+        JsonStdoutSink,
         StdoutSink,
         TeeSink,
         compile_highlights,
     )
 
-    hl = compile_highlights(opts.match, opts.ignore_case)
+    if opts.format == "json":
+        console = lambda job: JsonStdoutSink(job.pod, job.container)
+    else:
+        hl = compile_highlights(opts.match, opts.ignore_case)
+        console = lambda job: StdoutSink(job.pod, job.container,
+                                         highlight=hl)
     if opts.output == "stdout":
-        return lambda job: StdoutSink(job.pod, job.container, highlight=hl)
-    return lambda job: TeeSink(
-        FileSink(job.path),
-        StdoutSink(job.pod, job.container, highlight=hl))
+        return console
+    return lambda job: TeeSink(FileSink(job.path), console(job))
 
 
 def make_pipeline_for(opts: Options):

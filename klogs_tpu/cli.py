@@ -22,6 +22,8 @@ New (north-star) flags, absent from the reference:
   -I/--ignore-case  case-insensitive --match patterns
   -o/--output       files (reference behavior) | stdout (stern-style
                     prefixed console stream, no files) | both
+  --format          console stream format: text (prefixed lines) |
+                    json (one object per line, stern -o json analog)
   -c/--container    only containers whose name matches this regex
                     (stern parity; the reference streams all containers)
   -E/--exclude-container  drop containers whose name matches this regex
@@ -72,6 +74,7 @@ class Options:
     timestamps: bool = False
     container: str = ""
     exclude_container: str = ""
+    format: str = "text"
 
 
 USE = "klogs"
@@ -183,6 +186,13 @@ def build_parser() -> argparse.ArgumentParser:
         "(stern-style), or both",
     )
     p.add_argument(
+        "--format",
+        choices=["text", "json"],
+        default="text",
+        help="Console stream format with -o stdout|both: prefixed text "
+        "lines or one JSON object per line ({pod, container, line})",
+    )
+    p.add_argument(
         "-c",
         "--container",
         default="",
@@ -270,6 +280,7 @@ def parse_args(argv: list[str] | None = None) -> Options:
         timestamps=ns.timestamps,
         container=ns.container,
         exclude_container=ns.exclude_container,
+        format=ns.format,
     )
 
 

@@ -15,6 +15,7 @@ The prefix color is stable per pod name across runs (CRC-based, not
 ``hash()`` which is salted per process), like stern's pod coloring.
 """
 
+import json
 import sys
 import zlib
 
@@ -54,12 +55,52 @@ def compile_highlights(patterns, ignore_case: bool = False) -> list:
     return out
 
 
-class StdoutSink(Sink):
-    """Line-prefixed console sink for one (pod, container) stream.
+class _ConsoleSink(Sink):
+    """Shared console-sink lifecycle: incremental framing, write-through
+    flushing (the console is a live surface, not a bulk file copy —
+    stdout's own buffering would hold lines for seconds on quiet
+    streams), and a close() that emits any unterminated final fragment.
+    Subclasses provide ``_render(lines) -> bytes``."""
 
-    Flushes after every emitted line batch: the console is a live
-    surface (think ``-f``), not a bulk file copy, and stdout's own
-    buffering would otherwise hold lines for seconds on quiet streams.
+    def __init__(self, out=None):
+        self._framer = LineFramer()
+        self._out = out if out is not None else sys.stdout.buffer
+        self._bytes = 0
+        self._closed = False
+
+    async def write(self, chunk: bytes) -> None:
+        self._emit(self._framer.feed(chunk))
+
+    def _emit(self, lines: list) -> None:
+        if not lines:
+            return
+        buf = self._render(lines)
+        self._out.write(buf)
+        self._out.flush()
+        self._bytes += len(buf)
+
+    async def flush(self) -> None:
+        if not self._closed:
+            self._out.flush()
+
+    async def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        rest = self._framer.flush()
+        if rest is not None:
+            # Stream ended mid-line: terminate the fragment, or (in text
+            # form) it would visually fuse with the next stream's prefix.
+            self._emit([rest + b"\n"])
+        self._out.flush()
+
+    @property
+    def bytes_written(self) -> int:
+        return self._bytes
+
+
+class StdoutSink(_ConsoleSink):
+    """Line-prefixed console sink for one (pod, container) stream.
 
     ``highlight`` (compile_highlights output) wraps each --match hit in
     bold red, stern-style — only consulted when colors are on.
@@ -67,8 +108,7 @@ class StdoutSink(Sink):
 
     def __init__(self, pod: str, container: str, out=None,
                  highlight: list | None = None):
-        self._framer = LineFramer()
-        self._out = out if out is not None else sys.stdout.buffer
+        super().__init__(out)
         prefix = f"{pod} {container}"
         if term.colors_enabled():
             prefix = f"\x1b[{pod_color_code(pod)}m{prefix}\x1b[0m"
@@ -76,11 +116,6 @@ class StdoutSink(Sink):
         else:
             self._highlight = []
         self._prefix = (prefix + " ").encode()
-        self._bytes = 0
-        self._closed = False
-
-    async def write(self, chunk: bytes) -> None:
-        self._emit(self._framer.feed(chunk))
 
     def _decorate(self, ln: bytes) -> bytes:
         # Spans are computed on the RAW body (newline excluded, matching
@@ -112,34 +147,34 @@ class StdoutSink(Sink):
         out += body[prev:]
         return bytes(out) + ln[len(body):]
 
-    def _emit(self, lines: list) -> None:
-        if not lines:
-            return
+    def _render(self, lines: list) -> bytes:
         if self._highlight:
             lines = [self._decorate(ln) for ln in lines]
-        buf = b"".join(self._prefix + ln for ln in lines)
-        self._out.write(buf)
-        self._out.flush()
-        self._bytes += len(buf)
+        return b"".join(self._prefix + ln for ln in lines)
 
-    async def flush(self) -> None:
-        if not self._closed:
-            self._out.flush()
 
-    async def close(self) -> None:
-        if self._closed:
-            return
-        self._closed = True
-        rest = self._framer.flush()
-        if rest is not None:
-            # Stream ended mid-line: emit the fragment terminated, or it
-            # would visually fuse with the next stream's prefix.
-            self._emit([rest + b"\n"])
-        self._out.flush()
+class JsonStdoutSink(_ConsoleSink):
+    """``-o stdout --format json``: one JSON object per log line —
+    ``{"pod": ..., "container": ..., "line": ...}`` — for jq/log-shipper
+    consumption (stern's ``-o json`` analog). No prefixes, colors, or
+    highlighting; the line is decoded as UTF-8 with replacement (log
+    bytes are not guaranteed text) and carries no trailing newline
+    (close()'s fragment terminator is stripped with the rest)."""
 
-    @property
-    def bytes_written(self) -> int:
-        return self._bytes
+    def __init__(self, pod: str, container: str, out=None):
+        super().__init__(out)
+        self._pod = pod
+        self._container = container
+
+    def _render(self, lines: list) -> bytes:
+        return b"".join(
+            json.dumps({
+                "pod": self._pod,
+                "container": self._container,
+                "line": ln.rstrip(b"\n").decode("utf-8", "replace"),
+            }, ensure_ascii=False).encode() + b"\n"
+            for ln in lines
+        )
 
 
 class TeeSink(Sink):

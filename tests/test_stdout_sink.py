@@ -309,3 +309,76 @@ class TestHighlight:
 
         run_sink(go())
         assert b"\x1b[1;31mERROR\x1b[0m" in out.getvalue()
+
+
+class TestJsonFormat:
+    def test_json_objects_per_line(self):
+        import json as _json
+
+        from klogs_tpu.runtime.stdout import JsonStdoutSink
+
+        out = io.BytesIO()
+        s = JsonStdoutSink("web-1", "nginx", out=out)
+
+        async def go():
+            await s.write(b"hello\nwor")
+            await s.write(b"ld\n")
+            await s.close()
+
+        run_sink(go())
+        objs = [_json.loads(ln) for ln in out.getvalue().splitlines()]
+        assert objs == [
+            {"pod": "web-1", "container": "nginx", "line": "hello"},
+            {"pod": "web-1", "container": "nginx", "line": "world"},
+        ]
+
+    def test_json_handles_binary_and_unterminated(self):
+        import json as _json
+
+        from klogs_tpu.runtime.stdout import JsonStdoutSink
+
+        out = io.BytesIO()
+        s = JsonStdoutSink("p", "c", out=out)
+
+        async def go():
+            await s.write(b"\xff\xfe bad utf8")
+            await s.close()
+
+        run_sink(go())
+        (obj,) = [_json.loads(ln) for ln in out.getvalue().splitlines()]
+        assert obj["line"].endswith(" bad utf8")  # replaced, not crashed
+
+    def test_json_e2e_with_match(self, tmp_path, capsysbinary):
+        import json as _json
+
+        from klogs_tpu import app
+        from klogs_tpu.cli import parse_args
+        from klogs_tpu.cluster.fake import FakeCluster
+
+        fc = FakeCluster.synthetic(
+            n_pods=1, n_containers=1, lines_per_container=20)
+        opts = parse_args(["-n", "default", "-a", "-t", "20",
+                           "-p", str(tmp_path / "logs"),
+                           "-o", "stdout", "--format", "json",
+                           "--match", "ERROR"])
+        rc = asyncio.run(app.run_async(opts, backend=fc))
+        assert rc == 0
+        out = capsysbinary.readouterr().out
+        objs = [_json.loads(ln) for ln in out.splitlines()]
+        assert len(objs) == 5  # 1/4 of 20 lines are ERROR
+        assert all(o["pod"] == "pod-0000" and o["container"] == "c0"
+                   and " ERROR " in o["line"] for o in objs)
+
+    def test_format_json_without_console_warns(self, tmp_path, capsysbinary):
+        from klogs_tpu import app
+        from klogs_tpu.cli import parse_args
+        from klogs_tpu.cluster.fake import FakeCluster
+
+        fc = FakeCluster.synthetic(
+            n_pods=1, n_containers=1, lines_per_container=3)
+        opts = parse_args(["-n", "default", "-a", "-t", "3",
+                           "-p", str(tmp_path / "logs"),
+                           "--format", "json"])
+        rc = asyncio.run(app.run_async(opts, backend=fc))
+        assert rc == 0
+        assert b"only applies with -o" in capsysbinary.readouterr().out
