@@ -19,7 +19,11 @@ semantics:
 New (north-star) flags, absent from the reference:
 
   --match           repeatable regex; only matching lines are written
-  -I/--ignore-case  case-insensitive --match patterns
+  --exclude         repeatable regex; drop matching lines (alone =
+                    keep everything EXCEPT matches)
+  -I/--ignore-case  case-insensitive --match/--exclude patterns
+  --watch-new       with -f and -a/-l: stream pods created mid-follow
+                    (stern-style dynamic discovery)
   -o/--output       files (reference behavior) | stdout (stern-style
                     prefixed console stream, no files) | both
   --format          console stream format: text (prefixed lines) |
