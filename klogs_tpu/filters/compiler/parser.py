@@ -32,7 +32,7 @@ the CPU baseline is Python ``re`` (≙ Go ``regexp`` in klogs' world,
 /root/reference/cmd/root.go:366 being the unfiltered write).
 """
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 
 class RegexSyntaxError(ValueError):

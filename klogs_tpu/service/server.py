@@ -25,7 +25,6 @@ Partial TLS configuration (cert without key, client-ca without cert) is
 a constructor error, never a silent plaintext fallback.
 """
 
-import asyncio
 import hmac
 
 import grpc

@@ -9,7 +9,6 @@ Reference parity:
 
 import asyncio
 import itertools
-import sys
 
 from klogs_tpu.ui import term
 
