@@ -121,6 +121,9 @@ def build_log_options(opts: Options) -> LogOptions:
         # kubectl parity: "only one of follow or previous may be true".
         term.fatal("--previous is incompatible with -f/--follow "
                    "(a terminated instance cannot stream)")
+    if opts.since and opts.since_time:
+        term.fatal("at most one of -s/--since and --since-time may be "
+                   "given (kubectl parity)")
     lo = LogOptions(follow=opts.follow, previous=opts.previous,
                     timestamps=opts.timestamps)
     if opts.since:
@@ -128,6 +131,19 @@ def build_log_options(opts: Options) -> LogOptions:
             lo.since_seconds = int(parse_duration(opts.since))
         except DurationError as e:
             term.fatal("%s", e)
+    if opts.since_time:
+        from datetime import datetime
+
+        try:
+            dt = datetime.fromisoformat(
+                opts.since_time.replace("Z", "+00:00"))
+            if dt.tzinfo is None:  # see cli.main: naive is not RFC3339
+                raise ValueError("missing timezone offset")
+        except ValueError:
+            # Backstop for library callers; cli.main rejects earlier.
+            term.fatal("invalid --since-time %r (want RFC3339 with a "
+                       "timezone)", opts.since_time)
+        lo.since_time = opts.since_time
     if opts.tail != -1:
         lo.tail_lines = opts.tail
     return lo

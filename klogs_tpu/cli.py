@@ -35,6 +35,9 @@ New (north-star) flags, absent from the reference:
                     (kubectl -p parity; PodLogOptions.Previous)
   --timestamps      server-side RFC3339 timestamp prefix per line
                     (kubectl parity; PodLogOptions.Timestamps)
+  --since-time      only logs after an absolute RFC3339 time
+                    (kubectl parity; PodLogOptions.SinceTime;
+                    mutually exclusive with -s/--since)
   --backend         filter engine: cpu (host regex) | tpu (batch NFA)
   --remote          gate writes via a klogs-filterd service (gRPC)
   --profile         write a JAX profiler trace of the run to DIR
@@ -79,6 +82,7 @@ class Options:
     container: str = ""
     exclude_container: str = ""
     format: str = "text"
+    since_time: str = ""
 
 
 USE = "klogs"
@@ -220,6 +224,15 @@ def build_parser() -> argparse.ArgumentParser:
         "(kubectl logs -p); incompatible with -f",
     )
     p.add_argument(
+        "--since-time",
+        default="",
+        dest="since_time",
+        metavar="RFC3339",
+        help="Only return logs after an absolute time, e.g. "
+        "2026-07-31T06:00:00Z (kubectl logs --since-time; "
+        "mutually exclusive with -s/--since)",
+    )
+    p.add_argument(
         "--timestamps",
         action="store_true",
         help="Prefix each log line with its server-side RFC3339 "
@@ -285,6 +298,7 @@ def parse_args(argv: list[str] | None = None) -> Options:
         container=ns.container,
         exclude_container=ns.exclude_container,
         format=ns.format,
+        since_time=ns.since_time,
     )
 
 
@@ -304,6 +318,27 @@ def main(argv: list[str] | None = None) -> int:
         term.error("--previous is incompatible with -f/--follow "
                    "(a terminated instance cannot stream)")
         return 1
+    if opts.since and opts.since_time:
+        term.error("at most one of -s/--since and --since-time may be "
+                   "given (kubectl parity)")
+        return 1
+    if opts.since_time:
+        from datetime import datetime
+
+        try:
+            dt = datetime.fromisoformat(
+                opts.since_time.replace("Z", "+00:00"))
+            # fromisoformat also accepts date-only and offset-naive
+            # forms that are NOT RFC3339; a naive cutoff would be
+            # interpreted in the machine's local zone (wrong window)
+            # and the apiserver would 400 the verbatim string.
+            if dt.tzinfo is None:
+                raise ValueError("missing timezone offset")
+        except ValueError:
+            term.error("invalid --since-time %r (want RFC3339 with a "
+                       "timezone, e.g. 2026-07-31T06:00:00Z)",
+                       opts.since_time)
+            return 1
     for flag, pat in (("-c/--container", opts.container),
                       ("-E/--exclude-container", opts.exclude_container)):
         if pat:

@@ -97,6 +97,16 @@ class FakeLogStream(LogStream):
     async def close(self) -> None:
         self._closed.set()
 
+    def _since_time_cutoff(self) -> float | None:
+        """PodLogOptions.SinceTime as an epoch cutoff (RFC3339 input;
+        validated tz-aware upstream)."""
+        if self._opts.since_time is None:
+            return None
+        from datetime import datetime
+
+        return datetime.fromisoformat(
+            self._opts.since_time.replace("Z", "+00:00")).timestamp()
+
     def _stamp(self, ts: float, ln: bytes) -> bytes:
         """PodLogOptions.Timestamps: kubelet prefixes each line with an
         RFC3339Nano timestamp and one space."""
@@ -110,6 +120,9 @@ class FakeLogStream(LogStream):
         # previous=true reads the terminated prior instance's history
         # (PodLogOptions.Previous); a previous stream never follows.
         lines = self._c.previous_lines if self._opts.previous else self._c.lines
+        cutoff = self._since_time_cutoff()
+        if cutoff is not None:
+            lines = [(ts, ln) for ts, ln in lines if ts >= cutoff]
         if self._opts.since_seconds is not None:
             cutoff = self._clock() - self._opts.since_seconds
             lines = [(ts, ln) for ts, ln in lines if ts >= cutoff]
@@ -174,6 +187,11 @@ class FakeLogStream(LogStream):
             seq = self._c.next_seq
             self._c.next_seq += 1
             now = self._clock()
+            cutoff = self._since_time_cutoff()
+            if cutoff is not None and now < cutoff:
+                # kubelet applies the since bound to followed lines too
+                # (reachable only via since_time: a future cutoff).
+                continue
             line = self._stamp(now, synthetic_line(
                 self._pod, self._c.name, seq, now))
             emitted += 1

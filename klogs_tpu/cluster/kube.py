@@ -172,6 +172,8 @@ class KubeBackend(ClusterBackend):
             params["previous"] = "true"
         if opts.timestamps:
             params["timestamps"] = "true"
+        if opts.since_time is not None:
+            params["sinceTime"] = opts.since_time
         try:
             resp = None
             for attempt in (0, 1):

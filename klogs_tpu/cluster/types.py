@@ -35,10 +35,12 @@ class LogOptions:
     container: str = ""
     # kubectl-parity options absent from the reference (its getLopOpts,
     # cmd/root.go:201-221, maps only since/tail/follow): logs of the
-    # PREVIOUS terminated container instance (PodLogOptions.Previous)
-    # and server-side RFC3339 line timestamps (PodLogOptions.Timestamps).
+    # PREVIOUS terminated container instance (PodLogOptions.Previous),
+    # server-side RFC3339 line timestamps (PodLogOptions.Timestamps),
+    # and an absolute RFC3339 lower bound (PodLogOptions.SinceTime).
     previous: bool = False
     timestamps: bool = False
+    since_time: str | None = None
 
 
 def match_label_selector(labels: dict[str, str], selector: str) -> bool:

@@ -138,6 +138,7 @@ class FanoutRunner:
             container=job.container,
             previous=self.log_opts.previous,
             timestamps=self.log_opts.timestamps,
+            since_time=self.log_opts.since_time,
         )
         sink = self.sink_factory(job)
         attempt = 0
@@ -223,8 +224,10 @@ class FanoutRunner:
                     follow=True,
                     container=job.container,
                     # previous never reaches here (previous+follow is
-                    # rejected at option build), but timestamps must
-                    # survive a reconnect.
+                    # rejected at option build) and since_time is
+                    # deliberately dropped (the reconnect's gap-covering
+                    # since_seconds is strictly tighter); timestamps
+                    # must survive a reconnect.
                     timestamps=self.log_opts.timestamps,
                 )
         finally:

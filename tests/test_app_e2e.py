@@ -409,3 +409,32 @@ class TestContainerFilter:
         # db has no matching container: it must not inflate the plan.
         assert "Found 1 Pod(s) 1 Container(s)" in out
         assert "db" not in out.split("Acquiring")[0].split("Found")[1]
+
+    def test_since_time_reaches_streams_through_fanout(self, tmp_path,
+                                                       capsys):
+        # Regression: the per-job LogOptions rebuild in fanout._worker
+        # once dropped since_time — this drives the REAL app path.
+        from datetime import datetime, timezone
+
+        out_dir = str(tmp_path / "logs")
+        fc = FakeCluster(clock=lambda: 1_000_000.0)
+        fc.add_pod("default", "web", containers=["nginx"],
+                   lines_per_container=10)
+        cutoff = datetime.fromtimestamp(
+            999_997.0, tz=timezone.utc).isoformat()
+        _, rc = run_app(["-n", "default", "-a", "-p", out_dir,
+                         "--since-time", cutoff], fc)
+        assert rc == 0
+        with open(os.path.join(out_dir, "web__nginx.log"), "rb") as f:
+            lines = f.read().splitlines()
+        assert len(lines) == 4  # ts >= cutoff only
+        assert b"seq=6" in lines[0]
+
+    def test_naive_since_time_rejected(self, tmp_path, capsys):
+        from klogs_tpu.ui.term import FatalError
+
+        with pytest.raises(FatalError):
+            run_app(["-n", "default", "-a", "-p", str(tmp_path / "logs"),
+                     "--since-time", "2026-07-31T06:00:00"],
+                    make_cluster())
+        assert "timezone" in capsys.readouterr().out

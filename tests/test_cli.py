@@ -108,3 +108,16 @@ def test_exclude_container_flag_and_validation(capsys):
     assert parse_args(["-a", "-E", "istio"]).exclude_container == "istio"
     assert main(["-a", "--cluster", "fake", "-E", "["]) == 1
     assert "invalid -E/--exclude-container" in capsys.readouterr().out
+
+
+def test_since_time_validation(capsys):
+    from klogs_tpu.cli import parse_args
+
+    assert parse_args(["-a", "--since-time", "2026-07-31T06:00:00Z"]
+                      ).since_time == "2026-07-31T06:00:00Z"
+    assert main(["-a", "--cluster", "fake",
+                 "--since-time", "not-a-time"]) == 1
+    assert "invalid --since-time" in capsys.readouterr().out
+    assert main(["-a", "--cluster", "fake", "-s", "5m",
+                 "--since-time", "2026-07-31T06:00:00Z"]) == 1
+    assert "at most one of" in capsys.readouterr().out

@@ -269,3 +269,67 @@ class TestPreviousAndTimestamps:
         data = run(read_some())
         for ln in data.splitlines():
             assert ln.startswith(b"1970-01-12T13:46:40."), ln
+
+
+class TestSinceTime:
+    def test_since_time_filters_absolute(self):
+        # clock 1e6; 10 lines spaced 1s ending at clock. Cut at the ts
+        # of line index 6 -> lines 6..9 remain (ts >= cutoff).
+        from datetime import datetime, timezone
+
+        fc = FakeCluster(clock=lambda: 1_000_000.0)
+        fc.add_pod("default", "web", containers=["nginx"],
+                   lines_per_container=10)
+        cutoff = datetime.fromtimestamp(999_997.0, tz=timezone.utc)
+        data = run(read_all(run(fc.open_log_stream(
+            "default", "web",
+            LogOptions(container="nginx",
+                       since_time=cutoff.isoformat())))))
+        lines = data.splitlines()
+        assert len(lines) == 4
+        assert b"seq=6" in lines[0]
+
+    def test_since_time_bounds_follow_lines_too(self):
+        # A FUTURE cutoff (only reachable via since_time): generated
+        # follow lines before the cutoff must be withheld, like the
+        # kubelet's reader.
+        from datetime import datetime, timezone
+
+        t = [1_000_000.0]
+        fc = FakeCluster(clock=lambda: t[0])
+        fc.add_pod("default", "web", containers=["nginx"],
+                   lines_per_container=3, follow_interval_s=0.005)
+        cutoff = datetime.fromtimestamp(
+            1_000_005.0, tz=timezone.utc).isoformat()
+
+        async def drive():
+            s = await fc.open_log_stream(
+                "default", "web",
+                LogOptions(container="nginx", follow=True,
+                           since_time=cutoff))
+
+            async def ticker():
+                while True:
+                    await asyncio.sleep(0.01)
+                    t[0] += 2.0
+
+            tick = asyncio.create_task(ticker())
+            seen = []
+            try:
+                async for chunk in s:
+                    seen.append(chunk)
+                    if len(seen) >= 3:
+                        await s.close()
+            finally:
+                tick.cancel()
+            return b"".join(seen)
+
+        data = run(drive())
+        lines = data.splitlines()
+        assert len(lines) >= 3
+        # History (ts < cutoff) excluded; every emitted follow line was
+        # generated at ts >= cutoff, so seq starts at the follow counter
+        # (3), never the history seqs 0-2 re-emitted.
+        assert all(b"pod=web" in ln for ln in lines)
+        assert not any(b"seq=0 " in ln or b"seq=1 " in ln
+                       or b"seq=2 " in ln for ln in lines)

@@ -87,6 +87,8 @@ def make_app():
         if request.query.get("previous") == "true":
             lines = [f"{pod}/{container} prev {i}\n".encode()
                      for i in range(2)]
+        if request.query.get("sinceTime"):
+            lines = [b"since-time-applied\n"]
         if request.query.get("timestamps") == "true":
             lines = [b"2026-07-31T00:00:00.000000000Z " + ln
                      for ln in lines]
@@ -201,6 +203,16 @@ def test_log_stream_with_options(tmp_path):
             data += chunk
         await s.close()
         assert data == b"2026-07-31T00:00:00.000000000Z api-1/srv line 9\n"
+
+        s = await b.open_log_stream(
+            "default", "api-1",
+            LogOptions(container="srv",
+                       since_time="2026-07-31T00:00:00Z"))
+        data = b""
+        async for chunk in s:
+            data += chunk
+        await s.close()
+        assert data == b"since-time-applied\n"
 
     asyncio.run(with_backend(tmp_path, fn))
 
