@@ -246,7 +246,7 @@ def _grouped_kernel_body(g, cls_ref, char_mask_t_ref, follow_t_ref, out_ref,
             base = j * mask_block
             masks = []
             for k in range(mask_block):  # independent: pipelines on MXU
-                c = cls_ref[pl.ds(base + k, 1), :]
+                c = cls_ref[pl.ds(base + k, 1), :].astype(jnp.int32)
                 onehot = (iota_c == c).astype(jnp.int8)
                 masks.append(
                     jnp.dot(char_mask_t_ref[0], onehot,
@@ -266,7 +266,7 @@ def _grouped_kernel_body(g, cls_ref, char_mask_t_ref, follow_t_ref, out_ref,
             iota_c = jax.lax.broadcasted_iota(jnp.int32, (C, H), 0)
 
             def half_step(t, v):
-                c = cls_ref[pl.ds(t, 1), lo : lo + H]
+                c = cls_ref[pl.ds(t, 1), lo : lo + H].astype(jnp.int32)
                 onehot = (iota_c == c).astype(jnp.int8)
                 mask = jnp.dot(char_mask_t_ref[0], onehot,
                                preferred_element_type=jnp.int32)
@@ -345,7 +345,7 @@ def _grouped_kernel_fused(cls_ref, char_mask_all_ref, follow_t_ref, out_ref,
           ).astype(jnp.int8)
 
     def step(t, vs):
-        c = cls_ref[pl.ds(t, 1), :]
+        c = cls_ref[pl.ds(t, 1), :].astype(jnp.int32)
         onehot = (iota_c == c).astype(jnp.int8)  # shared by all groups
         mask_all = jnp.dot(char_mask_all_ref[:], onehot,
                            preferred_element_type=jnp.int32)  # [G*S, TILE]
@@ -476,7 +476,11 @@ def match_cls_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
                        mask_block)
     # Fused per-lane charge: cls block + G state tiles (i8 v + i32
     # reach) + the shared [G*S, TILE] i32 mask block. The T charge
-    # includes the mask_block padding the launcher will add.
+    # includes the mask_block padding the launcher will add. (An int8
+    # cls block would cut its VMEM charge 4x and raise the lane-tile
+    # cap, but Mosaic rejects the per-step dynamic single-row slice on
+    # i8 memrefs — "index in dimension 0 must be a multiple of 8", the
+    # i8 sublane-packing constraint — measured dead end, 2026-07-31.)
     T_cap = -(-cls.shape[1] // mask_block) * mask_block
     TILE_B = _cap_tile(tile_b, B, T_cap, dp.n_states,
                        state_weight=_state_weight(fused, dp, mask_block))

@@ -1,7 +1,12 @@
 """On-device validation: the adopted mask_block=4 default must compile
 and agree with the host-regex oracle at EVERY production width bucket
 (each bucket is a distinct Mosaic compile: T grows, tile shrinks)."""
-import random, time
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 random.seed(7)
 from klogs_tpu.filters.cpu import RegexFilter
 from klogs_tpu.filters.tpu import NFAEngineFilter
