@@ -1,0 +1,15 @@
+"""In-suite slice of tools/fuzz_features.py (the 20k-combo sweeps run
+from the command line; FUZZ.json records them). 150 random combos keep
+the interaction invariants exercised on every CI run."""
+
+import random
+
+from tools.fuzz_features import run_one
+from klogs_tpu.ui import term
+
+
+def test_random_flag_combinations():
+    term.set_colors(False)
+    rng = random.Random(20260731)
+    for trial in range(150):
+        run_one(rng, trial)

@@ -1,0 +1,287 @@
+"""Feature-interaction fuzzer: random flag combinations end-to-end.
+
+The unit/e2e suites cover each feature and `tests/test_chaos_e2e.py`
+covers one hand-picked interaction; this tool drives the REAL app
+orchestration with randomized combinations of the whole batch-mode flag
+surface (--match/--exclude/-I, -c/-E, -o/--format, --tail/--since/
+--since-time, --timestamps, --previous, -i init containers, label
+selection, fault injection) against a randomized FakeCluster, and
+checks EXACT invariants in both directions:
+
+- the run exits 0 (per-stream faults must never kill the run);
+- the file SET equals the planned selection exactly (every selected
+  container's file exists — created up front, reference semantics —
+  and no unselected container leaks one);
+- every file's CONTENT is byte-identical to the oracle: the same
+  deterministic stream re-opened and re-read (the fake's delivery,
+  including tail/since/since-time/timestamps/previous and
+  mid-stream faults, is covered by its own unit suite), framed to
+  lines, filtered through an independent host-regex include/exclude
+  oracle — so silent DROPS of kept lines fail, not just leaks;
+- stdout mode writes no files; every nonempty stdout line is either a
+  known "pod container " prefix (text) or a valid {pod, container,
+  line} object (json).
+
+Run:  python tools/fuzz_features.py --trials 20000 [--seed N]
+Writes one summary line; nonzero exit on any invariant violation.
+"""
+
+import argparse
+import asyncio
+import contextlib
+import io
+import json
+import os
+import random
+import re
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from klogs_tpu import app  # noqa: E402
+from klogs_tpu.cli import parse_args  # noqa: E402
+from klogs_tpu.cluster.backend import StreamError  # noqa: E402
+from klogs_tpu.cluster.fake import FakeCluster, Faults  # noqa: E402
+from klogs_tpu.cluster.types import LogOptions  # noqa: E402
+from klogs_tpu.filters.framer import LineFramer  # noqa: E402
+from klogs_tpu.runtime.fanout import plan_jobs  # noqa: E402
+from klogs_tpu.ui import term  # noqa: E402
+from klogs_tpu.utils.naming import split_log_file_name  # noqa: E402
+
+MATCH_POOL = ["ERROR", "WARN", r"code=\d00", "failed", r"seq=\d*[02468] ",
+              r"latency=\d{1,2}ms", r"^2026", "zzz-never"]
+CONTAINERS = ["srv", "web", "sidecar", "istio-proxy", "worker"]
+
+
+def build_cluster(rng: random.Random) -> FakeCluster:
+    fc = FakeCluster(chunk_size=rng.choice([7, 64, 4096]))
+    n_pods = rng.randint(1, 6)
+    for i in range(n_pods):
+        containers = rng.sample(CONTAINERS, rng.randint(1, 3))
+        init = ["setup"] if rng.random() < 0.3 else []
+        pod = fc.add_pod(
+            "default", f"pod-{i}", containers=containers,
+            init_containers=init,
+            labels={"app": f"app-{i % 2}"},
+            lines_per_container=rng.randint(0, 120),
+        )
+        for c in pod.containers.values():
+            if rng.random() < 0.4:  # a previous terminated instance
+                for k in range(rng.randint(1, 20)):
+                    c.previous_lines.append(
+                        (1_000.0 + k, b"prev ERROR line %d\n" % k))
+            r = rng.random()
+            if r < 0.12:
+                c.faults = Faults(fail_open=True)
+            elif r < 0.22:
+                c.faults = Faults(cut_after_lines=rng.randint(0, 30))
+            elif r < 0.30:
+                c.faults = Faults(error_after_lines=rng.randint(0, 30))
+    return fc
+
+
+def build_argv(rng: random.Random, out_dir: str) -> list[str]:
+    argv = ["-n", "default", "-p", out_dir]
+    if rng.random() < 0.8:
+        argv.append("-a")
+    else:
+        argv += ["-l", f"app=app-{rng.randint(0, 1)}"]
+    match = rng.sample(MATCH_POOL, rng.randint(0, 2))
+    for p in match:
+        argv += ["--match", p]
+    if rng.random() < 0.4:
+        argv += ["--exclude", rng.choice(MATCH_POOL)]
+    if rng.random() < 0.3:
+        argv.append("-I")
+    if rng.random() < 0.4:
+        argv += ["-c", rng.choice(["^s", "w", "srv|worker", "xyz-none"])]
+    if rng.random() < 0.3:
+        argv += ["-E", rng.choice(["istio", "side", "^w"])]
+    if rng.random() < 0.5:
+        argv += ["-t", str(rng.choice([0, 1, 5, 50]))]
+    if rng.random() < 0.2:
+        argv += ["-s", rng.choice(["1h", "24h"])]
+    elif rng.random() < 0.2:
+        argv += ["--since-time", "2000-01-01T00:00:00Z"]
+    if rng.random() < 0.25:
+        argv.append("--timestamps")
+    if rng.random() < 0.15:
+        argv.append("-i")  # include init containers
+    if rng.random() < 0.15:
+        argv.append("--previous")
+    out_mode = rng.choice(["files", "files", "stdout", "both"])
+    argv += ["-o", out_mode]
+    if out_mode != "files" and rng.random() < 0.4:
+        argv += ["--format", "json"]
+    return argv
+
+
+def oracle_keep(line: bytes, match, exclude, ignore_case) -> bool:
+    flags = re.IGNORECASE if ignore_case else 0
+    body = line.rstrip(b"\n")
+    inc = (not match) or any(re.search(p.encode(), body, flags)
+                             for p in match)
+    exc = exclude and any(re.search(p.encode(), body, flags)
+                          for p in exclude)
+    return inc and not exc
+
+
+def expected_jobs(fc: FakeCluster, opts, out_dir: str):
+    """Re-derive the plan exactly as the app does."""
+    pods = asyncio.run(fc.list_pods("default"))
+    if opts.labels:
+        from klogs_tpu.cluster.types import match_label_selector
+
+        sel = []
+        for lab in opts.labels:
+            sel.extend(p for p in pods
+                       if match_label_selector(p.labels, lab))
+        pods = sel
+    else:
+        pods = [p for p in pods if p.ready]
+    cre = re.compile(opts.container) if opts.container else None
+    ere = (re.compile(opts.exclude_container)
+           if opts.exclude_container else None)
+    return plan_jobs(pods, out_dir, opts.init_containers,
+                     container_re=cre, exclude_container_re=ere)
+
+
+def expected_file_bytes(fc: FakeCluster, opts, job) -> bytes:
+    """The delivery oracle: re-open the same deterministic stream, read
+    what it delivers (including mid-stream faults), frame to lines, and
+    filter through the independent regex oracle."""
+    lo = LogOptions(
+        container=job.container,
+        tail_lines=opts.tail if opts.tail != -1 else None,
+        since_seconds=None,
+        follow=False,
+        previous=opts.previous,
+        timestamps=opts.timestamps,
+        since_time=opts.since_time or None,
+    )
+    if opts.since:
+        from klogs_tpu.utils import parse_duration
+
+        lo.since_seconds = int(parse_duration(opts.since))
+
+    async def read():
+        try:
+            s = await fc.open_log_stream("default", job.pod, lo)
+        except StreamError:
+            return b""  # open failure: file stays truncated-empty
+        data = b""
+        try:
+            async for chunk in s:
+                data += chunk
+        except StreamError:
+            pass  # mid-stream error: keep what was delivered
+        finally:
+            await s.close()
+        return data
+
+    delivered = asyncio.run(read())
+    if not opts.match and not opts.exclude:
+        return delivered  # unfiltered path: byte-identical copy
+    framer = LineFramer()
+    lines = framer.feed(delivered)
+    rest = framer.flush()
+    if rest is not None:
+        lines.append(rest)
+    return b"".join(ln for ln in lines
+                    if oracle_keep(ln, opts.match, opts.exclude,
+                                   opts.ignore_case))
+
+
+class _Buf(io.TextIOBase):
+    """Text stdout shim exposing the bytes console sinks write."""
+
+    def __init__(self):
+        self.buffer = io.BytesIO()
+
+    def write(self, s):
+        self.buffer.write(s.encode())
+        return len(s)
+
+    def flush(self):
+        pass
+
+    def isatty(self):
+        return False
+
+
+def run_one(rng: random.Random, trial: int) -> None:
+    fc = build_cluster(rng)
+    with tempfile.TemporaryDirectory() as tmp:
+        out_dir = os.path.join(tmp, "logs")
+        argv = build_argv(rng, out_dir)
+        opts = parse_args(argv)
+        cap = io.StringIO()
+        shim = _Buf()
+        with contextlib.redirect_stdout(shim), \
+                contextlib.redirect_stderr(cap):
+            rc = asyncio.run(app.run_async(opts, backend=fc))
+        assert rc == 0, (trial, argv, "rc", rc, cap.getvalue()[-400:])
+
+        jobs = expected_jobs(fc, opts, out_dir)
+        stdout_bytes = shim.buffer.getvalue()
+
+        if opts.output == "stdout":
+            assert not os.path.exists(out_dir), (trial, argv)
+        else:
+            # Exact file-set equality: every planned container has a
+            # file (created up front, even on open failure), none else.
+            actual = sorted(os.listdir(out_dir)) \
+                if os.path.exists(out_dir) else []
+            expect = sorted(os.path.basename(j.path) for j in jobs)
+            assert actual == expect, (trial, argv, actual, expect)
+            for f in actual:
+                pod, container = split_log_file_name(f)
+                job = next(j for j in jobs if j.pod == pod
+                           and j.container == container)
+                with open(os.path.join(out_dir, f), "rb") as fh:
+                    got = fh.read()
+                want = expected_file_bytes(fc, opts, job)
+                assert got == want, (trial, argv, f,
+                                     got[:120], want[:120])
+
+        if opts.output in ("stdout", "both"):
+            if opts.format == "json":
+                for ln in stdout_bytes.splitlines():
+                    if not ln:
+                        continue
+                    o = json.loads(ln)
+                    assert set(o) == {"pod", "container", "line"}, \
+                        (trial, argv)
+            else:
+                prefixes = tuple(
+                    f"{j.pod} {j.container} ".encode() for j in jobs)
+                for ln in stdout_bytes.splitlines():
+                    if not ln:
+                        continue
+                    assert ln.startswith(prefixes), (trial, argv,
+                                                     ln[:120])
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=200)
+    ap.add_argument("--seed", type=int, default=None)
+    ns = ap.parse_args()
+    seed = ns.seed if ns.seed is not None else int(time.time())
+    rng = random.Random(seed)
+    term.set_colors(False)
+    t0 = time.time()
+    for trial in range(ns.trials):
+        run_one(rng, trial)
+        if trial and trial % 2000 == 0:
+            print(f"  {trial} combos, {time.time()-t0:.0f}s", flush=True)
+    print(f"feature-fuzz OK: {ns.trials} random flag combos, "
+          f"{time.time()-t0:.0f}s, seed={seed}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
