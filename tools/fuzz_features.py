@@ -265,9 +265,93 @@ def run_one(rng: random.Random, trial: int) -> None:
                                                      ln[:120])
 
 
+def run_one_follow(rng: random.Random, trial: int) -> None:
+    """Follow-mode variant: short live runs with reconnecting faults,
+    optional --watch-new discovery of a pod added mid-run, and an
+    explicit stop. Delivery is timing-nondeterministic here, so the
+    content invariant is the SOUNDNESS direction only (every written/
+    streamed line passes the oracle); structure invariants (rc, file
+    set, console purity) stay exact."""
+    fc = build_cluster(rng)
+    with tempfile.TemporaryDirectory() as tmp:
+        out_dir = os.path.join(tmp, "logs")
+        argv = [a for a in build_argv(rng, out_dir)
+                if a not in ("--previous",)]
+        argv.append("-f")
+        watch_new = rng.random() < 0.5
+        if watch_new:
+            argv.append("--watch-new")
+        opts = parse_args(argv)
+        stop = asyncio.Event()
+        cap = io.StringIO()
+        shim = _Buf()
+        os.environ["KLOGS_WATCH_INTERVAL_S"] = "0.2"
+
+        async def drive():
+            async def stopper():
+                await asyncio.sleep(rng.uniform(0.2, 0.6))
+                if watch_new and (opts.all_pods or opts.labels):
+                    fc.add_pod("default", "late-pod",
+                               containers=[rng.choice(CONTAINERS)],
+                               labels={"app": "app-0"},
+                               lines_per_container=5,
+                               follow_interval_s=0.01)
+                    await asyncio.sleep(0.5)
+                stop.set()
+
+            t = asyncio.create_task(stopper())
+            rc = await app.run_async(opts, backend=fc, stop=stop)
+            await t
+            return rc
+
+        try:
+            with contextlib.redirect_stdout(shim), \
+                    contextlib.redirect_stderr(cap):
+                rc = asyncio.run(drive())
+        finally:
+            os.environ.pop("KLOGS_WATCH_INTERVAL_S", None)
+        assert rc == 0, (trial, argv, rc, cap.getvalue()[-400:])
+
+        stdout_bytes = shim.buffer.getvalue()
+        if opts.output == "stdout":
+            assert not os.path.exists(out_dir), (trial, argv)
+        else:
+            actual = sorted(os.listdir(out_dir)) \
+                if os.path.exists(out_dir) else []
+            allowed = {os.path.basename(j.path)
+                       for j in expected_jobs(fc, opts, out_dir)}
+            # Discovery timing decides whether late-pod's file exists;
+            # anything OUTSIDE the final selection is a leak.
+            assert set(actual) <= allowed, (trial, argv, actual, allowed)
+            if opts.match or opts.exclude:
+                for f in actual:
+                    with open(os.path.join(out_dir, f), "rb") as fh:
+                        for ln in fh.read().splitlines(keepends=True):
+                            assert oracle_keep(
+                                ln, opts.match, opts.exclude,
+                                opts.ignore_case), (trial, argv, f,
+                                                    ln[:120])
+        if opts.output in ("stdout", "both"):
+            jobs = expected_jobs(fc, opts, out_dir)
+            if opts.format == "json":
+                for ln in stdout_bytes.splitlines():
+                    if ln:
+                        o = json.loads(ln)
+                        assert set(o) == {"pod", "container", "line"}, \
+                            (trial, argv)
+            else:
+                prefixes = tuple(
+                    f"{j.pod} {j.container} ".encode() for j in jobs)
+                for ln in stdout_bytes.splitlines():
+                    if ln:
+                        assert ln.startswith(prefixes), (trial, argv,
+                                                         ln[:120])
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--trials", type=int, default=200)
+    ap.add_argument("--follow-trials", type=int, default=0)
     ap.add_argument("--seed", type=int, default=None)
     ns = ap.parse_args()
     seed = ns.seed if ns.seed is not None else int(time.time())
@@ -278,8 +362,14 @@ def main() -> int:
         run_one(rng, trial)
         if trial and trial % 2000 == 0:
             print(f"  {trial} combos, {time.time()-t0:.0f}s", flush=True)
-    print(f"feature-fuzz OK: {ns.trials} random flag combos, "
-          f"{time.time()-t0:.0f}s, seed={seed}")
+    for trial in range(ns.follow_trials):
+        run_one_follow(rng, trial)
+        if trial and trial % 100 == 0:
+            print(f"  {trial} follow combos, {time.time()-t0:.0f}s",
+                  flush=True)
+    print(f"feature-fuzz OK: {ns.trials} batch + {ns.follow_trials} "
+          f"follow random flag combos, {time.time()-t0:.0f}s, "
+          f"seed={seed}")
     return 0
 
 
