@@ -34,7 +34,7 @@ import os
 import time
 from concurrent.futures import ThreadPoolExecutor
 
-from klogs_tpu.filters.base import FilterStats, LogFilter
+from klogs_tpu.filters.base import FilterStats, LogFilter, frame_lines
 
 # Each in-flight fetch blocks one worker thread for a full host<->device
 # round trip, so sustained batches/s caps at workers / RTT. On a remote
@@ -82,7 +82,8 @@ class AsyncFilterService:
         )
         self._coalesce_lines = coalesce_lines
         self._coalesce_delay_s = coalesce_delay_s
-        self._pending: list[tuple[list[bytes], asyncio.Future]] = []
+        # (payload, offsets, n_lines, future, enqueue_time) per caller.
+        self._pending: list[tuple] = []
         self._pending_lines = 0
         self._kick_handle: asyncio.TimerHandle | None = None
         self._closed = False
@@ -94,15 +95,36 @@ class AsyncFilterService:
 
     async def match(self, lines: list[bytes]) -> list[bool]:
         """Resolves with one verdict per line. Concurrent calls coalesce
-        into shared device batches."""
-        if self._closed:
-            raise RuntimeError("AsyncFilterService is closed")
+        into shared device batches. Internally the batch is framed
+        immediately (one contiguous payload + offsets, see
+        filters.base.frame_lines) so coalescing and dispatch never touch
+        per-line Python objects again."""
         if not lines:
             return []
+        payload, offsets, _ = frame_lines(lines)
+        arr = await self._enqueue(payload, offsets, len(lines))
+        return arr.tolist()
+
+    async def match_framed(self, payload: bytes, offsets):
+        """Framed-batch entry: offsets is an int32[n+1] prefix-sum
+        array. Resolves with a numpy bool verdict array (a view-slice of
+        the coalesced group's verdicts — zero per-line work)."""
+        n = len(offsets) - 1
+        if n <= 0:  # includes the pathological empty-offsets array
+            import numpy as np
+
+            if n < 0:
+                raise ValueError("framed batch: empty offsets array")
+            return np.zeros(0, dtype=bool)
+        return await self._enqueue(payload, offsets, n)
+
+    async def _enqueue(self, payload: bytes, offsets, n: int):
+        if self._closed:
+            raise RuntimeError("AsyncFilterService is closed")
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
-        self._pending.append((lines, fut, time.perf_counter()))
-        self._pending_lines += len(lines)
+        self._pending.append((payload, offsets, n, fut, time.perf_counter()))
+        self._pending_lines += n
         if self._pending_lines >= self._coalesce_lines:
             self._kick(loop)
         elif self._kick_handle is None:
@@ -124,35 +146,48 @@ class AsyncFilterService:
         task.add_done_callback(self._tasks.discard)
 
     async def _run_group(self, group) -> None:
+        import numpy as np
+
         loop = asyncio.get_running_loop()
-        all_lines: list[bytes] = []
-        for lines, _, _ in group:
-            all_lines.extend(lines)
+        if len(group) == 1:
+            payload, offsets = group[0][0], group[0][1]
+        else:
+            # Concatenate framed batches: payloads join; each offsets
+            # array shifts by the cumulative payload base. All
+            # vectorized over the (few) group members, never per line.
+            payload = b"".join(e[0] for e in group)
+            parts = []
+            base = 0
+            for e in group:
+                parts.append(e[1][:-1] + base)
+                base += len(e[0])
+            parts.append(np.asarray([base], dtype=np.int32))
+            offsets = np.concatenate(parts)
         try:
             async with self._sem:
                 t_dispatch = time.perf_counter()
                 if self._stats is not None:
                     self._stats.mark_batch_started(t_dispatch)
-                    for _, _, enq in group:
+                    for *_, enq in group:
                         self._stats.record_queue_wait(t_dispatch - enq)
-                handle = self._filter.dispatch(all_lines)
+                handle = self._filter.dispatch_framed(payload, offsets)
                 self.batches_dispatched += 1
                 verdicts = await loop.run_in_executor(
-                    self._pool, self._filter.fetch, handle
+                    self._pool, self._filter.fetch_framed, handle
                 )
                 if self._stats is not None:
                     self._stats.record_device_batch(
                         time.perf_counter() - t_dispatch)
         except Exception as e:
-            for _, fut, _ in group:
+            for _, _, _, fut, _ in group:
                 if not fut.done():
                     fut.set_exception(e)
             return
         off = 0
-        for lines, fut, _ in group:
+        for _, _, n, fut, _ in group:
             if not fut.done():
-                fut.set_result(verdicts[off : off + len(lines)])
-            off += len(lines)
+                fut.set_result(verdicts[off : off + n])
+            off += n
 
     async def aclose(self) -> None:
         """Graceful shutdown: dispatch any coalescing (un-kicked) lines,

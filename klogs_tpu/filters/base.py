@@ -148,6 +148,43 @@ class FilterStats:
         return 100.0 * self.lines_matched / self.lines_in if self.lines_in else 0.0
 
 
+def frame_lines(lines: list[bytes], strip_nl: bool = True):
+    """list[bytes] -> (payload, offsets: int32[n+1], raw_total) — the
+    framed-batch builder (one contiguous buffer + prefix sums instead of
+    n PyBytes). Trailing-newline runs are stripped when strip_nl, the
+    engine's rstrip(b"\\n") parity rule; raw_total is the UNstripped
+    byte count (stats bytes-in). Native single-pass when built."""
+    import numpy as np
+
+    from klogs_tpu.native import hostops
+
+    if hostops is not None and hasattr(hostops, "frame_lines"):
+        payload, offs, raw = hostops.frame_lines(lines, int(strip_nl))
+        return payload, np.frombuffer(offs, dtype=np.int32), raw
+    raw = sum(len(ln) for ln in lines)
+    bodies = [ln.rstrip(b"\n") for ln in lines] if strip_nl else lines
+    offsets = np.zeros(len(lines) + 1, dtype=np.int32)
+    if bodies:
+        offsets[1:] = np.cumsum(
+            np.fromiter((len(b) for b in bodies), np.int32, len(bodies)))
+    return b"".join(bodies), offsets, raw
+
+
+def split_frame(payload: bytes, offsets) -> list[bytes]:
+    """Framed batch -> list[bytes] (line i = payload[offsets[i]:
+    offsets[i+1]]) — the bridge for engines without a framed fast path.
+    ``offsets`` is an int32 numpy array of n+1 exclusive prefix sums."""
+    from klogs_tpu.native import hostops
+
+    n = len(offsets) - 1
+    if hostops is not None and hasattr(hostops, "split_frame"):
+        import numpy as np
+
+        return hostops.split_frame(
+            payload, np.ascontiguousarray(offsets, dtype=np.int32), n)
+    return [payload[offsets[i]:offsets[i + 1]] for i in range(n)]
+
+
 class LogFilter(abc.ABC):
     """K-pattern any-match line filter."""
 
@@ -168,6 +205,25 @@ class LogFilter(abc.ABC):
 
     def fetch(self, handle) -> list[bool]:
         return handle
+
+    # -- framed API ---------------------------------------------------
+    # A "framed batch" is (payload: bytes, offsets: int32[n+1] prefix
+    # sums): one contiguous buffer instead of n PyBytes. It is the
+    # zero-per-line-object representation the service/wire path rides
+    # (per-line msgpack objects measured ~1us/line of pure overhead on
+    # the single-core loopback — SERVICE_BENCH.json round-4 rows).
+    # Engines with a native framed packer override dispatch_framed;
+    # the default bridges through the list path so every filter works.
+    # fetch_framed returns a numpy bool array (callers count/slice it
+    # without materializing per-line Python bools).
+
+    def dispatch_framed(self, payload: bytes, offsets):
+        return self.dispatch(split_frame(payload, offsets))
+
+    def fetch_framed(self, handle):
+        import numpy as np
+
+        return np.asarray(self.fetch(handle), dtype=bool)
 
     def close(self) -> None:
         """Release engine resources (device buffers, transports)."""
@@ -199,6 +255,19 @@ class IncludeExcludeFilter(LogFilter):
             return [not e for e in ex]
         inc = self.include.fetch(hi)
         return [i and not e for i, e in zip(inc, ex)]
+
+    def dispatch_framed(self, payload: bytes, offsets):
+        hi = (self.include.dispatch_framed(payload, offsets)
+              if self.include is not None else None)
+        he = self.exclude.dispatch_framed(payload, offsets)
+        return (hi, he)
+
+    def fetch_framed(self, handle):
+        hi, he = handle
+        ex = self.exclude.fetch_framed(he)
+        if hi is None:
+            return ~ex
+        return self.include.fetch_framed(hi) & ~ex
 
     def close(self) -> None:
         if self.include is not None:

@@ -75,24 +75,46 @@ class FilteredSink(Sink):
         if not pending:
             return
         t0 = time.perf_counter()
-        if self._service is not None:
-            mask = await self._service.match(pending)
-        else:
-            mask = self._filter.match_lines(pending)
-        latency = time.perf_counter() - t0
         from klogs_tpu.native import hostops
 
-        n_kept = sum(mask)
-        if hostops is not None:
-            out = hostops.join_kept(pending, bytes(bytearray(mask)))
+        if self._service is not None and hasattr(self._service,
+                                                 "match_framed"):
+            # Framed flush: one C pass builds (payload, offsets), the
+            # verdicts come back as a numpy array, and the kept-line
+            # join consumes its raw bytes — the only remaining per-line
+            # Python cost in this path is accumulating `pending` itself.
+            import numpy as np
+
+            from klogs_tpu.filters.base import frame_lines
+
+            payload, offsets, bytes_in = frame_lines(pending)
+            mask_arr = await self._service.match_framed(payload, offsets)
+            latency = time.perf_counter() - t0
+            n_kept = int(np.count_nonzero(mask_arr))
+            mask_b = np.ascontiguousarray(mask_arr, dtype=np.uint8).tobytes()
+            if hostops is not None:
+                out = hostops.join_kept(pending, mask_b)
+            else:
+                out = b"".join(
+                    ln for ln, keep in zip(pending, mask_b) if keep)
         else:
-            out = b"".join(ln for ln, keep in zip(pending, mask) if keep)
+            if self._service is not None:
+                mask = await self._service.match(pending)
+            else:
+                mask = self._filter.match_lines(pending)
+            latency = time.perf_counter() - t0
+            n_kept = sum(mask)
+            if hostops is not None:
+                out = hostops.join_kept(pending, bytes(bytearray(mask)))
+            else:
+                out = b"".join(ln for ln, keep in zip(pending, mask) if keep)
+            bytes_in = sum(len(ln) for ln in pending)
         if out:
             await self._inner.write(out)
         self._stats.record_batch(
             n_lines=len(pending),
             n_matched=n_kept,
-            n_bytes_in=sum(len(ln) for ln in pending),
+            n_bytes_in=bytes_in,
             n_bytes_out=len(out),
             latency_s=latency,
         )

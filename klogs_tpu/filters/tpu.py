@@ -267,6 +267,83 @@ class NFAEngineFilter(LogFilter):
     def match_lines(self, lines: list[bytes]) -> list[bool]:
         return self.fetch(self.dispatch(lines))
 
+    def _cls_args(self):
+        """(table, begin, end, pad) for the active host-classify path."""
+        if self._engine is not None:
+            eng = self._engine
+            return (eng.cls_table, eng.begin_class, eng.end_class,
+                    eng.pad_class)
+        dpg = self._dp_grouped
+        return (self._cls_table, dpg.begin_class, dpg.end_class,
+                dpg.pad_class)
+
+    def _use_cls(self) -> bool:
+        if self._engine is not None:
+            return getattr(self._engine, "cls_table", None) is not None
+        return (self._kernel in ("pallas", "interpret")
+                and getattr(self, "_cls_table", None) is not None)
+
+    def dispatch_framed(self, payload: bytes, offsets):
+        """Framed-batch dispatch: no per-line PyBytes on the hot path.
+        Rows are width-bucketed vectorized (numpy over the offsets), each
+        bucket packs straight out of the contiguous payload via the C
+        framed packer, and the cls matrices go to the same device calls
+        as the list path. Long/huge rows (rare) bridge to the chunked /
+        seq-scan paths via slicing."""
+        import numpy as np
+
+        from klogs_tpu.native import hostops
+
+        offsets = np.ascontiguousarray(offsets, dtype=np.int32)
+        n = len(offsets) - 1
+        if n == 0:
+            return (0, [])
+        if self._prog.match_all:
+            return (n, None)
+        if (hostops is None or not hasattr(hostops, "pack_classify_framed")
+                or not self._use_cls()):
+            from klogs_tpu.filters.base import split_frame
+
+            return self.dispatch(split_frame(payload, offsets))
+        lens = np.diff(offsets)
+        parts = []
+        short = lens <= self._chunk_bytes
+        if short.any():
+            # Power-of-two width bucket per row (jit-cache discipline,
+            # same buckets as the list path). Raw lengths may include a
+            # trailing newline the C packer strips — the only effect is
+            # an occasional one-bucket-up pad, never a wrong width.
+            width_of = np.full(n, MIN_BUCKET, dtype=np.int64)
+            w = MIN_BUCKET
+            while w < self._chunk_bytes and bool((short & (lens > w)).any()):
+                w *= 2
+                width_of[lens > w // 2] = w
+            tab, bc, ec, pc = self._cls_args()
+            tab_b = tab.tobytes()
+            for w in np.unique(width_of[short]):
+                sel = np.nonzero(short & (width_of == w))[0].astype(np.int32)
+                buf, _ = hostops.pack_classify_framed(
+                    payload, offsets, n, sel.tobytes(), int(w),
+                    _bucket_batch(len(sel)), tab_b, bc, ec, pc)
+                cls = np.frombuffer(buf, dtype=np.int8).reshape(
+                    -1, int(w) + 3)
+                parts.append((sel, *self._match_cls_device(cls)))
+        if not bool(short.all()):
+            rest = np.nonzero(~short)[0]
+            bodies = {int(i): payload[offsets[i]:offsets[i + 1]]
+                      .rstrip(b"\n") for i in rest}
+            long_idx = [i for i in rest if
+                        len(bodies[int(i)]) <= self.SEQ_SCAN_BYTES]
+            huge_idx = [i for i in rest if
+                        len(bodies[int(i)]) > self.SEQ_SCAN_BYTES]
+            if long_idx:
+                parts.append((long_idx, self._match_long(
+                    [bodies[int(i)] for i in long_idx]), None, None))
+            if huge_idx:
+                parts.append((huge_idx, self._match_huge(
+                    [bodies[int(i)] for i in huge_idx]), None, None))
+        return (n, parts)
+
     def dispatch(self, lines: list[bytes]):
         """Enqueue device work for a batch WITHOUT blocking on results
         (jax dispatch is asynchronous). Returns a handle for fetch()."""
@@ -314,6 +391,12 @@ class NFAEngineFilter(LogFilter):
         return (len(lines), parts)
 
     def fetch(self, handle) -> list[bool]:
+        return self._fetch_array(handle).tolist()
+
+    def fetch_framed(self, handle) -> np.ndarray:
+        return self._fetch_array(handle)
+
+    def _fetch_array(self, handle) -> np.ndarray:
         """Block until the dispatched batch's verdicts are on host.
 
         An asynchronously-failing device batch (e.g. OOM at execution)
@@ -322,7 +405,7 @@ class NFAEngineFilter(LogFilter):
         the plain kernel instead of killing the streaming run."""
         n, parts = handle
         if parts is None:
-            return [True] * n
+            return np.ones(n, dtype=bool)
         out = np.zeros(n, dtype=bool)
         for idxs, mask, retry, pf in parts:
             try:
@@ -342,17 +425,22 @@ class NFAEngineFilter(LogFilter):
                 n_cand, n_live, n_tiles = (int(np.asarray(x)) for x in pf)
                 self._stats.record_prefilter(
                     len(idxs), min(n_cand, len(idxs)), n_tiles, n_live)
-        return out.tolist()
+        return out
 
     def _match_cls_dispatch(self, bodies: list[bytes], width: int):
         """Hot path: host-side fused pack+classify, device kernel on
         class ids (no classify gather on device). Returns
         (device_mask, retry_closure_or_None, pf_stats_or_None)."""
+        tab, bc, ec, pc = self._cls_args()
+        cls = pack_classify(bodies, width, tab, bc, ec, pc)
+        return self._match_cls_device(cls)
+
+    def _match_cls_device(self, cls: np.ndarray):
+        """Device half of the cls hot path — shared by the list and
+        framed packers. Returns (device_mask, retry_or_None,
+        pf_stats_or_None)."""
         if self._engine is not None:
             eng = self._engine
-            cls = pack_classify(bodies, width, eng.cls_table,
-                                eng.begin_class, eng.end_class,
-                                eng.pad_class)
             retry = None
             if getattr(eng, "gated", False):
                 # Degrade path for an opt-in gated kernel that fails
@@ -379,27 +467,43 @@ class NFAEngineFilter(LogFilter):
                     "falling back to plain NFA", str(e)[:120])
                 return retry(), None, None
         dpg = self._dp_grouped
-        cls = pack_classify(bodies, width, self._cls_table,
-                            dpg.begin_class, dpg.end_class, dpg.pad_class)
         interpret = self._kernel == "interpret"
         kw, chain_defaulted = self._chain_kwargs(interpret)
-        def plain_retry(record: bool = True):
-            # Rerun without prefilter gating, and without the chain
-            # restructure ONLY if the chain was a default — an
-            # env-forced variant is kept even here (the operator asked
-            # to measure exactly that kernel; if it is the async fault
-            # the rerun fails again and raises loudly, same policy as
-            # the sync path). Bookkeeping rides inside so the generic
-            # fetch-time retry path needs no per-cause branching.
-            if record:
-                if self._pf_tables is not None:
-                    self._pf_tables = None
-                if chain_defaulted:
-                    self._chain_fallback = True
-            rerun_kw = dict(kw, mask_block=1) if chain_defaulted else kw
+
+        def run_plain(run_kw):
             return self._pallas.match_cls_grouped_pallas(
                 dpg, self._g_live, self._g_acc, cls,
-                interpret=interpret, **rerun_kw)
+                interpret=interpret, **run_kw)
+
+        def chain_retry(record: bool = True):
+            # Rerun without the chain restructure ONLY if the chain was
+            # a default — an env-forced variant is kept even here (the
+            # operator asked to measure exactly that kernel; if it is
+            # the async fault the rerun fails again and raises loudly).
+            if record and chain_defaulted:
+                self._chain_fallback = True
+            return run_plain(dict(kw, mask_block=1) if chain_defaulted
+                             else kw)
+
+        def pf_retry(record: bool = True):
+            # Fetch-time failure of the PREFILTERED kernel: degrade one
+            # cause at a time (ADVICE r4) — drop gating but KEEP the
+            # defaulted chain variant (its +13% win is independent of
+            # the prefilter); only degrade the chain if the plain rerun
+            # also fails. np.asarray forces the rerun synchronous so a
+            # second async fault surfaces here, not at the caller.
+            self._pf_tables = None
+            try:
+                return np.asarray(run_plain(kw))
+            except Exception as e:
+                if not chain_defaulted:
+                    raise
+                from klogs_tpu.ui import term
+
+                term.warning(
+                    "plain chain rerun also failed (%s); degrading to "
+                    "mask_block=1", str(e)[:120])
+                return chain_retry()
 
         if self._pf_tables is not None and len(self._pf_tables) == 4:
             want_stats = self._stats is not None
@@ -410,7 +514,7 @@ class NFAEngineFilter(LogFilter):
                     prefilter_tables=self._pf_tables,
                     return_stats=want_stats, **kw)
                 mask, pf = res if want_stats else (res, None)
-                return mask, plain_retry, pf
+                return mask, pf_retry, pf
             except Exception as e:
                 # Gated-kernel compile trouble (Mosaic) must degrade to
                 # the plain NFA, not kill the streaming run.
@@ -421,9 +525,7 @@ class NFAEngineFilter(LogFilter):
                     "falling back to plain NFA", str(e)[:120])
                 self._pf_tables = None
         try:
-            mask = self._pallas.match_cls_grouped_pallas(
-                dpg, self._g_live, self._g_acc, cls,
-                interpret=interpret, **kw)
+            mask = run_plain(kw)
         except Exception as e:
             if not chain_defaulted:
                 raise
@@ -433,10 +535,10 @@ class NFAEngineFilter(LogFilter):
                 "default mask_block=%d chain failed on this backend (%s); "
                 "continuing on the plain chain",
                 kw.get("mask_block"), str(e)[:120])
-            return plain_retry(), None, None
+            return chain_retry(), None, None
         # A defaulted chain variant can also fail ASYNCHRONOUSLY (device
         # execution surfaces at fetch); hand fetch() the same retry.
-        return mask, (plain_retry if chain_defaulted else None), None
+        return mask, (chain_retry if chain_defaulted else None), None
 
     def _chain_kwargs(self, interpret: bool):
         """(kernel kwargs, chain_defaulted): tune.chain_selection plus

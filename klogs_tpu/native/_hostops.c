@@ -423,6 +423,278 @@ classify_chunk_c(PyObject *self, PyObject *args)
     return buf;
 }
 
+/* frame_lines(lines: list[bytes], strip_nl) -> (payload, offsets, raw_total)
+ *
+ * Contiguous "framed batch" builder: payload = concatenation of the
+ * lines (trailing '\n' runs stripped when strip_nl, matching the
+ * engine's rstrip(b"\n") parity rule), offsets = int32[n+1] exclusive
+ * prefix sums, raw_total = sum of UNstripped lengths (the stats
+ * bytes-in figure). One C pass; this is the collector-side cost of the
+ * framed wire/service path, replacing per-line msgpack objects. */
+static PyObject *
+frame_lines(PyObject *self, PyObject *args)
+{
+    PyObject *list;
+    int strip_nl;
+    if (!PyArg_ParseTuple(args, "O!i", &PyList_Type, &list, &strip_nl))
+        return NULL;
+    Py_ssize_t n = PyList_GET_SIZE(list);
+    Py_ssize_t total = 0, raw = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *item = PyList_GET_ITEM(list, i);
+        char *p;
+        Py_ssize_t len;
+        if (PyBytes_AsStringAndSize(item, &p, &len) < 0)
+            return NULL;
+        raw += len;
+        if (strip_nl)
+            while (len > 0 && p[len - 1] == '\n')
+                len--;
+        total += len;
+    }
+    if (total > INT32_MAX) {
+        PyErr_SetString(PyExc_OverflowError,
+                        "framed batch exceeds int32 offsets");
+        return NULL;
+    }
+    PyObject *payload = PyBytes_FromStringAndSize(NULL, total);
+    PyObject *offs = PyBytes_FromStringAndSize(NULL, (n + 1) * 4);
+    if (!payload || !offs) {
+        Py_XDECREF(payload);
+        Py_XDECREF(offs);
+        return NULL;
+    }
+    char *out = PyBytes_AS_STRING(payload);
+    int32_t *ov = (int32_t *)PyBytes_AS_STRING(offs);
+    Py_ssize_t pos = 0;
+    ov[0] = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *item = PyList_GET_ITEM(list, i);
+        char *p = PyBytes_AS_STRING(item);
+        Py_ssize_t len = PyBytes_GET_SIZE(item);
+        if (strip_nl)
+            while (len > 0 && p[len - 1] == '\n')
+                len--;
+        memcpy(out + pos, p, len);
+        pos += len;
+        ov[i + 1] = (int32_t)pos;
+    }
+    return Py_BuildValue("(NNn)", payload, offs, raw);
+}
+
+/* split_frame(payload, offsets, n) -> list[bytes]
+ * Inverse of frame_lines (fallback bridge for engines without a framed
+ * fast path): one PyBytes per span. */
+static PyObject *
+split_frame(PyObject *self, PyObject *args)
+{
+    Py_buffer payload, offs;
+    Py_ssize_t n;
+    if (!PyArg_ParseTuple(args, "y*y*n", &payload, &offs, &n))
+        return NULL;
+    if (n < 0 || offs.len < (n + 1) * 4) {
+        PyBuffer_Release(&payload);
+        PyBuffer_Release(&offs);
+        PyErr_SetString(PyExc_ValueError, "split_frame: bad offsets size");
+        return NULL;
+    }
+    const int32_t *ov = (const int32_t *)offs.buf;
+    const char *src = (const char *)payload.buf;
+    PyObject *list = PyList_New(n);
+    if (!list)
+        goto fail;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        int32_t lo = ov[i], hi = ov[i + 1];
+        if (lo < 0 || hi < lo || hi > payload.len) {
+            Py_DECREF(list);
+            list = NULL;
+            PyErr_SetString(PyExc_ValueError,
+                            "split_frame: offsets out of range");
+            goto fail;
+        }
+        PyObject *b = PyBytes_FromStringAndSize(src + lo, hi - lo);
+        if (!b) {
+            Py_DECREF(list);
+            list = NULL;
+            goto fail;
+        }
+        PyList_SET_ITEM(list, i, b);
+    }
+fail:
+    PyBuffer_Release(&payload);
+    PyBuffer_Release(&offs);
+    return list;
+}
+
+/* pack_classify_framed(payload, offsets, n, sel, width, rows, table,
+ *                      begin, end, pad) -> (cls bytes, lens bytes)
+ *
+ * Framed-batch variant of pack_classify: line i is
+ * payload[offsets[i]:offsets[i+1]] (trailing '\n' runs stripped,
+ * idempotent with frame_lines' stripping). ``sel`` selects a row
+ * subset as int32 indices (width-bucketing), or None for all n rows in
+ * order. No per-line PyObject is ever created — this is the server-side
+ * hot path of the framed service protocol. Reuses the pair-LUT
+ * classifier and the KLOGS_HOST_THREADS row-parallel worker pool; the
+ * GIL is released for the whole row loop even single-threaded (the
+ * asyncio event loop keeps serving while a jumbo batch packs). */
+static PyObject *
+pack_classify_framed(PyObject *self, PyObject *args)
+{
+    Py_buffer payload, offs, table;
+    PyObject *selobj;
+    Py_ssize_t n, width, rows;
+    int begin_c, end_c, pad_c;
+    if (!PyArg_ParseTuple(args, "y*y*nOnny*iii", &payload, &offs, &n,
+                          &selobj, &width, &rows, &table,
+                          &begin_c, &end_c, &pad_c))
+        return NULL;
+    Py_buffer sel = {0};
+    int have_sel = 0;
+    if (selobj != Py_None) {
+        if (PyObject_GetBuffer(selobj, &sel, PyBUF_SIMPLE) < 0) {
+            PyBuffer_Release(&payload);
+            PyBuffer_Release(&offs);
+            PyBuffer_Release(&table);
+            return NULL;
+        }
+        have_sel = 1;
+        n = sel.len / 4;  /* row count = selected count */
+    }
+    const Py_ssize_t nspans = have_sel ? (offs.len / 4) - 1 : n;
+    if (n < 0 || width <= 0 || table.len < 256
+        || offs.len < (nspans + 1) * 4) {
+        if (have_sel)
+            PyBuffer_Release(&sel);
+        PyBuffer_Release(&payload);
+        PyBuffer_Release(&offs);
+        PyBuffer_Release(&table);
+        PyErr_SetString(PyExc_ValueError,
+                        "pack_classify_framed: bad sizes");
+        return NULL;
+    }
+    if (rows < n)
+        rows = n;
+    const Py_ssize_t T = width + 3;
+    PyObject *buf = PyBytes_FromStringAndSize(NULL, rows * T);
+    PyObject *lens = PyBytes_FromStringAndSize(NULL, rows * 4);
+    const char **ptrs = PyMem_Malloc(rows * sizeof(char *));
+    Py_ssize_t *lenv = PyMem_Malloc(rows * sizeof(Py_ssize_t));
+    if (!buf || !lens || !ptrs || !lenv) {
+        if (have_sel)
+            PyBuffer_Release(&sel);
+        PyBuffer_Release(&payload);
+        PyBuffer_Release(&offs);
+        PyBuffer_Release(&table);
+        Py_XDECREF(buf);
+        Py_XDECREF(lens);
+        PyMem_Free(ptrs);
+        PyMem_Free(lenv);
+        return NULL;
+    }
+    const int32_t *ov = (const int32_t *)offs.buf;
+    const int32_t *sv = have_sel ? (const int32_t *)sel.buf : NULL;
+    const char *src = (const char *)payload.buf;
+    for (Py_ssize_t i = 0; i < rows; i++) {
+        ptrs[i] = NULL;
+        lenv[i] = 0;
+        if (i >= n)
+            continue;
+        Py_ssize_t r = have_sel ? (Py_ssize_t)sv[i] : i;
+        if (r < 0 || r >= nspans)
+            goto bad_span;
+        int32_t lo = ov[r], hi = ov[r + 1];
+        if (lo < 0 || hi < lo || hi > payload.len)
+            goto bad_span;
+        Py_ssize_t len = hi - lo;
+        while (len > 0 && src[lo + len - 1] == '\n')
+            len--;
+        ptrs[i] = src + lo;
+        lenv[i] = len > width ? width : len;
+    }
+
+    {
+        const int8_t *tab = (const int8_t *)table.buf;
+        const uint16_t *ptab = get_pair_tab(tab);
+        pack_job job = {ptrs, lenv, (int8_t *)PyBytes_AS_STRING(buf),
+                        (int32_t *)PyBytes_AS_STRING(lens), T, tab, ptab,
+                        begin_c, end_c, pad_c, 0, rows};
+        int nthreads = host_threads();
+        if (nthreads <= 1 || rows < 4096) {
+            Py_BEGIN_ALLOW_THREADS
+            pack_rows(&job);
+            Py_END_ALLOW_THREADS
+        } else {
+            /* The static pair-LUT cache could be rebuilt by another
+             * thread once the GIL drops; copy it call-locally like
+             * pack_classify's threaded path does. Copy failure just
+             * runs single-threaded with the GIL held (tab/ptab stay
+             * valid then). */
+            int8_t *tab_copy = PyMem_Malloc(256);
+            uint16_t *ptab_copy = PyMem_Malloc(65536 * sizeof(uint16_t));
+            if (!tab_copy || !ptab_copy) {
+                PyMem_Free(tab_copy);
+                PyMem_Free(ptab_copy);
+                pack_rows(&job);
+            } else {
+                memcpy(tab_copy, tab, 256);
+                memcpy(ptab_copy, ptab, 65536 * sizeof(uint16_t));
+                job.tab = tab_copy;
+                job.ptab = ptab_copy;
+                pthread_t tids[64];
+                pack_job jobs[64];
+                Py_ssize_t per = (rows + nthreads - 1) / nthreads;
+                int started = 0;
+                Py_BEGIN_ALLOW_THREADS
+                for (int t = 0; t < nthreads; t++) {
+                    jobs[t] = job;
+                    jobs[t].lo = t * per;
+                    jobs[t].hi = (t + 1) * per < rows ? (t + 1) * per : rows;
+                    if (jobs[t].lo >= jobs[t].hi)
+                        break;
+                    if (t == nthreads - 1 || jobs[t].hi == rows) {
+                        pack_rows(&jobs[t]);
+                        break;
+                    }
+                    if (pthread_create(&tids[started], NULL, pack_worker,
+                                       &jobs[t]) != 0) {
+                        pack_rows(&jobs[t]);
+                        continue;
+                    }
+                    started++;
+                }
+                for (int t = 0; t < started; t++)
+                    pthread_join(tids[t], NULL);
+                Py_END_ALLOW_THREADS
+                PyMem_Free(tab_copy);
+                PyMem_Free(ptab_copy);
+            }
+        }
+    }
+    PyMem_Free(ptrs);
+    PyMem_Free(lenv);
+    if (have_sel)
+        PyBuffer_Release(&sel);
+    PyBuffer_Release(&payload);
+    PyBuffer_Release(&offs);
+    PyBuffer_Release(&table);
+    return Py_BuildValue("(NN)", buf, lens);
+
+bad_span:
+    PyMem_Free(ptrs);
+    PyMem_Free(lenv);
+    if (have_sel)
+        PyBuffer_Release(&sel);
+    PyBuffer_Release(&payload);
+    PyBuffer_Release(&offs);
+    PyBuffer_Release(&table);
+    Py_DECREF(buf);
+    Py_DECREF(lens);
+    PyErr_SetString(PyExc_ValueError,
+                    "pack_classify_framed: offsets/sel out of range");
+    return NULL;
+}
+
 static PyObject *
 join_kept(PyObject *self, PyObject *args)
 {
@@ -479,6 +751,14 @@ static PyMethodDef Methods[] = {
      " final) -> int8-cls-bytes"},
     {"join_kept", join_kept, METH_VARARGS,
      "join_kept(lines, mask) -> bytes of mask-selected lines"},
+    {"frame_lines", frame_lines, METH_VARARGS,
+     "frame_lines(lines, strip_nl) -> (payload, int32-offsets-bytes,"
+     " raw_total)"},
+    {"split_frame", split_frame, METH_VARARGS,
+     "split_frame(payload, offsets, n) -> list[bytes]"},
+    {"pack_classify_framed", pack_classify_framed, METH_VARARGS,
+     "pack_classify_framed(payload, offsets, n, sel, width, rows, table,"
+     " begin, end, pad) -> (int8-cls-bytes, int32-lengths-bytes)"},
     {NULL, NULL, 0, NULL},
 };
 

@@ -218,16 +218,38 @@ class FanoutRunner:
                     )
                     return result
                 attempt += 1
+                # Reconnect bound: gap-covering since_seconds (+1s
+                # overlap) by default. A --since-time LATER than that
+                # cutoff (a future or very recent bound) is the
+                # stricter one and must survive the reconnect —
+                # otherwise the reconnected stream can emit lines
+                # before the requested bound (PodLogOptions takes ONE
+                # of SinceSeconds/SinceTime, so pick the stricter;
+                # ADVICE r4). previous never reaches here
+                # (previous+follow is rejected at option build);
+                # timestamps must survive a reconnect.
+                gap_s = max(1, int(time.monotonic() - last_data) + 1)
+                since_time = None
+                if self.log_opts.since_time is not None:
+                    from datetime import datetime, timedelta, timezone
+
+                    try:
+                        bound = datetime.fromisoformat(
+                            self.log_opts.since_time.replace("Z", "+00:00"))
+                        if bound.tzinfo is None:
+                            bound = bound.replace(tzinfo=timezone.utc)
+                        cutoff = (datetime.now(timezone.utc)
+                                  - timedelta(seconds=gap_s))
+                        if bound > cutoff:
+                            since_time = self.log_opts.since_time
+                    except ValueError:
+                        pass  # unparseable bound: gap cutoff (as before)
                 opts = LogOptions(
-                    since_seconds=max(1, int(time.monotonic() - last_data) + 1),
+                    since_seconds=None if since_time else gap_s,
                     tail_lines=None,  # tail would re-dump history after a cut
                     follow=True,
                     container=job.container,
-                    # previous never reaches here (previous+follow is
-                    # rejected at option build) and since_time is
-                    # deliberately dropped (the reconnect's gap-covering
-                    # since_seconds is strictly tighter); timestamps
-                    # must survive a reconnect.
+                    since_time=since_time,
                     timestamps=self.log_opts.timestamps,
                 )
         finally:

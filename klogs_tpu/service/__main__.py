@@ -25,7 +25,9 @@ def main() -> None:
                     help="case-insensitive patterns (collectors must "
                     "connect with matching -I or the pattern handshake "
                     "rejects them)")
-    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--host", default="127.0.0.1",
+                    help='bind address; "unix:/path.sock" serves a Unix '
+                    "domain socket (co-located collector deployments)")
     ap.add_argument("--port", type=int, default=50051)
     ap.add_argument("--tls-cert", help="PEM server certificate (enables TLS)")
     ap.add_argument("--tls-key", help="PEM server private key")

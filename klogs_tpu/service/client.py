@@ -82,7 +82,12 @@ class RemoteFilterClient:
         self._auth_token = auth_token
         self._auth_token_file = auth_token_file
         self._match_rpc = self._channel.unary_unary(transport.MATCH)
+        self._match_framed_rpc = self._channel.unary_unary(
+            transport.MATCH_FRAMED)
         self._hello_rpc = self._channel.unary_unary(transport.HELLO)
+        # None until the first Hello; old servers (no "framed" key)
+        # route match_framed through the legacy per-line Match.
+        self._server_framed: bool | None = None
 
     def _metadata(self):
         token = self._auth_token
@@ -105,10 +110,12 @@ class RemoteFilterClient:
 
     async def hello(self) -> dict:
         try:
-            return transport.unpack(
+            info = transport.unpack(
                 await self._hello_rpc(b"", metadata=self._metadata()))
         except grpc.aio.AioRpcError as e:
             raise self._friendly(e) from e
+        self._server_framed = bool(info.get("framed", False))
+        return info
 
     async def verify_patterns(self, patterns: list[str],
                               ignore_case: bool = False,
@@ -142,6 +149,28 @@ class RemoteFilterClient:
         except grpc.aio.AioRpcError as e:
             raise self._friendly(e) from e
         return transport.decode_match_response(resp)
+
+    async def match_framed(self, payload: bytes, offsets):
+        """Framed-batch match: O(1) per-batch wire cost both ways (see
+        transport.py). Returns a numpy bool array. Falls back to the
+        legacy Match against a server that predates the framed
+        protocol (Hello without "framed")."""
+        if self._server_framed is None:
+            await self.hello()
+        if not self._server_framed:
+            import numpy as np
+
+            from klogs_tpu.filters.base import split_frame
+
+            return np.asarray(
+                await self.match(split_frame(payload, offsets)), dtype=bool)
+        try:
+            resp = await self._match_framed_rpc(
+                transport.encode_framed_request(payload, offsets),
+                metadata=self._metadata())
+        except grpc.aio.AioRpcError as e:
+            raise self._friendly(e) from e
+        return transport.decode_framed_response(resp)
 
     async def aclose(self) -> None:
         """Graceful shutdown: awaited from the pipeline so the channel

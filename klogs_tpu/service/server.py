@@ -125,6 +125,7 @@ class FilterServer:
             "ignore_case": self.ignore_case,
             "backend": self.backend,
             "version": BUILD_VERSION,
+            "framed": True,
         })
 
     async def _match(self, request: bytes, context) -> bytes:
@@ -132,6 +133,23 @@ class FilterServer:
         lines = transport.decode_match_request(request)
         mask = await self._service.match(lines)
         return transport.encode_match_response(mask)
+
+    async def _match_framed(self, request: bytes, context) -> bytes:
+        """Framed hot path: payload+offsets in, raw mask bytes out —
+        no per-line Python object anywhere server-side (the batch goes
+        contiguous buffer -> C pack_classify_framed -> device -> numpy
+        mask)."""
+        await self._check_auth(context)
+        try:
+            payload, offsets = transport.decode_framed_request(request)
+        except (ValueError, KeyError, TypeError) as e:
+            # Malformed framing fails ITS OWN RPC with a clean status —
+            # decode validation guarantees it can never reach the
+            # coalescer shared with other collectors.
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                f"bad framed request: {e}")
+        mask = await self._service.match_framed(payload, offsets)
+        return transport.encode_framed_response(mask)
 
     async def start(self) -> int:
         """Binds and starts serving; returns the bound port (useful when
@@ -141,6 +159,8 @@ class FilterServer:
             {
                 "Hello": grpc.unary_unary_rpc_method_handler(self._hello),
                 "Match": grpc.unary_unary_rpc_method_handler(self._match),
+                "MatchFramed": grpc.unary_unary_rpc_method_handler(
+                    self._match_framed),
             },
         )
         # Jumbo batches (thousands of long lines) exceed gRPC's 4 MB
@@ -151,7 +171,14 @@ class FilterServer:
             ("grpc.max_send_message_length", 256 * 1024 * 1024),
         ])
         self._server.add_generic_rpc_handlers((handler,))
-        addr = f"{self.host}:{self.port}"
+        # A host of the form "unix:/path.sock" binds a Unix domain
+        # socket (grpc-native scheme) — the co-located collector->
+        # filterd deployment on one TPU host skips the TCP stack
+        # entirely; port is meaningless there.
+        if self.host.startswith("unix:"):
+            addr = self.host
+        else:
+            addr = f"{self.host}:{self.port}"
         if self.tls_cert and self.tls_key:
             def read(path, what):
                 try:
@@ -198,8 +225,10 @@ async def serve(patterns: list[str], backend: str, host: str, port: int,
             print("klogs filterd: WARNING bearer auth over plaintext sends "
                   "the token in the clear; add --tls-cert/--tls-key on "
                   "untrusted networks", flush=True)
+    where = (server.host if server.host.startswith("unix:")
+             else f"{server.host}:{bound}")
     print(f"klogs filterd: serving {len(server.patterns)} pattern(s) "
-          f"[{server.backend}] on {server.host}:{bound} ({mode})",
+          f"[{server.backend}] on {where} ({mode})",
           flush=True)
     try:
         await server.wait()

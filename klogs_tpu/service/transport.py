@@ -30,6 +30,7 @@ import msgpack
 SERVICE = "klogs.Filter"
 HELLO = f"/{SERVICE}/Hello"
 MATCH = f"/{SERVICE}/Match"
+MATCH_FRAMED = f"/{SERVICE}/MatchFramed"
 
 
 def pack(obj) -> bytes:
@@ -54,3 +55,62 @@ def encode_match_response(mask: list[bool]) -> bytes:
 
 def decode_match_response(data: bytes) -> list[bool]:
     return [bool(b) for b in unpack(data)["mask"]]
+
+
+# -- framed protocol --------------------------------------------------
+# MatchFramed ships ONE contiguous payload + an int32[n+1] offsets
+# array (three msgpack bin fields — O(1) encode/decode per batch)
+# instead of a per-line bin list, and the response mask comes back as a
+# raw uint8 buffer. The per-line msgpack objects of the legacy Match
+# were the measured transport bottleneck on a shared single core
+# (~1us/line across client+server; SERVICE_BENCH.json round-4 rows vs
+# the 9.8M lines/s in-process engine). Hello advertises
+# {"framed": True}; clients fall back to Match against older servers.
+
+def encode_framed_request(payload: bytes, offsets) -> bytes:
+    import numpy as np
+
+    offs = np.ascontiguousarray(offsets, dtype=np.int32)
+    return pack({"n": len(offs) - 1, "offs": offs.tobytes(),
+                 "data": payload})
+
+
+def decode_framed_request(data: bytes):
+    """-> (payload: bytes, offsets: int32 np.ndarray[n+1]).
+
+    Validates the offsets array fully: the server feeds it into a
+    coalescer SHARED across all connected collectors, so one client's
+    malformed offsets must fail its own RPC here — not poison the
+    group batch (mis-sliced verdicts / exceptions for innocent
+    callers)."""
+    import numpy as np
+
+    doc = unpack(data)
+    n = int(doc["n"])
+    payload = doc["data"]
+    offsets = np.frombuffer(doc["offs"], dtype=np.int32)
+    if n < 0 or len(offsets) != n + 1:
+        raise ValueError(
+            f"framed request: {len(offsets)} offsets for n={n}")
+    if len(offsets) and (
+            int(offsets[0]) != 0
+            or int(offsets[-1]) != len(payload)
+            or bool((np.diff(offsets) < 0).any())):
+        raise ValueError("framed request: offsets must rise from 0 to "
+                         "len(payload) monotonically")
+    return payload, offsets
+
+
+def encode_framed_response(mask) -> bytes:
+    """mask: numpy bool/uint8 array -> raw byte-per-verdict body."""
+    import numpy as np
+
+    return pack({"mask": np.ascontiguousarray(
+        mask, dtype=np.uint8).tobytes()})
+
+
+def decode_framed_response(data: bytes):
+    """-> numpy bool verdict array (no per-line Python objects)."""
+    import numpy as np
+
+    return np.frombuffer(unpack(data)["mask"], dtype=np.uint8).astype(bool)

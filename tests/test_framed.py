@@ -1,0 +1,321 @@
+"""Framed-batch protocol: contiguous payload+offsets end to end.
+
+Covers the representation (frame/split, native vs fallback), the C
+framed packer's parity with the list packer, the engine's framed
+dispatch, the coalescing service's framed entry, the gRPC MatchFramed
+round trip (including the legacy-server fallback and unix sockets),
+and the FilteredSink framed flush.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from klogs_tpu import native
+from klogs_tpu.filters.base import frame_lines, split_frame
+from klogs_tpu.filters.cpu import RegexFilter
+
+PATTERNS = ["ERROR", r"code=50[34]", r"retry \d+/\d+"]
+
+LINES = [
+    b"an ERROR here\n",
+    b"all good\n",
+    b"",
+    b"code=503 retry 1/5\n\n",
+    b"x" * 300 + b" ERROR tail\n",
+    b"\n",
+]
+
+
+def test_frame_lines_native_matches_fallback(monkeypatch):
+    if native.hostops is None:
+        pytest.skip("native extension unavailable")
+    p1, o1, r1 = frame_lines(LINES)
+    monkeypatch.setattr("klogs_tpu.native.hostops", None)
+    p2, o2, r2 = frame_lines(LINES)
+    assert p1 == p2
+    assert o1.tolist() == o2.tolist()
+    assert r1 == r2 == sum(len(ln) for ln in LINES)
+    # Stripping removes ALL trailing newlines (rstrip parity).
+    assert p1.count(b"\n") == 0
+
+
+def test_split_frame_round_trip(monkeypatch):
+    for use_native in ([True, False] if native.hostops else [False]):
+        if not use_native:
+            monkeypatch.setattr("klogs_tpu.native.hostops", None)
+        payload, offsets, _ = frame_lines(LINES)
+        back = split_frame(payload, offsets)
+        assert back == [ln.rstrip(b"\n") for ln in LINES]
+        monkeypatch.undo()
+
+
+def test_frame_lines_unstripped():
+    payload, offsets, raw = frame_lines(LINES, strip_nl=False)
+    assert split_frame(payload, offsets) == LINES
+    assert len(payload) == raw
+
+
+def test_pack_classify_framed_parity():
+    if native.hostops is None:
+        pytest.skip("native extension unavailable")
+    table = (np.arange(256) % 7).astype(np.int8)
+    bodies = [ln.rstrip(b"\n") for ln in LINES]
+    payload, offsets, _ = frame_lines(LINES)
+    a, al = native.hostops.pack_classify(
+        bodies, 64, 8, table.tobytes(), 100, 101, 102)
+    b, bl = native.hostops.pack_classify_framed(
+        payload, np.ascontiguousarray(offsets), len(bodies), None, 64, 8,
+        table.tobytes(), 100, 101, 102)
+    assert a == b and al == bl  # includes overlong truncation at width
+
+
+def test_pack_classify_framed_sel_subset():
+    if native.hostops is None:
+        pytest.skip("native extension unavailable")
+    table = (np.arange(256) % 5).astype(np.int8)
+    bodies = [ln.rstrip(b"\n") for ln in LINES]
+    payload, offsets, _ = frame_lines(LINES)
+    sel = np.array([4, 0, 2], dtype=np.int32)
+    a, al = native.hostops.pack_classify_framed(
+        payload, np.ascontiguousarray(offsets), len(bodies), sel.tobytes(),
+        128, 8, table.tobytes(), 9, 10, 11)
+    b, bl = native.hostops.pack_classify(
+        [bodies[4], bodies[0], bodies[2]], 128, 8, table.tobytes(), 9, 10, 11)
+    assert a == b and al == bl
+
+
+def test_pack_classify_framed_rejects_bad_offsets():
+    if native.hostops is None:
+        pytest.skip("native extension unavailable")
+    table = np.zeros(256, dtype=np.int8)
+    bad = np.array([0, 999], dtype=np.int32)  # beyond payload
+    with pytest.raises(ValueError):
+        native.hostops.pack_classify_framed(
+            b"abc", bad.tobytes(), 1, None, 128, 8, table.tobytes(), 0, 1, 2)
+
+
+@pytest.mark.parametrize("kernel", ["jnp", "interpret"])
+def test_engine_framed_parity(kernel):
+    from klogs_tpu.filters.tpu import NFAEngineFilter
+
+    f = NFAEngineFilter(PATTERNS, kernel=kernel)
+    oracle = RegexFilter(PATTERNS)
+    lines = LINES + [b"y" * 5000 + b" code=504\n",  # long-line chunk path
+                     b"retry 9/9 " + b"z" * 200 + b"\n"]
+    payload, offsets, _ = frame_lines(lines)
+    got = f.fetch_framed(f.dispatch_framed(payload, offsets))
+    assert isinstance(got, np.ndarray)
+    assert got.tolist() == oracle.match_lines(lines)
+    f.close()
+
+
+def test_engine_framed_parity_without_native(monkeypatch):
+    """No native build: framed dispatch bridges through the list path
+    with identical verdicts."""
+    from klogs_tpu.filters.tpu import NFAEngineFilter
+
+    payload, offsets, _ = frame_lines(LINES)
+    monkeypatch.setattr("klogs_tpu.native.hostops", None)
+    f = NFAEngineFilter(PATTERNS, kernel="jnp")
+    got = f.fetch_framed(f.dispatch_framed(payload, offsets))
+    assert got.tolist() == RegexFilter(PATTERNS).match_lines(LINES)
+    f.close()
+
+
+def test_include_exclude_framed():
+    from klogs_tpu.filters.base import build_include_exclude
+
+    filt = build_include_exclude(
+        lambda pats: RegexFilter(pats), ["ERROR"], ["tail"])
+    payload, offsets, _ = frame_lines(LINES)
+    got = filt.fetch_framed(filt.dispatch_framed(payload, offsets))
+    want = [("ERROR" in ln.decode("latin1"))
+            and ("tail" not in ln.decode("latin1")) for ln in LINES]
+    assert got.tolist() == want
+
+
+def test_async_service_framed_coalesces():
+    from klogs_tpu.filters.async_service import AsyncFilterService
+
+    async def run():
+        svc = AsyncFilterService(RegexFilter(PATTERNS),
+                                 coalesce_lines=10_000,
+                                 coalesce_delay_s=0.01)
+        p1, o1, _ = frame_lines(LINES)
+        p2, o2, _ = frame_lines([b"code=503\n", b"meh\n"])
+        r1, r2, r3 = await asyncio.gather(
+            svc.match_framed(p1, o1),
+            svc.match_framed(p2, o2),
+            svc.match(list(LINES)),  # mixed list/framed callers coalesce
+        )
+        await svc.aclose()
+        return r1, r2, r3, svc.batches_dispatched
+
+    r1, r2, r3, n_batches = asyncio.run(run())
+    oracle = RegexFilter(PATTERNS)
+    assert r1.tolist() == oracle.match_lines(LINES)
+    assert r2.tolist() == [True, False]
+    assert r3 == oracle.match_lines(LINES)
+    assert n_batches == 1  # all three callers in one device batch
+
+
+def test_async_service_framed_empty():
+    from klogs_tpu.filters.async_service import AsyncFilterService
+
+    async def run():
+        svc = AsyncFilterService(RegexFilter(PATTERNS))
+        out = await svc.match_framed(b"", np.zeros(1, dtype=np.int32))
+        await svc.aclose()
+        return out
+
+    assert asyncio.run(run()).tolist() == []
+
+
+@pytest.mark.parametrize("target_kind", ["tcp", "unix"])
+def test_grpc_framed_round_trip(target_kind, tmp_path):
+    pytest.importorskip("grpc")
+    from klogs_tpu.service.client import RemoteFilterClient
+    from klogs_tpu.service.server import FilterServer
+
+    async def run():
+        if target_kind == "unix":
+            addr = f"unix:{tmp_path}/filterd.sock"
+            server = FilterServer(PATTERNS, backend="cpu", host=addr)
+            await server.start()
+            client = RemoteFilterClient(addr)
+        else:
+            server = FilterServer(PATTERNS, backend="cpu", port=0)
+            port = await server.start()
+            client = RemoteFilterClient(f"127.0.0.1:{port}")
+        try:
+            await client.verify_patterns(PATTERNS)
+            payload, offsets, _ = frame_lines(LINES)
+            got = await client.match_framed(payload, offsets)
+            legacy = await client.match(list(LINES))
+        finally:
+            await client.aclose()
+            await server.stop()
+        return got, legacy
+
+    got, legacy = asyncio.run(run())
+    want = RegexFilter(PATTERNS).match_lines(LINES)
+    assert got.tolist() == want
+    assert legacy == want
+
+
+def test_client_falls_back_against_legacy_server():
+    """A server whose Hello lacks "framed" (pre-framed deployments)
+    routes match_framed through the per-line Match RPC."""
+    pytest.importorskip("grpc")
+    from klogs_tpu.service import transport
+    from klogs_tpu.service.client import RemoteFilterClient
+    from klogs_tpu.service.server import FilterServer
+
+    async def run():
+        server = FilterServer(PATTERNS, backend="cpu", port=0)
+        hello = server._hello
+
+        async def legacy_hello(request, context):
+            data = await hello(request, context)
+            doc = transport.unpack(data)
+            doc.pop("framed")
+            return transport.pack(doc)
+
+        server._hello = legacy_hello
+        port = await server.start()
+        client = RemoteFilterClient(f"127.0.0.1:{port}")
+        try:
+            payload, offsets, _ = frame_lines(LINES)
+            got = await client.match_framed(payload, offsets)
+            assert client._server_framed is False
+        finally:
+            await client.aclose()
+            await server.stop()
+        return got
+
+    got = asyncio.run(run())
+    assert got.tolist() == RegexFilter(PATTERNS).match_lines(LINES)
+
+
+def test_filtered_sink_framed_flush():
+    """FilteredSink over an in-process service takes the framed path:
+    verdicts correct, bytes_in counts RAW (unstripped) bytes."""
+    from klogs_tpu.filters.async_service import AsyncFilterService
+    from klogs_tpu.filters.base import FilterStats
+    from klogs_tpu.filters.sink import FilteredSink
+
+    class MemSink:
+        def __init__(self):
+            self.data = b""
+            self.bytes_written = 0
+
+        async def write(self, chunk):
+            self.data += chunk
+            self.bytes_written += len(chunk)
+
+        async def flush(self):
+            pass
+
+        async def close(self):
+            pass
+
+    async def run():
+        stats = FilterStats()
+        svc = AsyncFilterService(RegexFilter(PATTERNS), stats=stats)
+        mem = MemSink()
+        sink = FilteredSink(mem, None, stats, batch_lines=4, service=svc)
+        await sink.write(b"an ERROR here\nall good\ncode=503\nnope\n")
+        await sink.close()
+        await svc.aclose()
+        return mem.data, stats
+
+    data, stats = asyncio.run(run())
+    assert data == b"an ERROR here\ncode=503\n"
+    assert stats.lines_in == 4
+    assert stats.lines_matched == 2
+    assert stats.bytes_in == len(b"an ERROR here\nall good\ncode=503\nnope\n")
+
+
+def test_malformed_framed_request_rejected_cleanly():
+    """Client-controlled offsets hit a coalescer shared across
+    collectors: malformed ones must fail their OWN RPC with
+    INVALID_ARGUMENT, never poison the group (code-review r5)."""
+    grpc = pytest.importorskip("grpc")
+    from klogs_tpu.service import transport
+    from klogs_tpu.service.client import RemoteFilterClient
+    from klogs_tpu.service.server import FilterServer
+
+    bad_offsets = [
+        np.array([0, 5, 3, 7], dtype=np.int32),    # non-monotonic
+        np.array([0, 2], dtype=np.int32),          # end != len(payload)
+        np.array([1, 7], dtype=np.int32),          # start != 0
+        np.array([], dtype=np.int32),              # empty (n = -1)
+    ]
+
+    async def run():
+        server = FilterServer(PATTERNS, backend="cpu", port=0)
+        port = await server.start()
+        client = RemoteFilterClient(f"127.0.0.1:{port}")
+        try:
+            await client.hello()
+            payload = b"ERRORxy"
+            for offs in bad_offsets:
+                req = transport.pack({"n": len(offs) - 1,
+                                      "offs": offs.tobytes(),
+                                      "data": payload})
+                with pytest.raises(grpc.aio.AioRpcError) as ei:
+                    await client._match_framed_rpc(req)
+                assert (ei.value.code()
+                        == grpc.StatusCode.INVALID_ARGUMENT), offs
+            # ...and the server still serves well-formed batches.
+            good = await client.match_framed(
+                payload, np.array([0, 5, 7], dtype=np.int32))
+            return good
+        finally:
+            await client.aclose()
+            await server.stop()
+
+    got = asyncio.run(run())
+    assert got.tolist() == [True, False]

@@ -225,3 +225,60 @@ def test_gap_persists_across_unproductive_reconnect(tmp_path, monkeypatch):
     # Reconnect 3: the unproductive connection added 30s — since must
     # cover all ~40s back to the chunk, not just since the last open.
     assert opened_opts[2].since_seconds == 41, opened_opts[2]
+
+
+@pytest.mark.parametrize("bound_offset_s,expect_kept", [
+    (+3600, True),   # future bound: stricter than the gap cutoff
+    (-3600, False),  # past bound: gap-covering since_seconds is tighter
+])
+def test_since_time_survives_reconnect_when_stricter(
+        tmp_path, bound_offset_s, expect_kept):
+    """ADVICE r4: a --since-time LATER than the reconnect's gap cutoff
+    must ride the reconnect (else the new stream emits lines before the
+    requested bound); a past bound keeps the tighter since_seconds."""
+    from datetime import datetime, timedelta, timezone
+
+    from klogs_tpu.cluster.backend import StreamError
+    from klogs_tpu.runtime.fanout import StreamJob
+
+    bound = (datetime.now(timezone.utc)
+             + timedelta(seconds=bound_offset_s)).isoformat()
+    opened_opts = []
+
+    class DropStream:
+        def __aiter__(self):
+            return self
+
+        async def __anext__(self):
+            raise StopAsyncIteration
+
+        async def close(self):
+            pass
+
+    class Backend:
+        def __init__(self):
+            self.calls = 0
+
+        async def open_log_stream(self, namespace, pod, opts):
+            opened_opts.append(opts)
+            self.calls += 1
+            if self.calls == 1:
+                return DropStream()
+            raise StreamError("done")
+
+        async def close(self):
+            pass
+
+    runner = FanoutRunner(
+        Backend(), "default",
+        LogOptions(follow=True, since_time=bound), max_reconnects=1)
+    job = StreamJob("p", "c0", False, str(tmp_path / "p__c0.log"))
+    run(asyncio.wait_for(runner.run([job], stop=asyncio.Event()), timeout=10))
+    assert len(opened_opts) == 2
+    re_opts = opened_opts[1]
+    if expect_kept:
+        assert re_opts.since_time == bound
+        assert re_opts.since_seconds is None
+    else:
+        assert re_opts.since_time is None
+        assert re_opts.since_seconds is not None
