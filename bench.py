@@ -87,6 +87,23 @@ def cpu_lps(lines, repeats: int) -> float:
     return best
 
 
+def cpu_strong_lps(lines, repeats: int):
+    """(rate, engine_kind) of the STRONG host baseline — the fastest
+    CPU engine this repo can build for the pattern set (native DFA
+    scan; filters/cpu.best_host_filter). The round-4 verdict called
+    the K-sequential-`re` multiple soft; the headline vs_baseline now
+    cites this engine, with the K-sequential figure kept in detail."""
+    from klogs_tpu.filters.cpu import best_host_filter
+
+    filt, kind = best_host_filter(PATTERNS)
+    best = 0.0
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        filt.match_lines(lines)
+        best = max(best, len(lines) / (time.perf_counter() - t0))
+    return best, kind
+
+
 def measure_pipelined(run, n_rows: int, n_flight: int, repeats: int) -> float:
     """Best-of-`repeats` sustained rate of `run()` with `n_flight`
     dispatches in flight: block on the last output only, fetch ONE
@@ -313,24 +330,28 @@ def main() -> None:
 
     lines = make_lines(n_lines)
     cpu = cpu_lps(lines[:n_cpu], repeats)
+    strong, strong_kind = cpu_strong_lps(lines, repeats)
     dev = _device_subprocess(timeout_s)
 
+    base_detail = {
+        "cpu_regex_lps": round(cpu, 1),
+        "cpu_strong_lps": round(strong, 1),
+        "cpu_strong_engine": strong_kind,
+        "baseline": f"strong-cpu ({strong_kind})",
+        "n_patterns": len(PATTERNS),
+        "line_width_bytes": 128,
+    }
     if dev is not None and dev.get("backend") == "cpu":
         # No TPU on this host: the production --backend=cpu path IS the
-        # host regex engine; the tiny jnp run only proves the device
+        # strong host engine; the tiny jnp run only proves the device
         # code path executes. Report the honest production number.
         print(json.dumps({
             "metric": "log-lines/sec filtered, 32 patterns x 256-pod batch (batch-NFA)",
-            "value": round(cpu, 1),
+            "value": round(strong, 1),
             "unit": "lines/sec",
             "vs_baseline": 1.0,
-            "detail": {
-                "cpu_regex_lps": round(cpu, 1),
-                "no_tpu_on_host": True,
-                "jnp_smoke_lps": round(dev["pipelined"], 1),
-                "n_patterns": len(PATTERNS),
-                "line_width_bytes": 128,
-            },
+            "detail": dict(base_detail, no_tpu_on_host=True,
+                           jnp_smoke_lps=round(dev["pipelined"], 1)),
         }))
     elif dev is not None:
         pipelined, e2e = dev["pipelined"], dev["e2e"]
@@ -338,30 +359,26 @@ def main() -> None:
             "metric": "log-lines/sec filtered, 32 patterns x 256-pod batch (batch-NFA)",
             "value": round(pipelined, 1),
             "unit": "lines/sec",
-            "vs_baseline": round(pipelined / cpu, 3) if cpu else None,
-            "detail": {
-                "cpu_regex_lps": round(cpu, 1),
-                "device_pipelined_lps": round(pipelined, 1),
-                "host_pack_classify_lps": round(dev.get("host_prep", 0.0), 1),
-                "e2e_sync_lps": round(e2e, 1),
-                "n_patterns": len(PATTERNS),
-                "line_width_bytes": 128,
-            },
+            # Round-4 verdict: cite the STRONG baseline, not the soft
+            # K-sequential one (kept as vs_cpu_regex in detail).
+            "vs_baseline": round(pipelined / strong, 3) if strong else None,
+            "detail": dict(
+                base_detail,
+                device_pipelined_lps=round(pipelined, 1),
+                host_pack_classify_lps=round(dev.get("host_prep", 0.0), 1),
+                e2e_sync_lps=round(e2e, 1),
+                vs_cpu_regex=round(pipelined / cpu, 3) if cpu else None,
+            ),
         }))
     else:
         # Device attach unavailable/hung: report the CPU baseline so the
         # driver still gets a terminating, honest data point.
         print(json.dumps({
             "metric": "log-lines/sec filtered, 32 patterns x 256-pod batch (batch-NFA)",
-            "value": round(cpu, 1),
+            "value": round(strong, 1),
             "unit": "lines/sec",
             "vs_baseline": None,
-            "detail": {
-                "cpu_regex_lps": round(cpu, 1),
-                "device_unavailable": True,
-                "n_patterns": len(PATTERNS),
-                "line_width_bytes": 128,
-            },
+            "detail": dict(base_detail, device_unavailable=True),
         }))
 
 

@@ -257,13 +257,28 @@ class IncludeExcludeFilter(LogFilter):
         return [i and not e for i, e in zip(inc, ex)]
 
     def dispatch_framed(self, payload: bytes, offsets):
+        # When NEITHER side has a native framed path, split once and
+        # share the list — the per-side default bridge would run
+        # split_frame twice over the same payload (2n allocations on
+        # the flush hot path).
+        def bridged(f):
+            return (f is None
+                    or type(f).dispatch_framed is LogFilter.dispatch_framed)
+
+        if bridged(self.include) and bridged(self.exclude):
+            return ("list", self.dispatch(split_frame(payload, offsets)))
         hi = (self.include.dispatch_framed(payload, offsets)
               if self.include is not None else None)
         he = self.exclude.dispatch_framed(payload, offsets)
-        return (hi, he)
+        return ("framed", (hi, he))
 
     def fetch_framed(self, handle):
-        hi, he = handle
+        import numpy as np
+
+        kind, inner = handle
+        if kind == "list":
+            return np.asarray(self.fetch(inner), dtype=bool)
+        hi, he = inner
         ex = self.exclude.fetch_framed(he)
         if hi is None:
             return ~ex

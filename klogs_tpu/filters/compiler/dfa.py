@@ -1,0 +1,214 @@
+"""Subset-construction DFA over the Glushkov class alphabet — the
+strong-CPU host engine.
+
+The round-4 verdict called the K-sequential-`re` CPU baseline soft: a
+competent CPU opponent would run one combined pass, not K scans. This
+module IS that opponent, built from the same compiler artifacts the TPU
+engine uses: determinize the union Glushkov NFA (step semantics
+identical to ops.nfa._scan_classes: v' = (follow(v) | inject) &
+char_mask[c], accept latched after every step including the BEGIN/END
+sentinel steps) over the compressed class alphabet, then scan bytes
+through a flat transition table — one table lookup per byte, early-exit
+on accept. klogs_tpu.native exposes the C loop (dfa_scan); the numpy
+fallback here is the correctness oracle for it.
+
+Subset construction can blow up exponentially, so ``max_states`` caps
+it; callers fall back to a combined-alternation `re` (filters.cpu) when
+construction overflows or the pattern set uses syntax outside the
+compiler's RE2 subset.
+
+Reference analog: none — the reference matches with Go regexp
+(/root/reference/cmd/root.go:366); this is the "do better" CPU bar the
+TPU multiple is measured against (BASELINE.md row 3).
+"""
+
+from collections import deque
+from dataclasses import dataclass
+
+import numpy as np
+
+from klogs_tpu.filters.compiler.glushkov import NFAProgram
+
+# The 32-pattern north-star set determinizes to 8,544 states; 16k
+# leaves headroom for comparable sets while bounding the table at a
+# few MB (cache-resident scan) and construction at a couple of seconds.
+DEFAULT_MAX_STATES = 16384
+
+
+@dataclass
+class DFATables:
+    """Flat scan tables. ``table`` is [n_dfa, n_classes] uint32 state
+    ids; ``accept`` a uint8 flag per DFA state; ``byte_class`` the
+    int32[256] byte->class map shared with the NFA engine; ``start``
+    the state AFTER consuming the BEGIN sentinel (checked for accept
+    before any byte: patterns like "^" accept there)."""
+
+    table: np.ndarray
+    accept: np.ndarray
+    byte_class: np.ndarray
+    n_classes: int
+    start: int
+    end_class: int
+    match_all: bool
+
+
+def build_dfa(prog: NFAProgram,
+              max_states: int = DEFAULT_MAX_STATES) -> "DFATables | None":
+    """Determinize ``prog``. Returns None when the subset construction
+    exceeds ``max_states`` (caller falls back to `re`)."""
+    S = prog.n_states
+    C = prog.n_classes
+    follow = prog.follow.astype(bool)
+    inject = prog.inject.astype(bool)
+    char_mask = prog.char_mask.astype(bool)  # [C, S]
+    accept = prog.accept.astype(bool)
+
+    ids: dict[bytes, int] = {}
+    members: list[np.ndarray] = []
+    work: deque[int] = deque()
+
+    def intern_key(key: bytes, vec: np.ndarray) -> "int | None":
+        sid = ids.get(key)
+        if sid is None:
+            if len(members) >= max_states:
+                return None
+            sid = len(members)
+            ids[key] = sid
+            members.append(vec)
+            work.append(sid)
+        return sid
+
+    start_vec = np.zeros(S, dtype=bool)
+    intern_key(np.packbits(start_vec).tobytes(), start_vec)
+    rows: list[np.ndarray] = []
+    # Frontier-batched expansion: one bool matmul computes reachability
+    # for a whole batch of pending subset-states, one packbits call
+    # produces every candidate key — the per-transition Python cost is
+    # a single dict lookup (construction is startup/bench-time, but a
+    # 50k-state build at naive per-vector numpy cost would take
+    # minutes).
+    BATCH = 256
+    while work:
+        k = min(len(work), BATCH)
+        sids = [work.popleft() for _ in range(k)]
+        mat = np.stack([members[s] for s in sids])  # [k, S]
+        # int32 accumulation: a uint8 matmul wraps mod 256, and a state
+        # with an exact multiple of 256 active predecessors would
+        # silently vanish from the subset (code-review r5).
+        reach = (mat.astype(np.int32) @ follow.astype(np.int32)) > 0
+        active = reach | inject[None, :]
+        # [k, C, S] candidates; packbits over the state axis gives the
+        # dict keys for all k*C transitions at once.
+        nxt = active[:, None, :] & char_mask[None, :, :]
+        keys = np.packbits(nxt.reshape(k * C, S), axis=1)
+        klen = keys.shape[1]
+        keys_b = keys.tobytes()
+        for i in range(k):
+            row = np.empty(C, dtype=np.int64)
+            for c in range(C):
+                j = i * C + c
+                tid = intern_key(keys_b[j * klen:(j + 1) * klen],
+                                 nxt[i, c])
+                if tid is None:
+                    return None
+                row[c] = tid
+            rows.append(row)
+
+    # u16 ids when they fit: the C scan is latency-bound on the random
+    # table walk, so halving the footprint matters more than width.
+    dt = np.uint16 if len(members) < (1 << 16) else np.uint32
+    table = np.vstack(rows).astype(dt)
+    acc = np.fromiter(((m & accept).any() for m in members),
+                      dtype=np.uint8, count=len(members))
+    start = int(table[0, prog.begin_class])
+    return DFATables(
+        table=np.ascontiguousarray(table),
+        accept=acc,
+        byte_class=np.ascontiguousarray(prog.byte_class, dtype=np.int32),
+        n_classes=C,
+        start=start,
+        end_class=prog.end_class,
+        match_all=bool(prog.match_all),
+    )
+
+
+def _cache_path(patterns, ignore_case: bool, max_states: int) -> str:
+    import hashlib
+    import os
+
+    key = hashlib.sha256(repr(
+        (tuple(patterns), bool(ignore_case), int(max_states),
+         _LAYOUT_VERSION)).encode()).hexdigest()[:20]
+    base = os.environ.get("XDG_CACHE_HOME",
+                          os.path.join(os.path.expanduser("~"), ".cache"))
+    return os.path.join(base, "klogs-tpu", f"dfa-{key}.npz")
+
+
+_LAYOUT_VERSION = 1  # bump when DFATables layout changes
+
+
+def build_dfa_cached(patterns: list[str], ignore_case: bool = False,
+                     max_states: int = DEFAULT_MAX_STATES
+                     ) -> "DFATables | None":
+    """build_dfa with a disk cache (~/.cache/klogs-tpu) keyed by the
+    pattern set: the 32-pattern north-star set determinizes in ~1.6s,
+    which would otherwise be paid at every CLI start. Cache failures
+    (no home, corrupt file, race) silently rebuild."""
+    import os
+
+    import numpy as _np
+
+    from klogs_tpu.filters.compiler.glushkov import compile_patterns
+
+    path = _cache_path(patterns, ignore_case, max_states)
+    try:
+        with _np.load(path) as z:
+            return DFATables(
+                table=z["table"], accept=z["accept"],
+                byte_class=z["byte_class"], n_classes=int(z["n_classes"]),
+                start=int(z["start"]), end_class=int(z["end_class"]),
+                match_all=bool(z["match_all"]))
+    except Exception:
+        pass
+    prog = compile_patterns(patterns, ignore_case=ignore_case)
+    t = build_dfa(prog, max_states)
+    if t is None:
+        return None
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "wb") as f:
+            _np.savez(f, table=t.table, accept=t.accept,
+                      byte_class=t.byte_class, n_classes=t.n_classes,
+                      start=t.start, end_class=t.end_class,
+                      match_all=t.match_all)
+        os.replace(tmp, path)
+    except Exception:
+        pass
+    return t
+
+
+def scan_python(t: DFATables, lines: list[bytes]) -> list[bool]:
+    """Pure-Python reference scan (oracle for the C dfa_scan)."""
+    out = []
+    tab = t.table
+    acc = t.accept
+    bc = t.byte_class
+    for line in lines:
+        body = line.rstrip(b"\n")
+        if t.match_all:
+            out.append(True)
+            continue
+        s = t.start
+        hit = bool(acc[s])
+        if not hit:
+            for b in body:
+                s = int(tab[s, bc[b]])
+                if acc[s]:
+                    hit = True
+                    break
+            else:
+                s = int(tab[s, t.end_class])
+                hit = bool(acc[s])
+        out.append(hit)
+    return out

@@ -1,9 +1,23 @@
-"""Host-regex LogFilter — the CPU baseline.
+"""Host-side CPU LogFilters: the baseline and the strong opponents.
 
-The north-star analog of klogs + Go ``regexp``: every line is tested
-against K compiled patterns with re.search; a line is kept if any
-pattern matches. This is both the default ``--backend=cpu`` engine and
-the correctness oracle / performance baseline for the TPU path.
+Three engines, in ascending strength:
+
+- RegexFilter: K sequential ``re.search`` calls per line — the
+  north-star analog of klogs + Go ``regexp`` (one compiled regexp per
+  pattern, tried in order: /root/reference/cmd/root.go:366 semantics)
+  and the correctness oracle for everything else.
+- CombinedRegexFilter: ONE compiled alternation ``(?:p1)|(?:p2)|...``
+  — a single `re` pass per line.
+- DFAFilter: subset-construction DFA over the compiler's class
+  alphabet (filters/compiler/dfa.py) scanned by the native C loop —
+  one table lookup per byte, early exit on accept. The strongest
+  honest CPU opponent; the TPU multiple in BASELINE.md row 3 is
+  quoted against this (round-4 verdict: the K-sequential baseline was
+  soft).
+
+``best_host_filter`` picks the fastest engine the pattern set admits
+(DFA needs the compiler's RE2 subset and a bounded determinization;
+fallbacks keep full `re` syntax working).
 """
 
 import re
@@ -25,3 +39,116 @@ class RegexFilter(LogFilter):
             body = line.rstrip(b"\n")
             out.append(any(p.search(body) for p in compiled))
         return out
+
+
+class CombinedRegexFilter(LogFilter):
+    """One alternation, one `re` scan per line. Same verdicts as
+    RegexFilter for any-match semantics (group numbering differs, but
+    no captures are consumed)."""
+
+    def __init__(self, patterns: list[str], ignore_case: bool = False):
+        if not patterns:
+            raise ValueError("CombinedRegexFilter needs at least one pattern")
+        flags = re.IGNORECASE if ignore_case else 0
+        joined = b"|".join(b"(?:%s)" % p.encode() for p in patterns)
+        self._compiled = re.compile(joined, flags)
+
+    def match_lines(self, lines: list[bytes]) -> list[bool]:
+        search = self._compiled.search
+        return [search(line.rstrip(b"\n")) is not None for line in lines]
+
+
+class DFAFilter(LogFilter):
+    """Determinized union automaton + native flat-table scan.
+
+    Raises ValueError (or RegexSyntaxError) when the pattern set is
+    outside the compiler subset or the subset construction exceeds
+    ``max_states`` — callers fall back to CombinedRegexFilter."""
+
+    def __init__(self, patterns: list[str], ignore_case: bool = False,
+                 max_states: int | None = None):
+        from klogs_tpu.filters.compiler.dfa import (
+            DEFAULT_MAX_STATES,
+            build_dfa_cached,
+        )
+
+        if not patterns:
+            raise ValueError("DFAFilter needs at least one pattern")
+        t = build_dfa_cached(patterns, ignore_case=ignore_case,
+                             max_states=max_states or DEFAULT_MAX_STATES)
+        if t is None:
+            raise ValueError(
+                f"DFA for {len(patterns)} pattern(s) exceeds "
+                f"{max_states or DEFAULT_MAX_STATES} states")
+        self._t = t
+        self._table_b = t.table.tobytes()
+        self._accept_b = t.accept.tobytes()
+        self._bclass_b = t.byte_class.tobytes()
+
+    def match_lines(self, lines: list[bytes]) -> list[bool]:
+        from klogs_tpu.filters.base import frame_lines
+
+        payload, offsets, _ = frame_lines(lines)
+        return self._scan(payload, offsets).tolist()
+
+    def dispatch_framed(self, payload: bytes, offsets):
+        return self._scan(payload, offsets)
+
+    def fetch_framed(self, handle):
+        return handle
+
+    def _scan(self, payload: bytes, offsets):
+        import numpy as np
+
+        from klogs_tpu.native import hostops
+
+        n = len(offsets) - 1
+        t = self._t
+        if t.match_all:
+            return np.ones(n, dtype=bool)
+        if hostops is not None and hasattr(hostops, "dfa_scan"):
+            mask = hostops.dfa_scan(
+                payload, np.ascontiguousarray(offsets, dtype=np.int32), n,
+                self._table_b, t.n_classes, self._accept_b, self._bclass_b,
+                t.start, t.end_class,
+                1 if t.table.dtype == np.uint32 else 0)
+            return np.frombuffer(mask, dtype=np.uint8).astype(bool)
+        from klogs_tpu.filters.base import split_frame
+        from klogs_tpu.filters.compiler.dfa import scan_python
+
+        return np.asarray(scan_python(t, split_frame(payload, offsets)),
+                          dtype=bool)
+
+
+def best_host_filter(patterns: list[str], ignore_case: bool = False):
+    """Strongest CPU engine this pattern set admits: DFA when the
+    compiler subset + determinization allow it; else one combined
+    alternation; else K-sequential `re` (an alternation of valid `re`
+    patterns is usually valid `re`, but mid-pattern global flags like
+    "(?i)x" poison a combined expression). Returns (filter, kind).
+
+    KLOGS_CPU_ENGINE={auto,dfa,combined,re} forces a specific engine
+    (re = the reference-parity K-sequential baseline)."""
+    import os
+
+    choice = os.environ.get("KLOGS_CPU_ENGINE", "auto")
+    if choice == "re":
+        return RegexFilter(patterns, ignore_case=ignore_case), "re"
+    if choice == "combined":
+        return (CombinedRegexFilter(patterns, ignore_case=ignore_case),
+                "combined-re")
+    try:
+        return DFAFilter(patterns, ignore_case=ignore_case), "dfa"
+    except Exception:
+        if choice == "dfa":
+            raise
+    # A combined alternation RENUMBERS groups, so numbered/named
+    # backreferences would silently bind to the wrong group and drop
+    # lines — those sets stay on the K-sequential engine.
+    if any(re.search(r"\\[1-9]|\(\?P=", p) for p in patterns):
+        return RegexFilter(patterns, ignore_case=ignore_case), "re"
+    try:
+        return (CombinedRegexFilter(patterns, ignore_case=ignore_case),
+                "combined-re")
+    except re.error:
+        return RegexFilter(patterns, ignore_case=ignore_case), "re"

@@ -262,9 +262,11 @@ def _build_filter(patterns: list[str], backend: str, stats,
     """One engine for one pattern set (shared by the include and
     exclude sides so both always get the same backend treatment)."""
     if backend == "cpu":
-        from klogs_tpu.filters.cpu import RegexFilter
+        # Strongest host engine the set admits (native DFA scan ->
+        # combined-re -> K-sequential re); KLOGS_CPU_ENGINE overrides.
+        from klogs_tpu.filters.cpu import best_host_filter
 
-        return RegexFilter(patterns, ignore_case=ignore_case)
+        return best_host_filter(patterns, ignore_case=ignore_case)[0]
     import jax
 
     from klogs_tpu.filters.tpu import NFAEngineFilter

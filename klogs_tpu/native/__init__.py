@@ -2,14 +2,19 @@
 
 The extension is a single C file with no dependencies beyond CPython;
 building it is one cc invocation, done lazily and cached next to the
-source. Environments without a toolchain (or where the build fails for
-any reason) silently fall back to the pure-Python implementations —
-the native layer is a fast path, never a requirement.
+source — or, when the package directory is not writable (installed
+site-packages owned by root, or the single-file klogs.pyz zipapp where
+the "directory" is inside a zip), under ``~/.cache/klogs-tpu`` keyed by
+a hash of the C source, so every build of the artifact gets its own
+cached object. Environments without a toolchain (or where the build
+fails for any reason) silently fall back to the pure-Python
+implementations — the native layer is a fast path, never a requirement.
 
 Set KLOGS_NO_NATIVE=1 to force the fallback (used by tests to cover
 both paths).
 """
 
+import hashlib
 import os
 import subprocess
 import sys
@@ -17,36 +22,104 @@ import sysconfig
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "_hostops.c")
-_SO = os.path.join(_DIR, f"_hostops{sysconfig.get_config_var('EXT_SUFFIX') or '.so'}")
+_EXT = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
 
 hostops = None
 
 
-def _build() -> bool:
+def _read_source() -> "bytes | None":
+    """C source bytes — from the filesystem, or from inside the zipapp
+    via the package loader when there is no real file."""
+    try:
+        with open(_SRC, "rb") as f:
+            return f.read()
+    except OSError:
+        pass
+    try:
+        import importlib.resources
+
+        return (importlib.resources.files(__package__)
+                .joinpath("_hostops.c").read_bytes())
+    except Exception:
+        return None
+
+
+def _cache_path(src: bytes) -> str:
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    base = os.environ.get("XDG_CACHE_HOME",
+                          os.path.join(os.path.expanduser("~"), ".cache"))
+    return os.path.join(base, "klogs-tpu", f"_hostops-{tag}{_EXT}")
+
+
+def _build(c_src: str, so_path: str) -> bool:
+    """Compile to a pid-suffixed temp and os.replace into place: the
+    cache can be shared by many concurrently-starting processes, and a
+    half-written .so observed by another process would silently pin it
+    to the pure-Python fallback for its lifetime."""
     include = sysconfig.get_paths()["include"]
     cc = os.environ.get("CC", "cc")
+    tmp = f"{so_path}.tmp{os.getpid()}"
     cmd = [cc, "-O3", "-shared", "-fPIC", "-pthread", f"-I{include}",
-           _SRC, "-o", _SO]
+           c_src, "-o", tmp]
     try:
         res = subprocess.run(cmd, capture_output=True, timeout=120)
-        return res.returncode == 0
+        if res.returncode != 0:
+            return False
+        os.replace(tmp, so_path)
+        return True
     except (OSError, subprocess.TimeoutExpired):
         return False
+    finally:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+
+
+def _ensure_so() -> "str | None":
+    """Path to an up-to-date compiled extension, building if needed.
+    Preference order: next to the source (repo checkouts — mtime keeps
+    it fresh), else the user cache keyed by source hash (read-only
+    installs and zipapps)."""
+    in_tree = os.path.join(_DIR, f"_hostops{_EXT}")
+    src_exists = os.path.exists(_SRC)
+    if src_exists and os.path.exists(in_tree) and (
+            os.path.getmtime(_SRC) <= os.path.getmtime(in_tree)):
+        return in_tree
+    if src_exists and os.access(_DIR, os.W_OK):
+        return in_tree if _build(_SRC, in_tree) else None
+    # Read-only package (or zipapp): build into the user cache.
+    src = _read_source()
+    if src is None:
+        return None
+    cached = _cache_path(src)
+    if os.path.exists(cached):
+        return cached
+    try:
+        os.makedirs(os.path.dirname(cached), exist_ok=True)
+    except OSError:
+        return None
+    tmp_c = cached[: -len(_EXT)] + ".c"
+    try:
+        with open(tmp_c, "wb") as f:
+            f.write(src)
+    except OSError:
+        return None
+    return cached if _build(tmp_c, cached) else None
 
 
 def _load():
     global hostops
     if os.environ.get("KLOGS_NO_NATIVE"):
         return
-    if not os.path.exists(_SO) or (
-        os.path.exists(_SRC) and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
-    ):
-        if not _build():
-            return
+    so = _ensure_so()
+    if so is None:
+        return
     try:
         import importlib.util
 
-        spec = importlib.util.spec_from_file_location("klogs_tpu.native._hostops", _SO)
+        spec = importlib.util.spec_from_file_location(
+            "klogs_tpu.native._hostops", so)
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
         hostops = mod

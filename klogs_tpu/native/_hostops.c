@@ -621,9 +621,28 @@ pack_classify_framed(PyObject *self, PyObject *args)
                         begin_c, end_c, pad_c, 0, rows};
         int nthreads = host_threads();
         if (nthreads <= 1 || rows < 4096) {
-            Py_BEGIN_ALLOW_THREADS
-            pack_rows(&job);
-            Py_END_ALLOW_THREADS
+            /* Even single-threaded this path releases the GIL, so the
+             * static pair-LUT cache could be rebuilt under us by
+             * another Python thread packing with a different
+             * classifier — copy it call-locally (code-review r5); on
+             * alloc failure run with the GIL HELD on the statics. */
+            int8_t *tab_copy = PyMem_Malloc(256);
+            uint16_t *ptab_copy = PyMem_Malloc(65536 * sizeof(uint16_t));
+            if (!tab_copy || !ptab_copy) {
+                PyMem_Free(tab_copy);
+                PyMem_Free(ptab_copy);
+                pack_rows(&job);
+            } else {
+                memcpy(tab_copy, tab, 256);
+                memcpy(ptab_copy, ptab, 65536 * sizeof(uint16_t));
+                job.tab = tab_copy;
+                job.ptab = ptab_copy;
+                Py_BEGIN_ALLOW_THREADS
+                pack_rows(&job);
+                Py_END_ALLOW_THREADS
+                PyMem_Free(tab_copy);
+                PyMem_Free(ptab_copy);
+            }
         } else {
             /* The static pair-LUT cache could be rebuilt by another
              * thread once the GIL drops; copy it call-locally like
@@ -695,6 +714,171 @@ bad_span:
     return NULL;
 }
 
+/* dfa_scan(payload, offsets, n, table, n_classes, accept, byte_class,
+ *          start, end_class) -> mask bytes[n]
+ *
+ * Flat-table DFA scan over a framed batch: one u32 table lookup per
+ * byte, early exit on accept. This is the strong-CPU host engine the
+ * TPU multiple is measured against (filters/compiler/dfa.py builds the
+ * tables; scan_python there is the oracle for this loop). The GIL is
+ * released for the whole scan.
+ *
+ *   table:      u32[n_dfa * n_classes]  (row-major)
+ *   accept:     u8[n_dfa]
+ *   byte_class: i32[256]
+ *   start:      state AFTER the BEGIN sentinel step (checked first)
+ *   end_class:  class fed after the last byte ($ handling)
+ */
+static PyObject *
+dfa_scan(PyObject *self, PyObject *args)
+{
+    Py_buffer payload, offs, table, acc, bclass;
+    Py_ssize_t n;
+    unsigned int start, n_classes, end_class, wide;
+    if (!PyArg_ParseTuple(args, "y*y*ny*Iy*y*III", &payload, &offs, &n,
+                          &table, &n_classes, &acc, &bclass,
+                          &start, &end_class, &wide))
+        return NULL;
+    const Py_ssize_t elem = wide ? 4 : 2;
+    const Py_ssize_t n_dfa = (Py_ssize_t)(acc.len);
+    if (n < 0 || offs.len < (n + 1) * 4 || bclass.len < 256 * 4
+        || n_classes == 0 || end_class >= n_classes || start >= n_dfa
+        || table.len < n_dfa * (Py_ssize_t)n_classes * elem) {
+        PyBuffer_Release(&payload);
+        PyBuffer_Release(&offs);
+        PyBuffer_Release(&table);
+        PyBuffer_Release(&acc);
+        PyBuffer_Release(&bclass);
+        PyErr_SetString(PyExc_ValueError, "dfa_scan: bad buffer sizes");
+        return NULL;
+    }
+    PyObject *mask = PyBytes_FromStringAndSize(NULL, n);
+    if (!mask) {
+        PyBuffer_Release(&payload);
+        PyBuffer_Release(&offs);
+        PyBuffer_Release(&table);
+        PyBuffer_Release(&acc);
+        PyBuffer_Release(&bclass);
+        return NULL;
+    }
+    char *out = PyBytes_AS_STRING(mask);
+    const uint8_t *src = (const uint8_t *)payload.buf;
+    const int32_t *ov = (const int32_t *)offs.buf;
+    const uint32_t *tab32 = (const uint32_t *)table.buf;
+    const uint16_t *tab16 = (const uint16_t *)table.buf;
+    const uint8_t *accept = (const uint8_t *)acc.buf;
+    const int32_t *bc = (const int32_t *)bclass.buf;
+    int bad = 0;
+    Py_BEGIN_ALLOW_THREADS
+    /* The scan is bound by the dependent load chain (state -> table ->
+     * state, ~3ns/byte scalar): interleave LANES independent lines so
+     * the chains overlap. The u16 path (every practical pattern set)
+     * takes the interleaved loop; u32 and the remainder fall through
+     * to the scalar loop below. */
+#define DFA_LANES 4
+    Py_ssize_t i0 = 0;
+    if (!wide && n >= DFA_LANES) {
+        for (; i0 + DFA_LANES <= n && !bad; i0 += DFA_LANES) {
+            const uint8_t *p[DFA_LANES], *pe[DFA_LANES];
+            uint32_t s[DFA_LANES];
+            int m[DFA_LANES];
+            unsigned active = 0;
+            for (int l = 0; l < DFA_LANES; l++) {
+                int32_t lo = ov[i0 + l], hi = ov[i0 + l + 1];
+                if (lo < 0 || hi < lo || hi > payload.len) {
+                    bad = 1;
+                    break;
+                }
+                Py_ssize_t len = hi - lo;
+                while (len > 0 && src[lo + len - 1] == '\n')
+                    len--;
+                p[l] = src + lo;
+                pe[l] = p[l] + len;
+                s[l] = start;
+                m[l] = accept[start];
+                if (!m[l] && p[l] < pe[l])
+                    active |= 1u << l;
+            }
+            if (bad)
+                break;
+            while (active) {
+                for (int l = 0; l < DFA_LANES; l++) {
+                    if (!(active & (1u << l)))
+                        continue;
+                    s[l] = tab16[s[l] * n_classes + (uint32_t)bc[*p[l]]];
+                    p[l]++;
+                    if (accept[s[l]]) {
+                        m[l] = 1;
+                        active &= ~(1u << l);
+                    } else if (p[l] == pe[l]) {
+                        active &= ~(1u << l);
+                    }
+                }
+            }
+            for (int l = 0; l < DFA_LANES; l++) {
+                if (!m[l]) {
+                    uint32_t sf = tab16[s[l] * n_classes + end_class];
+                    m[l] = accept[sf];
+                }
+                out[i0 + l] = (char)m[l];
+            }
+        }
+    }
+    for (Py_ssize_t i = i0; i < n && !bad; i++) {
+        int32_t lo = ov[i], hi = ov[i + 1];
+        if (lo < 0 || hi < lo || hi > payload.len) {
+            bad = 1;
+            break;
+        }
+        Py_ssize_t len = hi - lo;
+        while (len > 0 && src[lo + len - 1] == '\n')
+            len--;
+        uint32_t s = start;
+        int m = accept[s];
+        if (!m) {
+            const uint8_t *p = src + lo, *pe = p + len;
+            if (wide) {
+                for (; p < pe; p++) {
+                    s = tab32[s * n_classes + (uint32_t)bc[*p]];
+                    if (accept[s]) {
+                        m = 1;
+                        break;
+                    }
+                }
+                if (!m) {
+                    s = tab32[s * n_classes + end_class];
+                    m = accept[s];
+                }
+            } else {
+                for (; p < pe; p++) {
+                    s = tab16[s * n_classes + (uint32_t)bc[*p]];
+                    if (accept[s]) {
+                        m = 1;
+                        break;
+                    }
+                }
+                if (!m) {
+                    s = tab16[s * n_classes + end_class];
+                    m = accept[s];
+                }
+            }
+        }
+        out[i] = (char)m;
+    }
+    Py_END_ALLOW_THREADS
+    PyBuffer_Release(&payload);
+    PyBuffer_Release(&offs);
+    PyBuffer_Release(&table);
+    PyBuffer_Release(&acc);
+    PyBuffer_Release(&bclass);
+    if (bad) {
+        Py_DECREF(mask);
+        PyErr_SetString(PyExc_ValueError, "dfa_scan: offsets out of range");
+        return NULL;
+    }
+    return mask;
+}
+
 static PyObject *
 join_kept(PyObject *self, PyObject *args)
 {
@@ -759,6 +943,9 @@ static PyMethodDef Methods[] = {
     {"pack_classify_framed", pack_classify_framed, METH_VARARGS,
      "pack_classify_framed(payload, offsets, n, sel, width, rows, table,"
      " begin, end, pad) -> (int8-cls-bytes, int32-lengths-bytes)"},
+    {"dfa_scan", dfa_scan, METH_VARARGS,
+     "dfa_scan(payload, offsets, n, table, n_classes, accept, byte_class,"
+     " start, end_class) -> mask bytes"},
     {NULL, NULL, 0, NULL},
 };
 

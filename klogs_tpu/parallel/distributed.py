@@ -21,6 +21,14 @@ set them manually elsewhere):
   KLOGS_COORDINATOR   host:port of process 0 (else jax defaults apply)
   KLOGS_NUM_PROCESSES total process count
   KLOGS_PROCESS_ID    this process's index
+
+CPU fleets: cross-process collectives ride jax's gloo backend (the
+default `jax_cpu_collectives_implementation`). The platform must be
+pinned (JAX_PLATFORMS=cpu) BEFORE first backend init — an ambient
+accelerator plugin that doesn't support multi-process leaves
+process_count() at 1 after an apparently-successful handshake
+(observed with the axon TPU tunnel plugin; root-caused 2026-07-31).
+Validated live by tests/test_distributed.py's two-controller run.
 """
 
 import os

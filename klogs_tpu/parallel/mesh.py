@@ -94,6 +94,13 @@ class MeshEngine:
             groups.append(groups[-1])
         self.grid = (d, grid[1])
         self.mesh = Mesh(np.asarray(devices).reshape(self.grid), ("data", "pattern"))
+        # Under jax.distributed the mesh spans processes: host numpy
+        # can no longer be handed to jit/device_put directly — every
+        # process holds the SAME full array and materializes only its
+        # addressable shards (make_array_from_callback; the
+        # replicated-input SPMD recipe). Single-process keeps the
+        # zero-copy direct path.
+        self._multiprocess = jax.process_count() > 1
         if impl in ("pallas", "pallas_interpret"):
             self._init_pallas(groups, ignore_case, impl)
             return
@@ -104,7 +111,11 @@ class MeshEngine:
         prog_sharding = jax.tree_util.tree_map(
             lambda _: NamedSharding(self.mesh, P("pattern")), self.dp
         )
-        self.dp = jax.device_put(self.dp, prog_sharding)
+        if self._multiprocess:
+            self.dp = jax.tree_util.tree_map(self._global_leaf, self.dp,
+                                             prog_sharding)
+        else:
+            self.dp = jax.device_put(self.dp, prog_sharding)
         if impl == "gspmd":
             self._fn = jax.jit(
                 nfa.match_batch_grouped,
@@ -219,6 +230,11 @@ class MeshEngine:
                 match_all=any(x.match_all for x in dps),
             ))
         stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *redps)
+        if self._multiprocess:
+            shardings = jax.tree_util.tree_map(
+                lambda _: NamedSharding(self.mesh, P("pattern")), stacked)
+            stacked = jax.tree_util.tree_map(self._global_leaf, stacked,
+                                             shardings)
         self.dp = stacked
         self.match_all = stacked.match_all
         self.cls_table = glob.astype(np.int8) if C <= 127 else None
@@ -328,6 +344,20 @@ class MeshEngine:
     def data_parallelism(self) -> int:
         return self.grid[0]
 
+    def _global_leaf(self, arr, sharding):
+        """Full host array -> global jax.Array under a multi-process
+        mesh (this process materializes its addressable shards)."""
+        arr = np.asarray(arr)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx])
+
+    def _place_data(self, arr: np.ndarray, spec):
+        """Batch-input placement: direct (jit shards it) in one
+        process, global-Array construction across processes."""
+        if not self._multiprocess:
+            return arr
+        return self._global_leaf(arr, NamedSharding(self.mesh, spec))
+
     def match_batch(self, batch: np.ndarray, lengths: np.ndarray):
         """[B, L] u8 + [B] i32 -> [>=B] bool mask, returned as a DEVICE
         array (padded rows at the tail; callers slice after np.asarray —
@@ -355,7 +385,8 @@ class MeshEngine:
             lengths = np.concatenate(
                 [lengths, np.zeros((Bp - B,), dtype=lengths.dtype)]
             )
-        return self._fn(self.dp, batch, lengths)
+        return self._fn(self.dp, self._place_data(batch, P("data", None)),
+                        self._place_data(lengths, P("data")))
 
     def match_cls(self, cls: np.ndarray, plain: bool = False):
         """Hot-path entry for pallas impls: [B, T] int8/int32 class ids
@@ -373,6 +404,7 @@ class MeshEngine:
             )
         use_gated = not plain and self.gated
         fn = self._fn_gated if use_gated else self._fn
+        cls = self._place_data(cls, P("data", None))
         try:
             return fn(self.dp, cls)
         except Exception as e:

@@ -41,9 +41,9 @@ def _make_filter(patterns: list[str], backend: str,
 
     def one(pats):
         if backend == "cpu":
-            from klogs_tpu.filters.cpu import RegexFilter
+            from klogs_tpu.filters.cpu import best_host_filter
 
-            return RegexFilter(pats, ignore_case=ignore_case)
+            return best_host_filter(pats, ignore_case=ignore_case)[0]
         from klogs_tpu.filters.tpu import NFAEngineFilter
 
         return NFAEngineFilter(pats, ignore_case=ignore_case)

@@ -1,0 +1,70 @@
+"""Two-controller MeshEngine worker — spawned by
+tests/test_distributed.py::test_live_two_process_mesh_match.
+
+Each process: pins the CPU platform with 2 virtual local devices,
+brings up jax.distributed through the production env plumbing
+(parallel/distributed.initialize), builds the SAME MeshEngine over the
+4 GLOBAL devices, matches a deterministic batch, reshards the verdict
+mask to fully-replicated, and writes it to KLOGS_DIST_OUT as JSON.
+The parent asserts both processes agree with each other and with the
+host-regex oracle bit for bit.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    impl = sys.argv[1]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from klogs_tpu.parallel import distributed
+
+    distributed.initialize()  # env-driven: KLOGS_COORDINATOR/_NUM/_ID
+    assert jax.process_count() == 2, (
+        f"distributed bring-up failed: process_count={jax.process_count()}")
+    assert jax.device_count() == 4, jax.device_count()
+
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from klogs_tpu.filters.tpu import pack_lines
+    from klogs_tpu.parallel.mesh import MeshEngine
+
+    patterns = ["ERROR", r"code=50[34]", r"retry \d+/\d+", r"^kernel:"]
+    lines = []
+    for i in range(64):
+        lines.append({
+            0: b"all quiet seq=%d" % i,
+            1: b"an ERROR happened seq=%d" % i,
+            2: b"code=503 backoff retry %d/9" % i,
+            3: b"kernel: oops %d" % i,
+            4: b"xx kernel: not anchored %d" % i,
+        }[i % 5])
+
+    eng = MeshEngine(patterns, impl=impl, devices=jax.devices())
+    batch, lengths = pack_lines(lines, 128)
+    mask = eng.match_batch(batch, lengths)
+    # Reshard to fully-replicated so every process holds the whole
+    # verdict vector (the cross-process equivalent of np.asarray).
+    rep = jax.jit(
+        lambda x: x,
+        out_shardings=NamedSharding(eng.mesh, P()))(mask)
+    full = np.asarray(rep.addressable_data(0))[: len(lines)]
+
+    with open(os.environ["KLOGS_DIST_OUT"], "w") as f:
+        json.dump({"process_id": int(os.environ["KLOGS_PROCESS_ID"]),
+                   "process_count": jax.process_count(),
+                   "mask": [int(b) for b in full]}, f)
+    print("worker done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

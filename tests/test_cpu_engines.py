@@ -1,0 +1,156 @@
+"""Strong-CPU host engines: DFAFilter (determinized union + native
+scan), CombinedRegexFilter, and the best_host_filter selection ladder.
+
+The DFA is the baseline the TPU multiple is quoted against (round-4
+verdict: the K-sequential `re` baseline was soft), so its parity with
+the `re` oracle gets the same property-fuzz treatment the compiler has.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from klogs_tpu.filters.cpu import (
+    CombinedRegexFilter,
+    DFAFilter,
+    RegexFilter,
+    best_host_filter,
+)
+from tests.test_compiler import _rand_line, _rand_pattern
+
+PATTERNS = ["ERROR", r"code=50[34]", r"retry \d+/\d+", r"^kernel:",
+            r"disk .*full$", r"\bOOM\b"]
+
+LINES = [
+    b"an ERROR here\n",
+    b"all good",
+    b"",
+    b"code=503 retry 1/5\n",
+    b"kernel: panic\n",
+    b"xx kernel: not anchored\n",
+    b"disk almost full\n",
+    b"disk full and more\n",
+    b"OOM killer\n",
+    b"xOOMy\n",
+    b"\n",
+]
+
+
+def test_dfa_matches_oracle_hand_cases():
+    oracle = RegexFilter(PATTERNS)
+    assert DFAFilter(PATTERNS).match_lines(LINES) == oracle.match_lines(LINES)
+
+
+def test_combined_matches_oracle_hand_cases():
+    oracle = RegexFilter(PATTERNS)
+    assert (CombinedRegexFilter(PATTERNS).match_lines(LINES)
+            == oracle.match_lines(LINES))
+
+
+def test_dfa_ignore_case():
+    oracle = RegexFilter(PATTERNS, ignore_case=True)
+    f = DFAFilter(PATTERNS, ignore_case=True)
+    lines = [ln.upper() for ln in LINES] + LINES
+    assert f.match_lines(lines) == oracle.match_lines(lines)
+
+
+def test_dfa_python_scan_matches_native(monkeypatch):
+    from klogs_tpu import native
+
+    if native.hostops is None:
+        pytest.skip("native extension unavailable")
+    f = DFAFilter(PATTERNS)
+    with_native = f.match_lines(LINES)
+    monkeypatch.setattr("klogs_tpu.native.hostops", None)
+    assert f.match_lines(LINES) == with_native
+
+
+def test_dfa_framed_dispatch():
+    from klogs_tpu.filters.base import frame_lines
+
+    f = DFAFilter(PATTERNS)
+    payload, offsets, _ = frame_lines(LINES)
+    got = f.fetch_framed(f.dispatch_framed(payload, offsets))
+    assert isinstance(got, np.ndarray)
+    assert got.tolist() == RegexFilter(PATTERNS).match_lines(LINES)
+
+
+def test_dfa_match_all_pattern():
+    f = DFAFilter([""])
+    assert f.match_lines([b"x", b""]) == [True, True]
+
+
+def test_dfa_state_cap_raises():
+    with pytest.raises(ValueError, match="states"):
+        DFAFilter(["a.*b.*c.*d"], max_states=4)
+
+
+def test_dfa_lane_remainder_sizes():
+    """The 4-lane interleaved scan must agree with the oracle at every
+    n mod 4 (the remainder rows take the scalar loop)."""
+    oracle = RegexFilter(PATTERNS)
+    f = DFAFilter(PATTERNS)
+    for n in range(1, 10):
+        lines = (LINES * 2)[:n]
+        assert f.match_lines(lines) == oracle.match_lines(lines), n
+
+
+def test_best_host_filter_ladder(monkeypatch):
+    filt, kind = best_host_filter(PATTERNS)
+    assert kind == "dfa"
+    # Lookaheads are outside the compiler subset -> combined re.
+    filt, kind = best_host_filter([r"foo(?=bar)"])
+    assert kind == "combined-re"
+    assert filt.match_lines([b"foobar", b"foox"]) == [True, False]
+    # Backreferences would be silently mis-bound by the combined
+    # alternation's group renumbering -> K-sequential re.
+    filt, kind = best_host_filter([r"(a)", r"(b)\1"])
+    assert kind == "re"
+    assert filt.match_lines([b"bb", b"x"]) == [True, False]
+    # A leading global flag is valid alone but poisons a combined
+    # alternation ("global flags not at the start" once wrapped), and
+    # the backref keeps it outside the compiler subset -> K-sequential.
+    filt, kind = best_host_filter([r"(?i)(a)\1"])
+    assert kind == "re"
+    assert filt.match_lines([b"AA", b"ab"]) == [True, False]
+    # Env override pins the engine.
+    monkeypatch.setenv("KLOGS_CPU_ENGINE", "re")
+    assert best_host_filter(PATTERNS)[1] == "re"
+    monkeypatch.setenv("KLOGS_CPU_ENGINE", "combined")
+    assert best_host_filter(PATTERNS)[1] == "combined-re"
+    monkeypatch.setenv("KLOGS_CPU_ENGINE", "dfa")
+    with pytest.raises(Exception):
+        best_host_filter([r"(a)\1"])  # forced dfa on unsupported syntax
+
+
+def test_property_dfa_vs_re_oracle():
+    """Random pattern sets x random lines: the DFA agrees with the
+    K-sequential `re` oracle wherever the compiler subset admits the
+    set (mirrors the compiler's own property test)."""
+    rng = random.Random(20260731)
+    checked = 0
+    for _ in range(60):
+        pats = [_rand_pattern(rng) for _ in range(rng.randint(1, 4))]
+        try:
+            f = DFAFilter(pats)
+        except Exception:
+            continue  # unsupported syntax / cap overflow: out of scope
+        oracle = RegexFilter(pats)
+        lines = [_rand_line(rng) for _ in range(40)]
+        assert f.match_lines(lines) == oracle.match_lines(lines), pats
+        checked += 1
+    assert checked >= 20  # the generator mostly emits supported sets
+
+
+def test_cpu_backend_pipeline_uses_strong_engine(tmp_path):
+    """--backend=cpu end to end through the pipeline: same files as the
+    re oracle would produce."""
+    from klogs_tpu.filters.sink import make_pipeline
+
+    pipe = make_pipeline(["ERROR"], "cpu")
+    from klogs_tpu.filters.cpu import DFAFilter as D
+
+    assert isinstance(pipe.log_filter, D)
+    assert pipe.log_filter.match_lines([b"an ERROR\n", b"ok\n"]) == [
+        True, False]

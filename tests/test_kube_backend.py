@@ -686,3 +686,79 @@ def test_in_cluster_ipv6_host(tmp_path, monkeypatch):
     monkeypatch.setenv("KUBECONFIG", str(tmp_path / "nope"))
     creds = load_creds()
     assert creds.server == "https://[fd00::1]:443"
+
+
+def test_follow_reconnect_full_stack_over_real_http(tmp_path):
+    """Round-5 (VERDICT item 6): the WHOLE streaming stack — KubeBackend
+    (real aiohttp client) + FanoutRunner reconnect + FileSink — against
+    a real HTTP apiserver whose follow stream cuts mid-line. The
+    reconnect must arrive with a gap-covering sinceSeconds, the framer
+    must splice the split line, and the file must hold every line
+    exactly once."""
+    import os as _os
+
+    from klogs_tpu.runtime import fanout as fanout_mod
+    from klogs_tpu.runtime.fanout import FanoutRunner, StreamJob
+
+    requests = []
+
+    def app_with_cutting_follow():
+        app = web.Application()
+
+        async def log(request):
+            requests.append(dict(request.query))
+            resp = web.StreamResponse()
+            await resp.prepare(request)
+            if len(requests) == 1:
+                # Chunk boundary INSIDE a line, then the connection dies.
+                await resp.write(b"alpha 1\nalp")
+                await resp.write(b"ha 2\nalpha 3 par")
+                # no write_eof: simulate an abrupt cut
+                resp.force_close()
+                return resp
+            if len(requests) == 2:
+                await resp.write(b"alpha 3 part-two\nalpha 4\n")
+            # 3rd connection (the follow budget's final attempt after
+            # the 2nd stream's clean EOF): nothing more to say.
+            await resp.write_eof()
+            return resp
+
+        app.router.add_get("/api/v1/namespaces/{ns}/pods/{pod}/log", log)
+        return app
+
+    async def run():
+        runner = web.AppRunner(app_with_cutting_follow())
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        path = write_kubeconfig(tmp_path, f"http://127.0.0.1:{port}")
+        backend = KubeBackend.from_kubeconfig(path)
+        job = StreamJob("api-1", "srv", False,
+                        str(tmp_path / "api-1__srv.log"))
+        fr = FanoutRunner(backend, "default", LogOptions(follow=True),
+                          max_reconnects=2)
+        try:
+            await asyncio.wait_for(fr.run([job], stop=asyncio.Event()),
+                                   timeout=30)
+        finally:
+            await backend.close()
+            await runner.cleanup()
+
+    import unittest.mock as mock
+
+    with mock.patch.object(fanout_mod, "_BACKOFF_BASE_S", 0.01), \
+         mock.patch.object(fanout_mod, "_BACKOFF_MAX_S", 0.05):
+        asyncio.run(run())
+
+    assert len(requests) == 3  # initial + data reconnect + final empty
+    assert requests[0].get("follow") == "true"
+    # Reconnect carried a gap-covering since and no tail re-dump.
+    assert "sinceSeconds" in requests[1]
+    assert "tailLines" not in requests[1]
+    data = (tmp_path / "api-1__srv.log").read_bytes()
+    # The cut mid-line fragment is completed by the reconnected stream's
+    # first bytes (server replays from the cut; framer splices).
+    assert b"alpha 1\n" in data and b"alpha 2\n" in data
+    assert b"alpha 3 part-two\n" in data and b"alpha 4\n" in data
+    assert data.count(b"alpha 2") == 1

@@ -1,0 +1,146 @@
+"""Isolated L5→L6 ceiling: FanoutRunner + FileSink with the generator
+out of the loop (round-4 verdict item 4 — BASELINE rows 1–2 were
+generator-bound, so the ceiling of OUR unfiltered hot path had never
+been measured).
+
+A Backend whose streams yield PRE-RENDERED chunks (the same bytes
+objects every time — zero generation cost) drives the real runner:
+asyncio task per container, open-burst semaphore, per-stream sinks,
+real file writes. The direct-write loop on the same chunks is the
+`io.Copy` stand-in (the reference's whole hot loop,
+/root/reference/cmd/root.go:359-374, is read-chunk → buffered write; no
+Go toolchain exists in this image, so the comparison ceiling is the
+same syscall path minus our scheduler).
+
+    python tools/bench_fanout.py            # appends FANOUT_BENCH.json
+"""
+
+import asyncio
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from klogs_tpu.cluster.fake import synthetic_line  # noqa: E402
+from klogs_tpu.cluster.types import LogOptions  # noqa: E402
+from klogs_tpu.runtime.fanout import FanoutRunner, StreamJob  # noqa: E402
+
+CHUNK_LINES = 512
+
+
+def render_chunks(n_chunks: int) -> list[bytes]:
+    """Pre-rendered ~64KB chunks of ~128B synthetic log lines."""
+    chunks = []
+    for c in range(n_chunks):
+        lines = [synthetic_line("pod-0000", "c0", c * CHUNK_LINES + i,
+                                1_753_800_000 + i)
+                 for i in range(CHUNK_LINES)]
+        chunks.append(b"".join(lines))
+    return chunks
+
+
+class _Stream:
+    def __init__(self, chunks):
+        self._it = iter(chunks)
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        try:
+            return next(self._it)
+        except StopIteration:
+            raise StopAsyncIteration
+
+    async def close(self):
+        pass
+
+
+class PreRenderedBackend:
+    """Every stream serves the SAME pre-rendered chunk list."""
+
+    def __init__(self, chunks):
+        self._chunks = chunks
+
+    async def open_log_stream(self, namespace, pod, opts):
+        return _Stream(self._chunks)
+
+    async def close(self):
+        pass
+
+
+async def run_fanout(n_streams: int, chunks, outdir: str):
+    backend = PreRenderedBackend(chunks)
+    runner = FanoutRunner(backend, "bench", LogOptions())
+    jobs = [StreamJob(f"pod-{i:04d}", "c0", False,
+                      os.path.join(outdir, f"pod-{i:04d}__c0.log"))
+            for i in range(n_streams)]
+    t0 = time.perf_counter()
+    await runner.run(jobs, stop=asyncio.Event())
+    return time.perf_counter() - t0
+
+
+def direct_write(n_streams: int, chunks, outdir: str) -> float:
+    """The io.Copy stand-in: same chunks, same files, plain writes."""
+    t0 = time.perf_counter()
+    for i in range(n_streams):
+        with open(os.path.join(outdir, f"d-{i:04d}.log"), "wb") as f:
+            for ch in chunks:
+                f.write(ch)
+    return time.perf_counter() - t0
+
+
+def main() -> None:
+    total_mb = float(os.environ.get("KLOGS_FANOUT_MB", "256"))
+    results = []
+    for n_streams in (64, 256, 1000):
+        # Fixed total volume across stream counts.
+        chunk_bytes = len(render_chunks(1)[0])
+        n_chunks = max(1, int(total_mb * 1e6 / chunk_bytes / n_streams))
+        chunks = render_chunks(n_chunks)
+        volume = n_streams * n_chunks * chunk_bytes
+        lines = n_streams * n_chunks * CHUNK_LINES
+        outdir = tempfile.mkdtemp(prefix="klogs_fanout_",
+                                  dir="/dev/shm" if os.path.isdir("/dev/shm")
+                                  else None)
+        try:
+            dt = asyncio.run(run_fanout(n_streams, chunks, outdir))
+            ddt = direct_write(n_streams, chunks, outdir)
+            row = {
+                "streams": n_streams,
+                "chunks_per_stream": n_chunks,
+                "lines_per_s": round(lines / dt, 1),
+                "mb_per_s": round(volume / 1e6 / dt, 1),
+                "direct_write_mb_per_s": round(volume / 1e6 / ddt, 1),
+                "runner_vs_direct": round(ddt / dt, 3),
+            }
+            results.append(row)
+            print(f"streams={n_streams}: runner {row['lines_per_s']:,.0f} "
+                  f"lines/s ({row['mb_per_s']} MB/s), direct "
+                  f"{row['direct_write_mb_per_s']} MB/s "
+                  f"(ratio {row['runner_vs_direct']})", flush=True)
+        finally:
+            shutil.rmtree(outdir, ignore_errors=True)
+
+    from datetime import date
+
+    doc = []
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "FANOUT_BENCH.json")
+    if os.path.exists(path):
+        with open(path) as f:
+            doc = json.load(f)
+    doc.append({"date": date.today().isoformat(),
+                "total_mb": total_mb, "chunk_lines": CHUNK_LINES,
+                "runs": results})
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"wrote {path}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+"""Build the single-file klogs.pyz zipapp (dist/klogs.pyz).
+
+The reference ships an upx-compressed static Go binary
+(/root/reference/.github/workflows/release.yaml:36-63) — install is
+"download one file and run". The Python-ecosystem equivalent is a
+zipapp: one file, runnable as ``python klogs.pyz ...`` (or directly
+with the embedded shebang) on any machine with python3.10+ and the
+library deps (numpy always; jax only for --backend=tpu; grpcio/msgpack
+only for --remote; aiohttp only for real clusters — all imports are
+lazy, so the artifact runs the fake/cpu paths with numpy alone). The
+native C fast path compiles itself on first use into
+~/.cache/klogs-tpu (klogs_tpu.native handles read-only zip packaging).
+
+    python tools/build_pyz.py [outdir]
+"""
+
+import os
+import py_compile
+import shutil
+import sys
+import tempfile
+import zipapp
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MAIN = """\
+from klogs_tpu.cli import main
+
+if __name__ == "__main__":
+    main()
+"""
+
+
+def build(outdir: str) -> str:
+    os.makedirs(outdir, exist_ok=True)
+    out = os.path.join(outdir, "klogs.pyz")
+    with tempfile.TemporaryDirectory() as stage:
+        pkg_src = os.path.join(ROOT, "klogs_tpu")
+        pkg_dst = os.path.join(stage, "klogs_tpu")
+        shutil.copytree(
+            pkg_src, pkg_dst,
+            ignore=shutil.ignore_patterns("__pycache__", "*.so", "*.pyc"))
+        with open(os.path.join(stage, "__main__.py"), "w") as f:
+            f.write(MAIN)
+        # Bake the release version into the artifact (the env override
+        # only exists on the build machine; ≙ the reference's -ldflags
+        # -X link-time stamp).
+        ver = os.environ.get("KLOGS_BUILD_VERSION")
+        if ver:
+            with open(os.path.join(pkg_dst, "version.py"), "a") as f:
+                f.write(f"\nBUILD_VERSION = {ver!r}  # stamped at build\n")
+        # Syntax-check everything we ship (a broken file inside a pyz
+        # is much harder to diagnose than at build time). The .pyc
+        # lands OUTSIDE the stage — default cfile would zip __pycache__
+        # into the artifact, doubling it for bytecode zipapp never uses.
+        with tempfile.TemporaryDirectory() as scratch:
+            junk = os.path.join(scratch, "check.pyc")
+            for dirpath, _, files in os.walk(stage):
+                for name in files:
+                    if name.endswith(".py"):
+                        py_compile.compile(os.path.join(dirpath, name),
+                                           cfile=junk, doraise=True)
+        zipapp.create_archive(stage, out,
+                              interpreter="/usr/bin/env python3",
+                              compressed=True)
+    return out
+
+
+if __name__ == "__main__":
+    path = build(sys.argv[1] if len(sys.argv) > 1 else
+                 os.path.join(ROOT, "dist"))
+    print(f"built {path} ({os.path.getsize(path)//1024} KB)")

