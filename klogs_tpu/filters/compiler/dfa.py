@@ -132,19 +132,46 @@ def build_dfa(prog: NFAProgram,
     )
 
 
+def _compiler_fingerprint() -> str:
+    """Hash of the compiler sources that determine table SEMANTICS
+    (parser, Glushkov construction, this module): a semantics bug-fix
+    invalidates every cached table automatically — no manually-bumped
+    version constant to forget (code-review r5)."""
+    import hashlib
+    import os
+
+    h = hashlib.sha256()
+    here = os.path.dirname(os.path.abspath(__file__))
+    for name in ("parser.py", "glushkov.py", "dfa.py"):
+        try:
+            with open(os.path.join(here, name), "rb") as f:
+                h.update(f.read())
+        except OSError:
+            try:  # zipapp: no real files — read through the loader
+                import importlib.resources
+
+                h.update(importlib.resources.files(__package__)
+                         .joinpath(name).read_bytes())
+            except Exception:
+                from klogs_tpu.version import BUILD_VERSION
+
+                h.update(BUILD_VERSION.encode())
+    return h.hexdigest()[:16]
+
+
+_FINGERPRINT = _compiler_fingerprint()
+
+
 def _cache_path(patterns, ignore_case: bool, max_states: int) -> str:
     import hashlib
     import os
 
+    from klogs_tpu.utils.cache import cache_dir
+
     key = hashlib.sha256(repr(
         (tuple(patterns), bool(ignore_case), int(max_states),
-         _LAYOUT_VERSION)).encode()).hexdigest()[:20]
-    base = os.environ.get("XDG_CACHE_HOME",
-                          os.path.join(os.path.expanduser("~"), ".cache"))
-    return os.path.join(base, "klogs-tpu", f"dfa-{key}.npz")
-
-
-_LAYOUT_VERSION = 1  # bump when DFATables layout changes
+         _FINGERPRINT)).encode()).hexdigest()[:20]
+    return os.path.join(cache_dir(), f"dfa-{key}.npz")
 
 
 def build_dfa_cached(patterns: list[str], ignore_case: bool = False,

@@ -30,3 +30,70 @@ class LineFramer:
         """The final unterminated line, if any (stream ended mid-line)."""
         rest, self._rest = self._rest, b""
         return rest if rest else None
+
+
+class FramedBatcher:
+    """Chunk stream -> framed pending batch with ZERO per-line Python
+    objects: chunks append to one contiguous buffer, a C memchr sweep
+    (native.find_newlines) records each complete line's end offset, and
+    take() hands the whole pending batch to the framed filter path as
+    (payload, int32 offsets, n) — lines keep their trailing newline
+    (every engine strips it at match time), so the kept-line join is a
+    plain span gather of the same buffer (join_kept_framed).
+
+    This replaces LineFramer + list[bytes] pending in FilteredSink when
+    the native module is present: the per-line split/append/len work
+    was the last Python-level cost on the collector hot path.
+    Requires the native module (callers fall back to LineFramer).
+    """
+
+    def __init__(self) -> None:
+        from klogs_tpu.native import hostops
+
+        if hostops is None or not hasattr(hostops, "find_newlines"):
+            raise RuntimeError("FramedBatcher requires the native module")
+        self._hostops = hostops
+        self._buf = bytearray()
+        self._ends: list[bytes] = []  # raw int32[...] buffers from C
+        self.pending_lines = 0
+
+    def feed(self, chunk: bytes) -> int:
+        """Returns the number of COMPLETE pending lines after this
+        chunk."""
+        base = len(self._buf)
+        self._buf += chunk
+        ends = self._hostops.find_newlines(chunk, base)
+        if ends:
+            self._ends.append(ends)
+            self.pending_lines += len(ends) // 4
+        return self.pending_lines
+
+    def take(self, final: bool = False):
+        """(payload: bytes, offsets: int32[n+1], n) of every complete
+        pending line; resets, carrying the unterminated tail forward.
+        ``final`` emits the tail as a last unterminated line (stream
+        end, ≙ LineFramer.flush)."""
+        import numpy as np
+
+        n = self.pending_lines
+        ends = (np.frombuffer(b"".join(self._ends), dtype=np.int32)
+                if self._ends else np.zeros(0, dtype=np.int32))
+        cut = int(ends[-1]) if n else 0
+        tail_len = len(self._buf) - cut
+        if final and tail_len:
+            payload = bytes(self._buf)
+            offsets = np.empty(n + 2, dtype=np.int32)
+            offsets[0] = 0
+            offsets[1:n + 1] = ends
+            offsets[n + 1] = len(payload)
+            self._buf = bytearray()
+            n += 1
+        else:
+            payload = bytes(self._buf[:cut])
+            offsets = np.empty(n + 1, dtype=np.int32)
+            offsets[0] = 0
+            offsets[1:] = ends
+            self._buf = bytearray(self._buf[cut:]) if tail_len else bytearray()
+        self._ends = []
+        self.pending_lines = 0
+        return payload, offsets, n

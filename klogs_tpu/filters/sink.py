@@ -39,37 +39,68 @@ class FilteredSink(Sink):
         self._inner = inner
         self._filter = log_filter
         self._stats = stats
-        self._framer = LineFramer()
-        self._pending: list[bytes] = []
         self._pending_since: float | None = None
         self._batch_lines = batch_lines
         self._deadline_s = deadline_s
         self._on_close = on_close
         self._closed = False
         self._service = service
+        # Fully-framed hot path when the native module and a framed
+        # service are both present: chunks accumulate in ONE contiguous
+        # buffer (C newline sweep), the verdicts come back as a numpy
+        # mask, and kept lines are span-gathered from the same buffer —
+        # no per-line Python object anywhere between the HTTP read and
+        # the file write. Otherwise the list path (LineFramer +
+        # list[bytes]) keeps identical semantics.
+        self._batcher = None
+        if (service is not None and hasattr(service, "match_framed")) or (
+                service is None and log_filter is not None):
+            from klogs_tpu.filters.framer import FramedBatcher
+
+            try:
+                self._batcher = FramedBatcher()
+            except RuntimeError:
+                pass
+        self._framer = LineFramer() if self._batcher is None else None
+        self._pending: list[bytes] = []
         # Held across match+write so concurrent flushes (write vs the
         # deadline flusher) cannot reorder this file's lines while a
         # batch is in flight on the async service.
         self._flush_lock = asyncio.Lock()
 
+    def _pending_count(self) -> int:
+        if self._batcher is not None:
+            return self._batcher.pending_lines
+        return len(self._pending)
+
     async def write(self, chunk: bytes) -> None:
-        lines = self._framer.feed(chunk)
-        if lines:
-            if not self._pending:
+        if self._batcher is not None:
+            had = self._batcher.pending_lines
+            n = self._batcher.feed(chunk)
+            if n and not had:
                 self._pending_since = time.perf_counter()
-            self._pending.extend(lines)
-        if len(self._pending) >= self._batch_lines or (
-            self._pending
+        else:
+            lines = self._framer.feed(chunk)
+            if lines:
+                if not self._pending:
+                    self._pending_since = time.perf_counter()
+                self._pending.extend(lines)
+            n = len(self._pending)
+        if n >= self._batch_lines or (
+            n
             and self._pending_since is not None
             and time.perf_counter() - self._pending_since >= self._deadline_s
         ):
             await self._flush_pending()
 
-    async def _flush_pending(self) -> None:
+    async def _flush_pending(self, final: bool = False) -> None:
         async with self._flush_lock:
-            await self._flush_pending_locked()
+            await self._flush_pending_locked(final=final)
 
-    async def _flush_pending_locked(self) -> None:
+    async def _flush_pending_locked(self, final: bool = False) -> None:
+        if self._batcher is not None:
+            await self._flush_framed(final)
+            return
         pending, self._pending = self._pending, []
         self._pending_since = None
         if not pending:
@@ -79,10 +110,9 @@ class FilteredSink(Sink):
 
         if self._service is not None and hasattr(self._service,
                                                  "match_framed"):
-            # Framed flush: one C pass builds (payload, offsets), the
-            # verdicts come back as a numpy array, and the kept-line
-            # join consumes its raw bytes — the only remaining per-line
-            # Python cost in this path is accumulating `pending` itself.
+            # Framed flush over list pending (native module absent or
+            # arrived late): one pass builds (payload, offsets), the
+            # verdicts come back as a numpy array.
             import numpy as np
 
             from klogs_tpu.filters.base import frame_lines
@@ -119,11 +149,43 @@ class FilteredSink(Sink):
             latency_s=latency,
         )
 
+    async def _flush_framed(self, final: bool) -> None:
+        """The zero-per-line flush: framed batch in, span-gathered
+        kept bytes out."""
+        import numpy as np
+
+        payload, offsets, n = self._batcher.take(final=final)
+        self._pending_since = None
+        if n == 0:
+            return
+        t0 = time.perf_counter()
+        if self._service is not None:
+            mask_arr = await self._service.match_framed(payload, offsets)
+        else:
+            # Direct sync engine (--backend=cpu): the DFA scan releases
+            # the GIL and runs at millions of lines/s — no service hop.
+            mask_arr = self._filter.fetch_framed(
+                self._filter.dispatch_framed(payload, offsets))
+        latency = time.perf_counter() - t0
+        n_kept = int(np.count_nonzero(mask_arr))
+        out = self._batcher._hostops.join_kept_framed(
+            payload, np.ascontiguousarray(offsets), n,
+            np.ascontiguousarray(mask_arr, dtype=np.uint8).tobytes())
+        if out:
+            await self._inner.write(out)
+        self._stats.record_batch(
+            n_lines=n,
+            n_matched=n_kept,
+            n_bytes_in=len(payload),
+            n_bytes_out=len(out),
+            latency_s=latency,
+        )
+
     async def flush_if_stale(self) -> None:
         """Flush pending lines whose deadline has passed (called by the
         pipeline's periodic follow-mode flusher)."""
         if (
-            self._pending
+            self._pending_count()
             and self._pending_since is not None
             and time.perf_counter() - self._pending_since >= self._deadline_s
         ):
@@ -138,10 +200,11 @@ class FilteredSink(Sink):
         self._closed = True
         if self._on_close is not None:
             self._on_close(self)
-        rest = self._framer.flush()
-        if rest is not None:
-            self._pending.append(rest)
-        await self._flush_pending()
+        if self._batcher is None:
+            rest = self._framer.flush()
+            if rest is not None:
+                self._pending.append(rest)
+        await self._flush_pending(final=True)
         await self._inner.close()
 
     @property

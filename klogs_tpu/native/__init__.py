@@ -45,10 +45,10 @@ def _read_source() -> "bytes | None":
 
 
 def _cache_path(src: bytes) -> str:
+    from klogs_tpu.utils.cache import cache_dir
+
     tag = hashlib.sha256(src).hexdigest()[:16]
-    base = os.environ.get("XDG_CACHE_HOME",
-                          os.path.join(os.path.expanduser("~"), ".cache"))
-    return os.path.join(base, "klogs-tpu", f"_hostops-{tag}{_EXT}")
+    return os.path.join(cache_dir(), f"_hostops-{tag}{_EXT}")
 
 
 def _build(c_src: str, so_path: str) -> bool:

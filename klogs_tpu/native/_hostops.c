@@ -620,46 +620,27 @@ pack_classify_framed(PyObject *self, PyObject *args)
                         (int32_t *)PyBytes_AS_STRING(lens), T, tab, ptab,
                         begin_c, end_c, pad_c, 0, rows};
         int nthreads = host_threads();
-        if (nthreads <= 1 || rows < 4096) {
-            /* Even single-threaded this path releases the GIL, so the
-             * static pair-LUT cache could be rebuilt under us by
-             * another Python thread packing with a different
-             * classifier — copy it call-locally (code-review r5); on
-             * alloc failure run with the GIL HELD on the statics. */
-            int8_t *tab_copy = PyMem_Malloc(256);
-            uint16_t *ptab_copy = PyMem_Malloc(65536 * sizeof(uint16_t));
-            if (!tab_copy || !ptab_copy) {
-                PyMem_Free(tab_copy);
-                PyMem_Free(ptab_copy);
-                pack_rows(&job);
-            } else {
-                memcpy(tab_copy, tab, 256);
-                memcpy(ptab_copy, ptab, 65536 * sizeof(uint16_t));
-                job.tab = tab_copy;
-                job.ptab = ptab_copy;
+        /* EVERY branch below releases the GIL, so the static pair-LUT
+         * cache could be rebuilt under us by another Python thread
+         * packing with a different classifier — copy it call-locally
+         * ONCE here (one block, not one per branch: code-review r5);
+         * on alloc failure run GIL-HELD on the statics. */
+        int8_t *tab_copy = PyMem_Malloc(256);
+        uint16_t *ptab_copy = PyMem_Malloc(65536 * sizeof(uint16_t));
+        if (!tab_copy || !ptab_copy) {
+            PyMem_Free(tab_copy);
+            PyMem_Free(ptab_copy);
+            pack_rows(&job);
+        } else {
+            memcpy(tab_copy, tab, 256);
+            memcpy(ptab_copy, ptab, 65536 * sizeof(uint16_t));
+            job.tab = tab_copy;
+            job.ptab = ptab_copy;
+            if (nthreads <= 1 || rows < 4096) {
                 Py_BEGIN_ALLOW_THREADS
                 pack_rows(&job);
                 Py_END_ALLOW_THREADS
-                PyMem_Free(tab_copy);
-                PyMem_Free(ptab_copy);
-            }
-        } else {
-            /* The static pair-LUT cache could be rebuilt by another
-             * thread once the GIL drops; copy it call-locally like
-             * pack_classify's threaded path does. Copy failure just
-             * runs single-threaded with the GIL held (tab/ptab stay
-             * valid then). */
-            int8_t *tab_copy = PyMem_Malloc(256);
-            uint16_t *ptab_copy = PyMem_Malloc(65536 * sizeof(uint16_t));
-            if (!tab_copy || !ptab_copy) {
-                PyMem_Free(tab_copy);
-                PyMem_Free(ptab_copy);
-                pack_rows(&job);
             } else {
-                memcpy(tab_copy, tab, 256);
-                memcpy(ptab_copy, ptab, 65536 * sizeof(uint16_t));
-                job.tab = tab_copy;
-                job.ptab = ptab_copy;
                 pthread_t tids[64];
                 pack_job jobs[64];
                 Py_ssize_t per = (rows + nthreads - 1) / nthreads;
@@ -685,9 +666,9 @@ pack_classify_framed(PyObject *self, PyObject *args)
                 for (int t = 0; t < started; t++)
                     pthread_join(tids[t], NULL);
                 Py_END_ALLOW_THREADS
-                PyMem_Free(tab_copy);
-                PyMem_Free(ptab_copy);
             }
+            PyMem_Free(tab_copy);
+            PyMem_Free(ptab_copy);
         }
     }
     PyMem_Free(ptrs);
@@ -879,6 +860,116 @@ dfa_scan(PyObject *self, PyObject *args)
     return mask;
 }
 
+/* find_newlines(data, base) -> bytes holding int32 positions
+ *
+ * Absolute end-offsets (position AFTER each '\n', plus `base`) of every
+ * newline in `data` — one memchr sweep. The framed-batcher's line
+ * scanner: chunk boundaries never materialize per-line objects. */
+static PyObject *
+find_newlines(PyObject *self, PyObject *args)
+{
+    Py_buffer data;
+    Py_ssize_t base;
+    if (!PyArg_ParseTuple(args, "y*n", &data, &base))
+        return NULL;
+    if (base < 0 || base + data.len > INT32_MAX) {
+        /* Same guard as frame_lines: a >2 GiB pending buffer must fail
+         * loudly here, not wrap into negative offsets downstream. */
+        PyBuffer_Release(&data);
+        PyErr_SetString(PyExc_OverflowError,
+                        "framed buffer exceeds int32 offsets");
+        return NULL;
+    }
+    const char *src = (const char *)data.buf;
+    Py_ssize_t n = data.len;
+    /* Count first (cheap memchr sweep), then fill exactly. */
+    Py_ssize_t count = 0;
+    for (const char *p = src;
+         (p = memchr(p, '\n', n - (p - src))) != NULL; p++)
+        count++;
+    PyObject *out = PyBytes_FromStringAndSize(NULL, count * 4);
+    if (!out) {
+        PyBuffer_Release(&data);
+        return NULL;
+    }
+    int32_t *ov = (int32_t *)PyBytes_AS_STRING(out);
+    Py_ssize_t k = 0;
+    for (const char *p = src;
+         (p = memchr(p, '\n', n - (p - src))) != NULL; p++)
+        ov[k++] = (int32_t)(base + (p - src) + 1);
+    PyBuffer_Release(&data);
+    return out;
+}
+
+/* join_kept_framed(payload, offsets, n, mask) -> bytes
+ *
+ * Concatenation of the mask-selected spans, with ADJACENT kept lines
+ * coalesced into single memcpys (a 25%-match batch averages long kept/
+ * dropped runs; the common all-kept case is ONE memcpy). The framed
+ * sibling of join_kept. */
+static PyObject *
+join_kept_framed(PyObject *self, PyObject *args)
+{
+    Py_buffer payload, offs, mask;
+    Py_ssize_t n;
+    if (!PyArg_ParseTuple(args, "y*y*ny*", &payload, &offs, &n, &mask))
+        return NULL;
+    if (n < 0 || offs.len < (n + 1) * 4 || mask.len < n) {
+        PyBuffer_Release(&payload);
+        PyBuffer_Release(&offs);
+        PyBuffer_Release(&mask);
+        PyErr_SetString(PyExc_ValueError, "join_kept_framed: bad sizes");
+        return NULL;
+    }
+    const int32_t *ov = (const int32_t *)offs.buf;
+    const char *m = (const char *)mask.buf;
+    const char *src = (const char *)payload.buf;
+    Py_ssize_t total = 0;
+    int bad = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        if (ov[i] < 0 || ov[i + 1] < ov[i] || ov[i + 1] > payload.len) {
+            bad = 1;
+            break;
+        }
+        if (m[i])
+            total += ov[i + 1] - ov[i];
+    }
+    if (bad) {
+        PyBuffer_Release(&payload);
+        PyBuffer_Release(&offs);
+        PyBuffer_Release(&mask);
+        PyErr_SetString(PyExc_ValueError,
+                        "join_kept_framed: offsets out of range");
+        return NULL;
+    }
+    PyObject *out = PyBytes_FromStringAndSize(NULL, total);
+    if (!out) {
+        PyBuffer_Release(&payload);
+        PyBuffer_Release(&offs);
+        PyBuffer_Release(&mask);
+        return NULL;
+    }
+    char *dst = PyBytes_AS_STRING(out);
+    Py_ssize_t i = 0;
+    while (i < n) {
+        if (!m[i]) {
+            i++;
+            continue;
+        }
+        Py_ssize_t j = i;
+        while (j < n && m[j])
+            j++;
+        Py_ssize_t len = ov[j] - ov[i];
+        memcpy(dst, src + ov[i], len);
+        dst += len;
+        i = j;
+    }
+    PyBuffer_Release(&payload);
+    PyBuffer_Release(&offs);
+    PyBuffer_Release(&mask);
+    return out;
+}
+
 static PyObject *
 join_kept(PyObject *self, PyObject *args)
 {
@@ -945,7 +1036,11 @@ static PyMethodDef Methods[] = {
      " begin, end, pad) -> (int8-cls-bytes, int32-lengths-bytes)"},
     {"dfa_scan", dfa_scan, METH_VARARGS,
      "dfa_scan(payload, offsets, n, table, n_classes, accept, byte_class,"
-     " start, end_class) -> mask bytes"},
+     " start, end_class, wide) -> mask bytes"},
+    {"find_newlines", find_newlines, METH_VARARGS,
+     "find_newlines(data, base) -> int32 after-newline positions"},
+    {"join_kept_framed", join_kept_framed, METH_VARARGS,
+     "join_kept_framed(payload, offsets, n, mask) -> bytes"},
     {NULL, NULL, 0, NULL},
 };
 

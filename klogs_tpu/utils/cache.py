@@ -1,0 +1,12 @@
+"""The one cache-directory resolver (native .so builds, DFA tables):
+XDG_CACHE_HOME else ~/.cache, under a klogs-tpu namespace. A single
+helper so a future relocation (KLOGS_CACHE_DIR, containerized HOME)
+cannot leave the two caches in different places."""
+
+import os
+
+
+def cache_dir() -> str:
+    base = os.environ.get("XDG_CACHE_HOME",
+                          os.path.join(os.path.expanduser("~"), ".cache"))
+    return os.path.join(base, "klogs-tpu")

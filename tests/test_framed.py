@@ -319,3 +319,95 @@ def test_malformed_framed_request_rejected_cleanly():
 
     got = asyncio.run(run())
     assert got.tolist() == [True, False]
+
+
+def test_find_newlines_and_framed_batcher():
+    if native.hostops is None:
+        pytest.skip("native extension unavailable")
+    from klogs_tpu.filters.framer import FramedBatcher, LineFramer
+
+    chunks = [b"alpha\nbe", b"ta\n\ngam", b"ma\ntail-no-nl"]
+    fb = FramedBatcher()
+    lf = LineFramer()
+    want_lines = []
+    for c in chunks:
+        fb.feed(c)
+        want_lines.extend(lf.feed(c))
+    payload, offsets, n = fb.take()
+    got = [payload[offsets[i]:offsets[i + 1]] for i in range(n)]
+    assert got == want_lines  # newline retained, same framing
+    # The unterminated tail survives into the next take(final=True).
+    fb.feed(b"+more")
+    payload, offsets, n = fb.take(final=True)
+    assert n == 1
+    assert payload == b"tail-no-nl+more"
+
+
+def test_framed_batcher_take_mid_stream_keeps_tail():
+    if native.hostops is None:
+        pytest.skip("native extension unavailable")
+    from klogs_tpu.filters.framer import FramedBatcher
+
+    fb = FramedBatcher()
+    fb.feed(b"one\ntwo\npartial")
+    p1, o1, n1 = fb.take()
+    assert n1 == 2 and p1 == b"one\ntwo\n"
+    fb.feed(b"-done\nlast\n")
+    p2, o2, n2 = fb.take()
+    assert n2 == 2 and p2 == b"partial-done\nlast\n"
+
+
+def test_join_kept_framed_matches_list_join():
+    if native.hostops is None:
+        pytest.skip("native extension unavailable")
+    lines = [b"a\n", b"bb\n", b"ccc\n", b"d\n", b"ee\n"]
+    payload, offsets, _ = frame_lines(lines, strip_nl=False)
+    for mask in ([1, 0, 1, 1, 0], [0] * 5, [1] * 5):
+        got = native.hostops.join_kept_framed(
+            payload, np.ascontiguousarray(offsets), len(lines),
+            bytes(mask))
+        want = native.hostops.join_kept(lines, bytes(mask))
+        assert got == want, mask
+
+
+def test_filtered_sink_uses_framed_batcher_end_to_end():
+    """Chunked writes with split lines through the fully-framed sink:
+    same output and stats as the list path."""
+    if native.hostops is None:
+        pytest.skip("native extension unavailable")
+    from klogs_tpu.filters.async_service import AsyncFilterService
+    from klogs_tpu.filters.base import FilterStats
+    from klogs_tpu.filters.sink import FilteredSink
+
+    class MemSink:
+        def __init__(self):
+            self.data = b""
+            self.bytes_written = 0
+
+        async def write(self, chunk):
+            self.data += chunk
+            self.bytes_written += len(chunk)
+
+        async def flush(self):
+            pass
+
+        async def close(self):
+            pass
+
+    async def run():
+        stats = FilterStats()
+        svc = AsyncFilterService(RegexFilter(PATTERNS), stats=stats)
+        mem = MemSink()
+        sink = FilteredSink(mem, None, stats, batch_lines=3, service=svc)
+        assert sink._batcher is not None  # framed mode engaged
+        await sink.write(b"an ERROR he")
+        await sink.write(b"re\nall good\ncode=5")
+        await sink.write(b"03\nnope\nERROR tail-no-nl")
+        await sink.close()
+        await svc.aclose()
+        return mem.data, stats
+
+    data, stats = asyncio.run(run())
+    assert data == b"an ERROR here\ncode=503\nERROR tail-no-nl"
+    assert stats.lines_in == 5
+    assert stats.lines_matched == 3

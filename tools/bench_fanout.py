@@ -73,14 +73,19 @@ class PreRenderedBackend:
         pass
 
 
-async def run_fanout(n_streams: int, chunks, outdir: str):
+async def run_fanout(n_streams: int, chunks, outdir: str,
+                     pipeline=None):
     backend = PreRenderedBackend(chunks)
-    runner = FanoutRunner(backend, "bench", LogOptions())
+    runner = FanoutRunner(backend, "bench", LogOptions(),
+                          sink_factory=(pipeline.sink_factory
+                                        if pipeline else None))
     jobs = [StreamJob(f"pod-{i:04d}", "c0", False,
                       os.path.join(outdir, f"pod-{i:04d}__c0.log"))
             for i in range(n_streams)]
     t0 = time.perf_counter()
     await runner.run(jobs, stop=asyncio.Event())
+    if pipeline is not None:
+        await pipeline.aclose()
     return time.perf_counter() - t0
 
 
@@ -110,17 +115,29 @@ def main() -> None:
         try:
             dt = asyncio.run(run_fanout(n_streams, chunks, outdir))
             ddt = direct_write(n_streams, chunks, outdir)
+            # FILTERED collector hot path: the fully-framed sink
+            # (FramedBatcher -> strong-CPU DFA -> span-gather join),
+            # the whole L4->L6 pipeline minus only the generator.
+            from klogs_tpu.filters.sink import make_pipeline
+
+            fdt = asyncio.run(run_fanout(
+                n_streams, chunks, outdir,
+                pipeline=make_pipeline(
+                    ["ERROR", r"code=50[34]", r"latency=49\dms",
+                     "panic:"], "cpu", batch_lines=8192)))
             row = {
                 "streams": n_streams,
                 "chunks_per_stream": n_chunks,
                 "lines_per_s": round(lines / dt, 1),
                 "mb_per_s": round(volume / 1e6 / dt, 1),
+                "filtered_lines_per_s": round(lines / fdt, 1),
                 "direct_write_mb_per_s": round(volume / 1e6 / ddt, 1),
                 "runner_vs_direct": round(ddt / dt, 3),
             }
             results.append(row)
             print(f"streams={n_streams}: runner {row['lines_per_s']:,.0f} "
-                  f"lines/s ({row['mb_per_s']} MB/s), direct "
+                  f"lines/s ({row['mb_per_s']} MB/s), filtered(dfa) "
+                  f"{row['filtered_lines_per_s']:,.0f} lines/s, direct "
                   f"{row['direct_write_mb_per_s']} MB/s "
                   f"(ratio {row['runner_vs_direct']})", flush=True)
         finally:
