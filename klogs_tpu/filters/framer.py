@@ -5,9 +5,11 @@ so line boundaries never align with chunk boundaries. The framer turns a
 chunk sequence into complete lines (newline retained) plus a final
 unterminated remainder at flush.
 
-A pure-Python implementation; a C-extension fast path can slot in here
-for the host-side hot loop (the reference's one native aspect is being a
-compiled binary, SURVEY.md §2).
+Two implementations: LineFramer (pure Python, list-of-lines — the
+fallback and the oracle) and FramedBatcher (native fast path: one
+contiguous buffer + C newline sweep, zero per-line objects — what
+FilteredSink rides in production; the reference's one native aspect is
+being a compiled binary, SURVEY.md §2).
 """
 
 

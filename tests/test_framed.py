@@ -411,3 +411,47 @@ def test_filtered_sink_uses_framed_batcher_end_to_end():
     assert data == b"an ERROR here\ncode=503\nERROR tail-no-nl"
     assert stats.lines_in == 5
     assert stats.lines_matched == 3
+
+
+def test_filtered_sink_framed_direct_engine_no_service():
+    """The service=None arm of the framed flush — the production
+    --backend=cpu hot path (direct DFA engine, incl. the framed
+    include/exclude combination) — code-review r5 coverage gap."""
+    if native.hostops is None:
+        pytest.skip("native extension unavailable")
+    from klogs_tpu.filters.base import FilterStats, build_include_exclude
+    from klogs_tpu.filters.cpu import DFAFilter
+    from klogs_tpu.filters.sink import FilteredSink
+
+    class MemSink:
+        def __init__(self):
+            self.data = b""
+            self.bytes_written = 0
+
+        async def write(self, chunk):
+            self.data += chunk
+            self.bytes_written += len(chunk)
+
+        async def flush(self):
+            pass
+
+        async def close(self):
+            pass
+
+    filt = build_include_exclude(
+        lambda pats: DFAFilter(pats), ["ERROR"], ["tail"])
+
+    async def run():
+        stats = FilterStats()
+        mem = MemSink()
+        sink = FilteredSink(mem, filt, stats, batch_lines=2, service=None)
+        assert sink._batcher is not None  # framed mode without a service
+        await sink.write(b"an ERROR here\nERROR tail drop\n")
+        await sink.write(b"plain\nERROR keep")
+        await sink.close()
+        return mem.data, stats
+
+    data, stats = asyncio.run(run())
+    assert data == b"an ERROR here\nERROR keep"
+    assert stats.lines_in == 4
+    assert stats.lines_matched == 2

@@ -33,6 +33,7 @@ from klogs_tpu.filters.compiler import (  # noqa: E402
     compile_patterns,
     reference_match,
 )
+from klogs_tpu.filters.cpu import DFAFilter  # noqa: E402
 
 ALPHABET = b"ab01 .-XY\t/=:\xc3\x28\n"  # \n: DOTALL edge
 CLASS_BODIES = ["ab", "a-c", "0-9a", "^ab", "^0-9", "b-", "]a", "a-zA-Z",
@@ -179,8 +180,18 @@ def main() -> int:
     rng = random.Random(seed)
     print(f"fuzz: seed={seed} trials={args.trials}", flush=True)
 
+    # DFA trials build into a throwaway cache (30k trials would
+    # otherwise spray ~/.cache with one .npz per pattern set); removed
+    # at exit so repeated sweeps don't accumulate /tmp files.
+    import atexit
+    import shutil
+    import tempfile
+
+    scratch_cache = tempfile.mkdtemp(prefix="klogs_fuzz_")
+    os.environ["XDG_CACHE_HOME"] = scratch_cache
+    atexit.register(shutil.rmtree, scratch_cache, True)
     t0 = time.time()
-    checked = skipped = engine_runs = backtracked = 0
+    checked = skipped = engine_runs = backtracked = dfa_runs = 0
     for trial in range(args.trials):
         k = rng.randrange(1, 5)
         pats = [rand_pattern(rng) for _ in range(k)]
@@ -212,6 +223,27 @@ def main() -> int:
                       flush=True)
                 return 1
             checked += 1
+        # The strong-CPU DFA engine (subset construction over the same
+        # compiler artifacts + native scan) against the same ground
+        # truth. Tiny cap: pathological determinizations should skip,
+        # not stall the sweep.
+        try:
+            dfa = DFAFilter(pats, ignore_case=ignore_case,
+                            max_states=2048)
+        except (ValueError, RegexSyntaxError):
+            dfa = None  # cap overflow (ValueError) only; the subset
+            # was already accepted by compile_patterns above
+        if dfa is not None:
+            got_dfa = dfa.match_lines(list(lines))
+            if got_dfa != expects:
+                bad = next(i for i in range(len(lines))
+                           if got_dfa[i] != expects[i])
+                print(f"DIVERGENCE (dfa engine): seed={seed} "
+                      f"trial={trial} patterns={pats!r} ignore_case="
+                      f"{ignore_case} line={lines[bad]!r} "
+                      f"dfa={got_dfa[bad]} re={expects[bad]}", flush=True)
+                return 1
+            dfa_runs += 1
         if args.engine_every and trial % args.engine_every == 0:
             # Mix in lines several times the (shrunken) chunk width, so
             # the carried-state chunk protocol crosses many boundaries;
@@ -290,7 +322,7 @@ def main() -> int:
     print(f"fuzz OK: {checked} line-checks across {args.trials} trials "
           f"({skipped} outside subset/invalid, {backtracked} re-backtrack "
           f"timeouts — the linear-time NFA has no such blowup), "
-          f"{engine_runs} interpret-kernel pattern sets, "
+          f"{engine_runs} interpret-kernel + {dfa_runs} dfa pattern sets, "
           f"{time.time()-t0:.0f}s, seed={seed}", flush=True)
     return 0
 
