@@ -66,16 +66,26 @@ class DFAFilter(LogFilter):
     ``max_states`` — callers fall back to CombinedRegexFilter."""
 
     def __init__(self, patterns: list[str], ignore_case: bool = False,
-                 max_states: int | None = None):
+                 max_states: int | None = None, cache: bool = True):
         from klogs_tpu.filters.compiler.dfa import (
             DEFAULT_MAX_STATES,
+            build_dfa,
             build_dfa_cached,
         )
 
         if not patterns:
             raise ValueError("DFAFilter needs at least one pattern")
-        t = build_dfa_cached(patterns, ignore_case=ignore_case,
-                             max_states=max_states or DEFAULT_MAX_STATES)
+        if cache:
+            t = build_dfa_cached(patterns, ignore_case=ignore_case,
+                                 max_states=max_states or DEFAULT_MAX_STATES)
+        else:
+            # cache=False: throwaway table sets (fuzz sweeps build one
+            # per trial — writing each to disk would be pure waste).
+            from klogs_tpu.filters.compiler.glushkov import compile_patterns
+
+            t = build_dfa(compile_patterns(patterns,
+                                           ignore_case=ignore_case),
+                          max_states or DEFAULT_MAX_STATES)
         if t is None:
             raise ValueError(
                 f"DFA for {len(patterns)} pattern(s) exceeds "

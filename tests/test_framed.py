@@ -28,6 +28,24 @@ LINES = [
 ]
 
 
+class MemSink:
+    """In-memory Sink for the FilteredSink tests below."""
+
+    def __init__(self):
+        self.data = b""
+        self.bytes_written = 0
+
+    async def write(self, chunk):
+        self.data += chunk
+        self.bytes_written += len(chunk)
+
+    async def flush(self):
+        pass
+
+    async def close(self):
+        pass
+
+
 def test_frame_lines_native_matches_fallback(monkeypatch):
     if native.hostops is None:
         pytest.skip("native extension unavailable")
@@ -246,21 +264,6 @@ def test_filtered_sink_framed_flush():
     from klogs_tpu.filters.base import FilterStats
     from klogs_tpu.filters.sink import FilteredSink
 
-    class MemSink:
-        def __init__(self):
-            self.data = b""
-            self.bytes_written = 0
-
-        async def write(self, chunk):
-            self.data += chunk
-            self.bytes_written += len(chunk)
-
-        async def flush(self):
-            pass
-
-        async def close(self):
-            pass
-
     async def run():
         stats = FilterStats()
         svc = AsyncFilterService(RegexFilter(PATTERNS), stats=stats)
@@ -379,21 +382,6 @@ def test_filtered_sink_uses_framed_batcher_end_to_end():
     from klogs_tpu.filters.base import FilterStats
     from klogs_tpu.filters.sink import FilteredSink
 
-    class MemSink:
-        def __init__(self):
-            self.data = b""
-            self.bytes_written = 0
-
-        async def write(self, chunk):
-            self.data += chunk
-            self.bytes_written += len(chunk)
-
-        async def flush(self):
-            pass
-
-        async def close(self):
-            pass
-
     async def run():
         stats = FilterStats()
         svc = AsyncFilterService(RegexFilter(PATTERNS), stats=stats)
@@ -422,21 +410,6 @@ def test_filtered_sink_framed_direct_engine_no_service():
     from klogs_tpu.filters.base import FilterStats, build_include_exclude
     from klogs_tpu.filters.cpu import DFAFilter
     from klogs_tpu.filters.sink import FilteredSink
-
-    class MemSink:
-        def __init__(self):
-            self.data = b""
-            self.bytes_written = 0
-
-        async def write(self, chunk):
-            self.data += chunk
-            self.bytes_written += len(chunk)
-
-        async def flush(self):
-            pass
-
-        async def close(self):
-            pass
 
     filt = build_include_exclude(
         lambda pats: DFAFilter(pats), ["ERROR"], ["tail"])

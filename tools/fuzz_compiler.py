@@ -180,16 +180,6 @@ def main() -> int:
     rng = random.Random(seed)
     print(f"fuzz: seed={seed} trials={args.trials}", flush=True)
 
-    # DFA trials build into a throwaway cache (30k trials would
-    # otherwise spray ~/.cache with one .npz per pattern set); removed
-    # at exit so repeated sweeps don't accumulate /tmp files.
-    import atexit
-    import shutil
-    import tempfile
-
-    scratch_cache = tempfile.mkdtemp(prefix="klogs_fuzz_")
-    os.environ["XDG_CACHE_HOME"] = scratch_cache
-    atexit.register(shutil.rmtree, scratch_cache, True)
     t0 = time.time()
     checked = skipped = engine_runs = backtracked = dfa_runs = 0
     for trial in range(args.trials):
@@ -229,7 +219,7 @@ def main() -> int:
         # not stall the sweep.
         try:
             dfa = DFAFilter(pats, ignore_case=ignore_case,
-                            max_states=2048)
+                            max_states=2048, cache=False)
         except (ValueError, RegexSyntaxError):
             dfa = None  # cap overflow (ValueError) only; the subset
             # was already accepted by compile_patterns above
