@@ -140,6 +140,73 @@ host_threads(void)
     return n < 1 ? 1 : (n > 64 ? 64 : n);
 }
 
+/* THE one spawn/join/inline-fallback loop for row-parallel work
+ * (pack_classify, pack_classify_framed, dfa_scan all dispatch through
+ * here — the failure-handling rules live in exactly one place):
+ * jobs[0..count) are pre-sliced clones; the LAST live slice runs
+ * inline on this thread, a failed pthread_create degrades that slice
+ * to inline execution, and every spawned worker is joined before
+ * return. Call with the GIL released; job structs must reference no
+ * Python objects. */
+/* Clone *proto into jobs[0..count) slices covering [0, rows) in
+ * contiguous ranges of ceil(rows/nthreads) rounded up to `align` rows
+ * (lane-aligned splits keep interleaved loops on full groups except at
+ * each slice's own tail); writes the bounds through the lo/hi field
+ * offsets so pack_job and dfa_job share one slicer. Returns the live
+ * slice count. */
+#include <stddef.h>
+
+static int
+slice_jobs(char *jobs, size_t jsz, const void *proto, Py_ssize_t rows,
+           int nthreads, Py_ssize_t align, size_t lo_off, size_t hi_off)
+{
+    Py_ssize_t per = (rows + nthreads - 1) / nthreads;
+    per = (per + align - 1) / align * align;
+    if (per < 1)
+        per = 1;
+    int count = 0;
+    for (int t = 0; t < nthreads; t++) {
+        Py_ssize_t lo = (Py_ssize_t)t * per;
+        Py_ssize_t hi = lo + per < rows ? lo + per : rows;
+        if (lo >= hi)
+            break;
+        char *j = jobs + (size_t)count * jsz;
+        memcpy(j, proto, jsz);
+        *(Py_ssize_t *)(j + lo_off) = lo;
+        *(Py_ssize_t *)(j + hi_off) = hi;
+        count++;
+    }
+    return count;
+}
+
+static void
+pack_rows_run(void *arg)
+{
+    pack_rows((const pack_job *)arg);
+}
+
+static void
+dispatch_row_jobs(char *jobs, size_t jsz, int count,
+                  void *(*worker)(void *), void (*run)(void *))
+{
+    pthread_t tids[64];
+    int started = 0;
+    for (int t = 0; t < count; t++) {
+        void *j = jobs + (size_t)t * jsz;
+        if (t == count - 1) {
+            run(j);
+            break;
+        }
+        if (pthread_create(&tids[started], NULL, worker, j) != 0) {
+            run(j);
+            continue;
+        }
+        started++;
+    }
+    for (int t = 0; t < started; t++)
+        pthread_join(tids[t], NULL);
+}
+
 static PyObject *
 pack_lines(PyObject *self, PyObject *args)
 {
@@ -328,30 +395,14 @@ fused:
     {
         pack_job job = {ptrs, lenv, out, lengths, T, tab_copy, ptab_copy,
                         begin_c, end_c, pad_c, 0, rows};
-        pthread_t tids[64];
         pack_job jobs[64];
-        Py_ssize_t per = (rows + nthreads - 1) / nthreads;
-        int started = 0;
+        int count = slice_jobs((char *)jobs, sizeof(pack_job), &job,
+                               rows, nthreads, 1,
+                               offsetof(pack_job, lo),
+                               offsetof(pack_job, hi));
         Py_BEGIN_ALLOW_THREADS
-        for (int t = 0; t < nthreads; t++) {
-            jobs[t] = job;
-            jobs[t].lo = t * per;
-            jobs[t].hi = (t + 1) * per < rows ? (t + 1) * per : rows;
-            if (jobs[t].lo >= jobs[t].hi)
-                break;
-            if (t == nthreads - 1 || jobs[t].hi == rows) {
-                pack_rows(&jobs[t]);  /* run the last slice inline */
-                break;
-            }
-            if (pthread_create(&tids[started], NULL, pack_worker,
-                               &jobs[t]) != 0) {
-                pack_rows(&jobs[t]);  /* spawn failed: do it here */
-                continue;
-            }
-            started++;
-        }
-        for (int t = 0; t < started; t++)
-            pthread_join(tids[t], NULL);
+        dispatch_row_jobs((char *)jobs, sizeof(pack_job), count,
+                          pack_worker, pack_rows_run);
         Py_END_ALLOW_THREADS
     }
     for (Py_ssize_t k = 0; k < held; k++)
@@ -641,30 +692,14 @@ pack_classify_framed(PyObject *self, PyObject *args)
                 pack_rows(&job);
                 Py_END_ALLOW_THREADS
             } else {
-                pthread_t tids[64];
                 pack_job jobs[64];
-                Py_ssize_t per = (rows + nthreads - 1) / nthreads;
-                int started = 0;
+                int count = slice_jobs((char *)jobs, sizeof(pack_job),
+                                       &job, rows, nthreads, 1,
+                                       offsetof(pack_job, lo),
+                                       offsetof(pack_job, hi));
                 Py_BEGIN_ALLOW_THREADS
-                for (int t = 0; t < nthreads; t++) {
-                    jobs[t] = job;
-                    jobs[t].lo = t * per;
-                    jobs[t].hi = (t + 1) * per < rows ? (t + 1) * per : rows;
-                    if (jobs[t].lo >= jobs[t].hi)
-                        break;
-                    if (t == nthreads - 1 || jobs[t].hi == rows) {
-                        pack_rows(&jobs[t]);
-                        break;
-                    }
-                    if (pthread_create(&tids[started], NULL, pack_worker,
-                                       &jobs[t]) != 0) {
-                        pack_rows(&jobs[t]);
-                        continue;
-                    }
-                    started++;
-                }
-                for (int t = 0; t < started; t++)
-                    pthread_join(tids[t], NULL);
+                dispatch_row_jobs((char *)jobs, sizeof(pack_job), count,
+                                  pack_worker, pack_rows_run);
                 Py_END_ALLOW_THREADS
             }
             PyMem_Free(tab_copy);
@@ -710,64 +745,50 @@ bad_span:
  *   start:      state AFTER the BEGIN sentinel step (checked first)
  *   end_class:  class fed after the last byte ($ handling)
  */
-static PyObject *
-dfa_scan(PyObject *self, PyObject *args)
-{
-    Py_buffer payload, offs, table, acc, bclass;
-    Py_ssize_t n;
+typedef struct {
+    const uint8_t *src;
+    Py_ssize_t src_len;
+    const int32_t *ov;
+    const uint16_t *tab16;
+    const uint32_t *tab32;
+    const uint8_t *accept;
+    const int32_t *bc;
     unsigned int start, n_classes, end_class, wide;
-    if (!PyArg_ParseTuple(args, "y*y*ny*Iy*y*III", &payload, &offs, &n,
-                          &table, &n_classes, &acc, &bclass,
-                          &start, &end_class, &wide))
-        return NULL;
-    const Py_ssize_t elem = wide ? 4 : 2;
-    const Py_ssize_t n_dfa = (Py_ssize_t)(acc.len);
-    if (n < 0 || offs.len < (n + 1) * 4 || bclass.len < 256 * 4
-        || n_classes == 0 || end_class >= n_classes || start >= n_dfa
-        || table.len < n_dfa * (Py_ssize_t)n_classes * elem) {
-        PyBuffer_Release(&payload);
-        PyBuffer_Release(&offs);
-        PyBuffer_Release(&table);
-        PyBuffer_Release(&acc);
-        PyBuffer_Release(&bclass);
-        PyErr_SetString(PyExc_ValueError, "dfa_scan: bad buffer sizes");
-        return NULL;
-    }
-    PyObject *mask = PyBytes_FromStringAndSize(NULL, n);
-    if (!mask) {
-        PyBuffer_Release(&payload);
-        PyBuffer_Release(&offs);
-        PyBuffer_Release(&table);
-        PyBuffer_Release(&acc);
-        PyBuffer_Release(&bclass);
-        return NULL;
-    }
-    char *out = PyBytes_AS_STRING(mask);
-    const uint8_t *src = (const uint8_t *)payload.buf;
-    const int32_t *ov = (const int32_t *)offs.buf;
-    const uint32_t *tab32 = (const uint32_t *)table.buf;
-    const uint16_t *tab16 = (const uint16_t *)table.buf;
-    const uint8_t *accept = (const uint8_t *)acc.buf;
-    const int32_t *bc = (const int32_t *)bclass.buf;
-    int bad = 0;
-    Py_BEGIN_ALLOW_THREADS
-    /* The scan is bound by the dependent load chain (state -> table ->
-     * state, ~3ns/byte scalar): interleave LANES independent lines so
-     * the chains overlap. The u16 path (every practical pattern set)
-     * takes the interleaved loop; u32 and the remainder fall through
-     * to the scalar loop below. */
+    char *out;
+    Py_ssize_t lo, hi;          /* row range for this worker */
+    int bad;
+} dfa_job;
+
+/* The scan body over rows [lo, hi): bound by the dependent load chain
+ * (state -> table -> state, ~3ns/byte scalar), so DFA_LANES
+ * independent lines interleave to overlap the chains. The u16 path
+ * (every practical pattern set) takes the interleaved loop; u32 and
+ * the remainder fall through to the scalar loop. Pure C over borrowed
+ * buffers — safe with the GIL released and across worker threads. */
 #define DFA_LANES 4
-    Py_ssize_t i0 = 0;
-    if (!wide && n >= DFA_LANES) {
-        for (; i0 + DFA_LANES <= n && !bad; i0 += DFA_LANES) {
+static void
+dfa_scan_rows(dfa_job *job)
+{
+    const uint8_t *src = job->src;
+    const int32_t *ov = job->ov;
+    const uint16_t *tab16 = job->tab16;
+    const uint32_t *tab32 = job->tab32;
+    const uint8_t *accept = job->accept;
+    const int32_t *bc = job->bc;
+    const unsigned int start = job->start, n_classes = job->n_classes;
+    const unsigned int end_class = job->end_class, wide = job->wide;
+    char *out = job->out;
+    Py_ssize_t i0 = job->lo;
+    if (!wide && job->hi - job->lo >= DFA_LANES) {
+        for (; i0 + DFA_LANES <= job->hi && !job->bad; i0 += DFA_LANES) {
             const uint8_t *p[DFA_LANES], *pe[DFA_LANES];
             uint32_t s[DFA_LANES];
             int m[DFA_LANES];
             unsigned active = 0;
             for (int l = 0; l < DFA_LANES; l++) {
                 int32_t lo = ov[i0 + l], hi = ov[i0 + l + 1];
-                if (lo < 0 || hi < lo || hi > payload.len) {
-                    bad = 1;
+                if (lo < 0 || hi < lo || hi > job->src_len) {
+                    job->bad = 1;
                     break;
                 }
                 Py_ssize_t len = hi - lo;
@@ -780,7 +801,7 @@ dfa_scan(PyObject *self, PyObject *args)
                 if (!m[l] && p[l] < pe[l])
                     active |= 1u << l;
             }
-            if (bad)
+            if (job->bad)
                 break;
             while (active) {
                 for (int l = 0; l < DFA_LANES; l++) {
@@ -805,10 +826,10 @@ dfa_scan(PyObject *self, PyObject *args)
             }
         }
     }
-    for (Py_ssize_t i = i0; i < n && !bad; i++) {
+    for (Py_ssize_t i = i0; i < job->hi && !job->bad; i++) {
         int32_t lo = ov[i], hi = ov[i + 1];
-        if (lo < 0 || hi < lo || hi > payload.len) {
-            bad = 1;
+        if (lo < 0 || hi < lo || hi > job->src_len) {
+            job->bad = 1;
             break;
         }
         Py_ssize_t len = hi - lo;
@@ -846,7 +867,87 @@ dfa_scan(PyObject *self, PyObject *args)
         }
         out[i] = (char)m;
     }
-    Py_END_ALLOW_THREADS
+}
+
+static void *
+dfa_scan_worker(void *arg)
+{
+    dfa_scan_rows((dfa_job *)arg);
+    return NULL;
+}
+
+static void
+dfa_scan_run(void *arg)
+{
+    dfa_scan_rows((dfa_job *)arg);
+}
+
+static PyObject *
+dfa_scan(PyObject *self, PyObject *args)
+{
+    Py_buffer payload, offs, table, acc, bclass;
+    Py_ssize_t n;
+    unsigned int start, n_classes, end_class, wide;
+    if (!PyArg_ParseTuple(args, "y*y*ny*Iy*y*III", &payload, &offs, &n,
+                          &table, &n_classes, &acc, &bclass,
+                          &start, &end_class, &wide))
+        return NULL;
+    const Py_ssize_t elem = wide ? 4 : 2;
+    const Py_ssize_t n_dfa = (Py_ssize_t)(acc.len);
+    if (n < 0 || offs.len < (n + 1) * 4 || bclass.len < 256 * 4
+        || n_classes == 0 || end_class >= n_classes || start >= n_dfa
+        || table.len < n_dfa * (Py_ssize_t)n_classes * elem) {
+        PyBuffer_Release(&payload);
+        PyBuffer_Release(&offs);
+        PyBuffer_Release(&table);
+        PyBuffer_Release(&acc);
+        PyBuffer_Release(&bclass);
+        PyErr_SetString(PyExc_ValueError, "dfa_scan: bad buffer sizes");
+        return NULL;
+    }
+    PyObject *mask = PyBytes_FromStringAndSize(NULL, n);
+    if (!mask) {
+        PyBuffer_Release(&payload);
+        PyBuffer_Release(&offs);
+        PyBuffer_Release(&table);
+        PyBuffer_Release(&acc);
+        PyBuffer_Release(&bclass);
+        return NULL;
+    }
+    /* KLOGS_HOST_THREADS row-parallel dispatch (same contract as
+     * pack_classify): the table/accept/byte_class buffers are borrowed
+     * and read-only, each worker writes a disjoint out range, so the
+     * whole scan runs GIL-free. Small batches stay single-threaded
+     * (thread spawn ~10us each would swamp a sub-ms scan). */
+    dfa_job job = {(const uint8_t *)payload.buf, payload.len,
+                   (const int32_t *)offs.buf,
+                   (const uint16_t *)table.buf,
+                   (const uint32_t *)table.buf,
+                   (const uint8_t *)acc.buf,
+                   (const int32_t *)bclass.buf,
+                   start, n_classes, end_class, wide,
+                   PyBytes_AS_STRING(mask), 0, n, 0};
+    int nthreads = host_threads();
+    int bad;
+    if (nthreads <= 1 || n < 8192) {
+        Py_BEGIN_ALLOW_THREADS
+        dfa_scan_rows(&job);
+        Py_END_ALLOW_THREADS
+        bad = job.bad;
+    } else {
+        dfa_job jobs[64];
+        int count = slice_jobs((char *)jobs, sizeof(dfa_job), &job, n,
+                               nthreads, DFA_LANES,
+                               offsetof(dfa_job, lo),
+                               offsetof(dfa_job, hi));
+        Py_BEGIN_ALLOW_THREADS
+        dispatch_row_jobs((char *)jobs, sizeof(dfa_job), count,
+                          dfa_scan_worker, dfa_scan_run);
+        Py_END_ALLOW_THREADS
+        bad = 0;
+        for (int t = 0; t < count; t++)
+            bad |= jobs[t].bad;
+    }
     PyBuffer_Release(&payload);
     PyBuffer_Release(&offs);
     PyBuffer_Release(&table);

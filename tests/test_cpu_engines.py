@@ -154,3 +154,22 @@ def test_cpu_backend_pipeline_uses_strong_engine(tmp_path):
     assert isinstance(pipe.log_filter, D)
     assert pipe.log_filter.match_lines([b"an ERROR\n", b"ok\n"]) == [
         True, False]
+
+
+def test_dfa_scan_threaded_parity(monkeypatch):
+    """KLOGS_HOST_THREADS>1 splits the DFA scan across pthreads
+    (lane-aligned row ranges, GIL released); output must be identical
+    to the single-thread scan. The 8192-row threshold gates the
+    threaded path, so the batch here exceeds it."""
+    from klogs_tpu import native
+
+    if native.hostops is None:
+        pytest.skip("native extension unavailable")
+    lines = [(b"x%d ERROR y" % i if i % 7 == 0 else b"quiet %d" % i)
+             for i in range(9000)]
+    monkeypatch.delenv("KLOGS_HOST_THREADS", raising=False)
+    f = DFAFilter(PATTERNS)
+    single = f.match_lines(lines)
+    monkeypatch.setenv("KLOGS_HOST_THREADS", "3")
+    assert f.match_lines(lines) == single
+    assert sum(single) == sum(1 for i in range(9000) if i % 7 == 0)
