@@ -262,9 +262,10 @@ def make_inner_sink_factory(opts: Options):
     return lambda job: TeeSink(FileSink(job.path), console(job))
 
 
-def make_pipeline_for(opts: Options):
+def make_pipeline_for(opts: Options, registry=None):
     """The --match/--exclude filter pipeline (None = unfiltered
-    reference path)."""
+    reference path). ``registry`` (an obs.Registry) backs the stats
+    when --metrics-port / --stats-json want them scrapable."""
     if not opts.match and not opts.exclude:
         return None
     import re as _re
@@ -276,7 +277,7 @@ def make_pipeline_for(opts: Options):
     try:
         return make_pipeline(opts.match, opts.backend, remote=opts.remote,
                              ignore_case=opts.ignore_case,
-                             exclude=opts.exclude)
+                             exclude=opts.exclude, registry=registry)
     except _re.error as e:
         term.fatal("invalid --match/--exclude pattern %r: %s", e.pattern, e)
     except RegexSyntaxError as e:
@@ -286,6 +287,42 @@ def make_pipeline_for(opts: Options):
         term.fatal("unsupported --match/--exclude pattern: %s", e)
     except ImportError as e:
         term.fatal("--backend %s is unavailable: %s", opts.backend, e)
+
+
+def _write_stats_json(path: str, registry, pipeline) -> None:
+    """--stats-json: one-shot metrics dump at exit — the scrapeless
+    option for batch (non-follow, non-server) runs. The full registry
+    snapshot plus the --stats summary numbers, derived from the SAME
+    metric objects a /metrics scrape reads."""
+    import json
+
+    from klogs_tpu.obs import snapshot
+
+    doc: dict = {"metrics": snapshot(registry)}
+    if pipeline is not None:
+        s = pipeline.stats
+        doc["summary"] = {
+            "lines_in": s.lines_in,
+            "lines_matched": s.lines_matched,
+            "matched_pct": s.matched_pct(),
+            "lines_per_sec": s.lines_per_sec(),
+            "batches": s.batches,
+            "batch_latency_p50_s": s.percentile_latency_s(50),
+            "batch_latency_p99_s": s.percentile_latency_s(99),
+        }
+        if s.has_service_latencies:
+            doc["summary"].update({
+                "queue_p50_s": s.percentile_queue_s(50),
+                "queue_p99_s": s.percentile_queue_s(99),
+                "device_p50_s": s.percentile_device_s(50),
+                "device_p99_s": s.percentile_device_s(99),
+            })
+    try:
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1)
+        term.info("Metrics dump written to %s", term.green(path))
+    except OSError as e:
+        term.error("cannot write --stats-json %s: %s", path, e)
 
 
 async def run_async(
@@ -376,7 +413,25 @@ async def _run_async_inner(
             term.info("note: --timestamps prefixes are part of the line "
                       "--match/--exclude see (anchor accordingly)")
 
-        pipeline = make_pipeline_for(opts)
+        # Observability (opt-in): one registry backs the pipeline
+        # stats, the fan-out instrumentation, and — with
+        # --metrics-port — a live /metrics + /healthz HTTP sidecar.
+        # Per-RUN (not the process-global obs.REGISTRY): a second
+        # run_async in the same process must not inherit the first
+        # run's counters into its summary/dump.
+        obs_registry = None
+        metrics_srv = None
+        if opts.metrics_port is not None or opts.stats_json is not None:
+            from klogs_tpu import obs
+
+            obs_registry = obs.Registry()
+            obs.register_all(obs_registry)
+            from klogs_tpu.version import BUILD_VERSION as _ver
+
+            obs_registry.family("klogs_build_info").labels(
+                version=_ver).set(1)
+
+        pipeline = make_pipeline_for(opts, registry=obs_registry)
         inner_factory = make_inner_sink_factory(opts)
         try:
             if pipeline is not None:
@@ -387,7 +442,31 @@ async def _run_async_inner(
                 sink_factory=(pipeline.sink_factory if pipeline
                               else inner_factory),
                 create_files=opts.output != "stdout",
+                registry=obs_registry,
             )
+            if opts.metrics_port is not None:
+                from klogs_tpu import obs
+
+                health = obs.Health()
+                # The collector has no cold-start compile gate of its
+                # own (the engine warms on first batch; a --remote
+                # engine warms in filterd): it is ready once streaming
+                # is set up.
+                health.set_ready()
+                health.add_live_check("runner",
+                                      lambda: not runner._stopping)
+                metrics_srv = obs.MetricsHTTPServer(
+                    obs_registry, health=health, port=opts.metrics_port)
+                try:
+                    bound_metrics = await metrics_srv.start()
+                except OSError as e:
+                    # Friendly one-liner like every other bad-flag
+                    # path, not a traceback out of asyncio.run.
+                    term.fatal("cannot bind --metrics-port %s: %s",
+                               opts.metrics_port, e)
+                term.info("Metrics on %s",
+                          term.green(f"http://127.0.0.1:{bound_metrics}"
+                                     "/metrics"))
             # --watch-new: stern-style dynamic discovery. Only a
             # NON-interactive selection can be re-planned (the user's
             # one-off multiselect cannot); re-run the same -a/-l
@@ -507,6 +586,8 @@ async def _run_async_inner(
                 print_log_size(log_files, opts.log_path)
             if pipeline is not None and opts.stats:
                 pipeline.print_summary()
+            if opts.stats_json is not None:
+                _write_stats_json(opts.stats_json, obs_registry, pipeline)
             # Interrupted-but-graceful: everything is flushed and
             # reported, yet scripts still see the conventional 130.
             return 130 if interrupted else 0
@@ -514,6 +595,8 @@ async def _run_async_inner(
             # Close inside the loop even on error/Ctrl-C paths — an
             # unawaited grpc channel or in-flight batch task would be
             # destroyed pending at loop teardown.
+            if metrics_srv is not None:
+                await metrics_srv.stop()
             if pipeline is not None:
                 await pipeline.aclose()
     finally:

@@ -42,6 +42,9 @@ New (north-star) flags, absent from the reference:
   --remote          gate writes via a klogs-filterd service (gRPC)
   --profile         write a JAX profiler trace of the run to DIR
   --stats           print lines/sec, matched %, batch-latency summary
+  --metrics-port    serve Prometheus /metrics + /healthz for this run
+                    (obs subsystem; see docs/OBSERVABILITY.md)
+  --stats-json      one-shot JSON metrics dump at exit (non-server runs)
   --cluster         cluster backend: kube (real) | fake (hermetic demo)
 """
 
@@ -73,6 +76,8 @@ class Options:
     backend: str = "cpu"
     remote: str | None = None
     stats: bool = False
+    metrics_port: int | None = None
+    stats_json: str | None = None
     profile: str | None = None
     cluster: str = "kube"
     watch_new: bool = False
@@ -185,6 +190,23 @@ def build_parser() -> argparse.ArgumentParser:
         help="Print lines/sec, matched %%, and batch-latency summary",
     )
     p.add_argument(
+        "--metrics-port",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="Serve Prometheus /metrics and /healthz for this run on "
+        "an HTTP sidecar port (0 = ephemeral; binds 127.0.0.1). See "
+        "docs/OBSERVABILITY.md for the metric inventory",
+    )
+    p.add_argument(
+        "--stats-json",
+        default=None,
+        dest="stats_json",
+        metavar="PATH",
+        help="Write a one-shot JSON dump of all pipeline metrics to "
+        "PATH at exit (the scrapeless option for batch runs)",
+    )
+    p.add_argument(
         "-o",
         "--output",
         choices=["files", "stdout", "both"],
@@ -289,6 +311,8 @@ def parse_args(argv: list[str] | None = None) -> Options:
         backend=ns.backend,
         remote=ns.remote,
         stats=ns.stats,
+        metrics_port=ns.metrics_port,
+        stats_json=ns.stats_json,
         profile=ns.profile,
         cluster=ns.cluster,
         watch_new=ns.watch_new,

@@ -64,6 +64,13 @@ DEFAULT_FETCH_WORKERS = _env_int("KLOGS_FETCH_WORKERS", 8)
 DEFAULT_COALESCE_LINES = _env_int("KLOGS_COALESCE_LINES", 8192)
 DEFAULT_COALESCE_DELAY_S = 0.005
 
+# Offsets ride int32: a coalesced group whose combined payload passes
+# this would wrap member offset shifts into negative values (the C
+# validators then fail the WHOLE group with an obscure range error).
+# Groups are split below the limit instead. Module-level so tests can
+# exercise the split without allocating 2 GiB.
+GROUP_PAYLOAD_LIMIT = 2**31 - 1
+
 
 class AsyncFilterService:
     def __init__(self, log_filter: LogFilter,
@@ -76,6 +83,23 @@ class AsyncFilterService:
         # Optional split-latency recording (queue wait vs device time) so
         # --stats can tell saturation queueing from engine latency.
         self._stats = stats
+        # Coalescer instrumentation rides the stats' registry (one
+        # source of truth with the /metrics scrape); stats=None keeps
+        # the zero-overhead path.
+        self._m = None
+        if stats is not None:
+            r = stats.registry
+            self._m = {
+                "depth": r.family("klogs_coalescer_queue_depth"),
+                "pending": r.family("klogs_coalescer_pending_lines"),
+                "groups": r.family("klogs_coalescer_groups_total"),
+                "members": r.family("klogs_coalescer_group_members"),
+                "lines": r.family("klogs_coalescer_group_lines"),
+                "splits": r.family("klogs_coalescer_group_splits_total"),
+                "bp_wait": r.family(
+                    "klogs_coalescer_backpressure_wait_seconds"),
+                "dispatch": r.family("klogs_coalescer_dispatch_seconds"),
+            }
         self._sem = asyncio.Semaphore(max_in_flight)
         self._pool = ThreadPoolExecutor(
             max_workers=fetch_workers, thread_name_prefix="klogs-fetch"
@@ -125,6 +149,9 @@ class AsyncFilterService:
         fut: asyncio.Future = loop.create_future()
         self._pending.append((payload, offsets, n, fut, time.perf_counter()))
         self._pending_lines += n
+        if self._m is not None:
+            self._m["depth"].set(len(self._pending))
+            self._m["pending"].set(self._pending_lines)
         if self._pending_lines >= self._coalesce_lines:
             self._kick(loop)
         elif self._kick_handle is None:
@@ -141,6 +168,9 @@ class AsyncFilterService:
             return
         group, self._pending = self._pending, []
         self._pending_lines = 0
+        if self._m is not None:
+            self._m["depth"].set(0)
+            self._m["pending"].set(0)
         task = loop.create_task(self._run_group(group))
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)
@@ -149,6 +179,27 @@ class AsyncFilterService:
         import numpy as np
 
         loop = asyncio.get_running_loop()
+        if len(group) > 1 and (
+                sum(len(e[0]) for e in group) > GROUP_PAYLOAD_LIMIT):
+            # A concatenated payload past int32 would wrap the member
+            # offset shifts below into negative values. Split into
+            # subgroups under the limit (each member is itself bounded:
+            # frame_lines and the framed-wire decode both reject >int32
+            # single batches) and run them sequentially — correctness
+            # over peak batch size in this pathological regime.
+            subs, sub, size = [], [], 0
+            for e in group:
+                if sub and size + len(e[0]) > GROUP_PAYLOAD_LIMIT:
+                    subs.append(sub)
+                    sub, size = [], 0
+                sub.append(e)
+                size += len(e[0])
+            subs.append(sub)
+            if self._m is not None:
+                self._m["splits"].inc(len(subs) - 1)
+            for sub in subs:
+                await self._run_group(sub)
+            return
         if len(group) == 1:
             payload, offsets = group[0][0], group[0][1]
         else:
@@ -164,14 +215,23 @@ class AsyncFilterService:
             parts.append(np.asarray([base], dtype=np.int32))
             offsets = np.concatenate(parts)
         try:
+            t_sem = time.perf_counter()
             async with self._sem:
                 t_dispatch = time.perf_counter()
                 if self._stats is not None:
                     self._stats.mark_batch_started(t_dispatch)
                     for *_, enq in group:
                         self._stats.record_queue_wait(t_dispatch - enq)
+                if self._m is not None:
+                    self._m["bp_wait"].observe(t_dispatch - t_sem)
+                    self._m["groups"].inc()
+                    self._m["members"].observe(len(group))
+                    self._m["lines"].observe(len(offsets) - 1)
                 handle = self._filter.dispatch_framed(payload, offsets)
                 self.batches_dispatched += 1
+                if self._m is not None:
+                    self._m["dispatch"].observe(
+                        time.perf_counter() - t_dispatch)
                 verdicts = await loop.run_in_executor(
                     self._pool, self._filter.fetch_framed, handle
                 )

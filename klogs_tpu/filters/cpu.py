@@ -153,9 +153,12 @@ def best_host_filter(patterns: list[str], ignore_case: bool = False):
         if choice == "dfa":
             raise
     # A combined alternation RENUMBERS groups, so numbered/named
-    # backreferences would silently bind to the wrong group and drop
-    # lines — those sets stay on the K-sequential engine.
-    if any(re.search(r"\\[1-9]|\(\?P=", p) for p in patterns):
+    # backreferences — and conditional group references (?(1)...) /
+    # (?(name)...), which bind by the same numbering — would silently
+    # resolve to the wrong group and drop lines (ADVICE r5 repro:
+    # ['(x)y', '(a)?b(?(1)c|d)'] on b'abc'). Those sets stay on the
+    # K-sequential engine.
+    if any(re.search(r"\\[1-9]|\(\?P=|\(\?\(", p) for p in patterns):
         return RegexFilter(patterns, ignore_case=ignore_case), "re"
     try:
         return (CombinedRegexFilter(patterns, ignore_case=ignore_case),

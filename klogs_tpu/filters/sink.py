@@ -189,6 +189,9 @@ class FilteredSink(Sink):
             and self._pending_since is not None
             and time.perf_counter() - self._pending_since >= self._deadline_s
         ):
+            # Deadline-forced (not size-triggered) flushes are the
+            # latency-bound signal operators size batch_lines by.
+            self._stats.record_deadline_flush()
             await self._flush_pending()
             # Live tailing: matched lines must reach the file, not sit in
             # the inner sink's write buffer.
@@ -353,8 +356,12 @@ def make_pipeline(patterns: list[str], backend: str,
                   deadline_s: float = 0.05,
                   remote: str | None = None,
                   ignore_case: bool = False,
-                  exclude: list[str] | None = None) -> FilterPipeline:
-    stats = FilterStats()
+                  exclude: list[str] | None = None,
+                  registry=None) -> FilterPipeline:
+    # ``registry`` (an obs.Registry) shares the stats backing store
+    # with a /metrics sidecar or --stats-json dump; None keeps the
+    # pipeline's numbers private (default, and what tests rely on).
+    stats = FilterStats(registry=registry)
     service = None
     exclude = exclude or []
     if remote is not None:

@@ -169,7 +169,11 @@ class NFAEngineFilter(LogFilter):
         self._dp = nfa.pack_program(self._prog)
         self._chunk_bytes = chunk_bytes
         self._engine = engine  # optional parallel engine (klogs_tpu.parallel)
-        self._stats = stats  # optional FilterStats for prefilter visibility
+        self._stats = stats  # optional FilterStats for engine visibility
+        # Batch geometries already traced: a new (width, rows) pair is
+        # one jit compile — surfaced as a compile-event counter so an
+        # operator can see shape churn (each event is a latency cliff).
+        self._shapes_seen: set[tuple[int, int]] = set()
 
         # Execution path for the hot op: the Pallas kernel on real TPU,
         # the jnp/lax.scan path elsewhere (identical semantics; the
@@ -267,6 +271,19 @@ class NFAEngineFilter(LogFilter):
     def match_lines(self, lines: list[bytes]) -> list[bool]:
         return self.fetch(self.dispatch(lines))
 
+    def _record_sub_batch(self, width: int, rows: int,
+                          payload_bytes: int) -> None:
+        """Engine-layer instrumentation per width-bucketed sub-batch:
+        bucket-width distribution, padding waste, and first-seen shape
+        (≈ jit compile) events. No-op without a stats object."""
+        if self._stats is None:
+            return
+        self._stats.record_engine_batch(width, rows, payload_bytes)
+        key = (width, rows)
+        if key not in self._shapes_seen:
+            self._shapes_seen.add(key)
+            self._stats.record_compile()
+
     def _cls_args(self):
         """(table, begin, end, pad) for the active host-classify path."""
         if self._engine is not None:
@@ -310,23 +327,30 @@ class NFAEngineFilter(LogFilter):
         short = lens <= self._chunk_bytes
         if short.any():
             # Power-of-two width bucket per row (jit-cache discipline,
-            # same buckets as the list path). Raw lengths may include a
-            # trailing newline the C packer strips — the only effect is
-            # an occasional one-bucket-up pad, never a wrong width.
-            width_of = np.full(n, MIN_BUCKET, dtype=np.int64)
+            # same buckets as the list path: every assignment clamps to
+            # chunk_bytes exactly like _bucket_len, or a non-power-of-
+            # two chunk_bytes would mint an EXTRA jit shape above it
+            # and pad every top-bucket row past the chunk width). Raw
+            # lengths may include a trailing newline the C packer
+            # strips — the only effect is an occasional one-bucket-up
+            # pad, never a wrong width.
+            chunk = self._chunk_bytes
+            width_of = np.full(n, min(MIN_BUCKET, chunk), dtype=np.int64)
             w = MIN_BUCKET
-            while w < self._chunk_bytes and bool((short & (lens > w)).any()):
+            while w < chunk and bool((short & (lens > w)).any()):
                 w *= 2
-                width_of[lens > w // 2] = w
+                width_of[lens > w // 2] = min(w, chunk)
             tab, bc, ec, pc = self._cls_args()
             tab_b = tab.tobytes()
             for w in np.unique(width_of[short]):
                 sel = np.nonzero(short & (width_of == w))[0].astype(np.int32)
+                rows = _bucket_batch(len(sel))
                 buf, _ = hostops.pack_classify_framed(
                     payload, offsets, n, sel.tobytes(), int(w),
-                    _bucket_batch(len(sel)), tab_b, bc, ec, pc)
+                    rows, tab_b, bc, ec, pc)
                 cls = np.frombuffer(buf, dtype=np.int8).reshape(
                     -1, int(w) + 3)
+                self._record_sub_batch(int(w), rows, int(lens[sel].sum()))
                 parts.append((sel, *self._match_cls_device(cls)))
         if not bool(short.all()):
             rest = np.nonzero(~short)[0]
@@ -374,6 +398,8 @@ class NFAEngineFilter(LogFilter):
                        and getattr(self, "_cls_table", None) is not None)
         for width, idxs in buckets.items():
             sub = [bodies[i] for i in idxs]
+            self._record_sub_batch(width, _bucket_batch(len(sub)),
+                                   sum(len(b) for b in sub))
             if use_cls:
                 parts.append((idxs, *self._match_cls_dispatch(sub, width)))
             else:

@@ -1,0 +1,26 @@
+"""klogs_tpu.obs — the observability subsystem.
+
+Dependency-free metrics core (Counter/Gauge/Histogram/Registry),
+Prometheus text exposition, JSON snapshots, and the /metrics + /healthz
+HTTP sidecar. The metric inventory (names, types, help, buckets) lives
+in obs.inventory and is linted against docs/OBSERVABILITY.md by
+tools/check_metrics_docs.py.
+"""
+
+from klogs_tpu.obs.expo import render, snapshot
+from klogs_tpu.obs.http import Health, MetricsHTTPServer
+from klogs_tpu.obs.inventory import SPECS, register_all
+from klogs_tpu.obs.metrics import (
+    REGISTRY,
+    Counter,
+    Family,
+    Gauge,
+    Histogram,
+    Registry,
+)
+
+__all__ = [
+    "REGISTRY", "Registry", "Family", "Counter", "Gauge", "Histogram",
+    "Health", "MetricsHTTPServer", "SPECS", "register_all", "render",
+    "snapshot",
+]

@@ -1,0 +1,85 @@
+"""Prometheus text exposition (format 0.0.4) + JSON snapshots.
+
+``render`` walks a Registry into the text format a Prometheus scrape
+expects; ``snapshot`` produces the JSON-able dict behind the CLI's
+``--stats-json`` one-shot dump. Both read the same families — there is
+no second bookkeeping path to drift.
+"""
+
+
+def _fmt(v) -> str:
+    """Numbers render canonically: integral floats without the '.0'
+    (Prometheus parsers take either; goldens want stability)."""
+    if isinstance(v, float) and v == int(v) and abs(v) < 2**53:
+        return str(int(v))
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+def _escape_help(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label(s: str) -> str:
+    return (s.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _labelstr(names, values, extra=()) -> str:
+    pairs = [(n, v) for n, v in zip(names, values)]
+    pairs.extend(extra)
+    if not pairs:
+        return ""
+    body = ",".join(f'{n}="{_escape_label(str(v))}"' for n, v in pairs)
+    return "{" + body + "}"
+
+
+def render(registry) -> str:
+    """Registry -> Prometheus text exposition."""
+    out: list[str] = []
+    for fam in registry.collect():
+        out.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
+        out.append(f"# TYPE {fam.name} {fam.type}")
+        for labelvalues, child in fam.children():
+            lab = _labelstr(fam.labelnames, labelvalues)
+            if fam.type == "histogram":
+                counts, total, n = child.snapshot()
+                cum = 0
+                for bound, c in zip(child.buckets, counts):
+                    cum += c
+                    le = _labelstr(fam.labelnames, labelvalues,
+                                   extra=(("le", _fmt(float(bound))),))
+                    out.append(f"{fam.name}_bucket{le} {cum}")
+                inf = _labelstr(fam.labelnames, labelvalues,
+                                extra=(("le", "+Inf"),))
+                out.append(f"{fam.name}_bucket{inf} {n}")
+                out.append(f"{fam.name}_sum{lab} {_fmt(total)}")
+                out.append(f"{fam.name}_count{lab} {n}")
+            else:
+                out.append(f"{fam.name}{lab} {_fmt(child.value)}")
+    return "\n".join(out) + "\n"
+
+
+def snapshot(registry) -> dict:
+    """Registry -> JSON-able dict (--stats-json). Histograms carry
+    bucket bounds/counts plus sum/count; labeled families list one
+    entry per child."""
+    doc: dict = {}
+    for fam in registry.collect():
+        samples = []
+        for labelvalues, child in fam.children():
+            labels = dict(zip(fam.labelnames, labelvalues))
+            if fam.type == "histogram":
+                counts, total, n = child.snapshot()
+                sample = {"buckets": dict(zip(
+                    (_fmt(float(b)) for b in child.buckets), counts)),
+                    "sum": total, "count": n,
+                    "p50": child.percentile(50),
+                    "p99": child.percentile(99)}
+            else:
+                sample = {"value": child.value}
+            if labels:
+                sample["labels"] = labels
+            samples.append(sample)
+        doc[fam.name] = {"type": fam.type, "help": fam.help,
+                         "samples": samples}
+    return doc

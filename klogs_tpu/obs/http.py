@@ -1,0 +1,157 @@
+"""The /metrics + /healthz HTTP sidecar.
+
+A deliberately tiny asyncio HTTP/1.1 server (the environment bakes in
+no HTTP framework, and a scrape endpoint needs none): GET /metrics
+serves the Prometheus text exposition of one Registry; GET /healthz and
+GET /readyz serve the Health state as JSON.
+
+Liveness vs readiness (the kubelet distinction, and the reason one
+boolean is not enough during cold start): a filterd that is COMPILING
+its first kernel is alive — restarting it would only restart the
+compile — but not ready — routing traffic to it queues RPCs behind a
+multi-second jit trace. /healthz (liveness) answers "should this
+process be restarted?"; /readyz answers "should traffic be routed
+here?". Readiness flips when the warmup batch completes (engine warm +
+device reachable, proven by an actual round trip) and liveness checks
+keep watching the coalescer loop afterwards.
+"""
+
+import asyncio
+import json
+from typing import Callable
+
+from klogs_tpu.obs.expo import render
+
+_REQ_TIMEOUT_S = 5.0
+
+
+class Health:
+    """Named liveness/readiness checks + the explicit warm flag.
+
+    ``live_checks`` / ``ready_checks`` map name -> () -> bool; a check
+    that RAISES counts as failed (a health probe must never take the
+    process down). Readiness additionally requires ``set_ready()`` —
+    the cold-start gate the warmup batch flips.
+    """
+
+    def __init__(self):
+        self._ready = False
+        self.live_checks: dict[str, Callable[[], bool]] = {}
+        self.ready_checks: dict[str, Callable[[], bool]] = {}
+
+    def add_live_check(self, name: str, fn: Callable[[], bool]) -> None:
+        self.live_checks[name] = fn
+
+    def add_ready_check(self, name: str, fn: Callable[[], bool]) -> None:
+        self.ready_checks[name] = fn
+
+    def set_ready(self, ready: bool = True) -> None:
+        self._ready = ready
+
+    @staticmethod
+    def _run(checks) -> tuple[bool, dict]:
+        detail = {}
+        ok = True
+        for name, fn in checks.items():
+            try:
+                good = bool(fn())
+            except Exception:
+                good = False
+            detail[name] = good
+            ok = ok and good
+        return ok, detail
+
+    def liveness(self) -> tuple[bool, dict]:
+        ok, detail = self._run(self.live_checks)
+        return ok, {"live": ok, "ready": self._ready, "checks": detail}
+
+    def readiness(self) -> tuple[bool, dict]:
+        ok, detail = self._run(self.ready_checks)
+        ok = ok and self._ready
+        return ok, {"ready": ok, "warm": self._ready, "checks": detail}
+
+
+class MetricsHTTPServer:
+    """Serves one Registry (+ optional Health) over plain HTTP.
+
+    Binds 127.0.0.1 by default: metrics and health are operator
+    surfaces, exposed beyond localhost only by explicit host choice
+    (cluster deployments front this with the pod network, where the
+    scrape config in docs/OBSERVABILITY.md points)."""
+
+    def __init__(self, registry, health: "Health | None" = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.registry = registry
+        self.health = health
+        self.host = host
+        self.port = port
+        self._server: asyncio.AbstractServer | None = None
+
+    async def start(self) -> int:
+        """Bind + serve; returns the bound port (port=0 asks the OS)."""
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            line = await asyncio.wait_for(reader.readline(), _REQ_TIMEOUT_S)
+            parts = line.decode("latin-1", "replace").split()
+            if len(parts) < 2:
+                return
+            method, path = parts[0], parts[1].split("?", 1)[0]
+            # Drain headers (requests are tiny; bodies unsupported).
+            while True:
+                h = await asyncio.wait_for(reader.readline(),
+                                           _REQ_TIMEOUT_S)
+                if h in (b"\r\n", b"\n", b""):
+                    break
+            status, ctype, body = self._route(method, path)
+            head = (f"HTTP/1.1 {status}\r\n"
+                    f"Content-Type: {ctype}\r\n"
+                    f"Content-Length: {len(body)}\r\n"
+                    "Connection: close\r\n\r\n")
+            writer.write(head.encode("latin-1") + body)
+            await writer.drain()
+        except (asyncio.TimeoutError, ConnectionError):
+            pass
+        except Exception:
+            # E.g. a header line past the StreamReader limit raises
+            # ValueError. An operator surface must never let a garbage
+            # request propagate into 'Task exception was never
+            # retrieved' noise; drop the connection.
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    def _route(self, method: str, path: str) -> tuple[str, str, bytes]:
+        if method != "GET":
+            return ("405 Method Not Allowed", "text/plain; charset=utf-8",
+                    b"method not allowed\n")
+        if path == "/metrics":
+            body = render(self.registry).encode()
+            return ("200 OK",
+                    "text/plain; version=0.0.4; charset=utf-8", body)
+        if path in ("/healthz", "/readyz"):
+            if self.health is None:
+                return ("200 OK", "application/json",
+                        b'{"live": true}\n')
+            ok, doc = (self.health.liveness() if path == "/healthz"
+                       else self.health.readiness())
+            body = (json.dumps(doc) + "\n").encode()
+            return ("200 OK" if ok else "503 Service Unavailable",
+                    "application/json", body)
+        return ("404 Not Found", "text/plain; charset=utf-8",
+                b"try /metrics, /healthz, or /readyz\n")

@@ -1,0 +1,152 @@
+"""The metric inventory: every metric name this codebase can register.
+
+One table, five instrumented layers (engine, coalescer, sink, fanout,
+RPC) plus process-level info. Instrumented modules obtain families via
+``registry.family(name)`` which resolves through SPECS, so a name used
+anywhere in the code is guaranteed to carry the type/help/buckets
+documented here — and tools/check_metrics_docs.py fails tier-1 when a
+SPECS entry is missing from docs/OBSERVABILITY.md (or vice versa).
+
+Label cardinality rule: labels must be bounded by DEPLOYMENT SHAPE
+(method names, pod set, client hosts), never by traffic content (line
+text, pattern hits). Per-pod labels are acceptable at the reference's
+scale (hundreds of pods per collector); anything keyed by raw peer
+address is normalized to the host (ports churn per connection).
+"""
+
+from klogs_tpu.obs.metrics import LATENCY_BUCKETS
+
+# Power-of-two ladders matching the engine's bucketing discipline.
+WIDTH_BUCKETS = (128, 256, 512, 1024, 2048, 4096, 8192,
+                 16384, 32768, 65536, 131072)
+GROUP_MEMBER_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+GROUP_LINE_BUCKETS = (64, 256, 1024, 4096, 8192, 16384,
+                      65536, 262144, 1048576)
+
+
+def _m(mtype, help, labels=(), buckets=None):
+    spec = {"type": mtype, "help": help}
+    if labels:
+        spec["labels"] = tuple(labels)
+    if buckets is not None:
+        spec["buckets"] = tuple(buckets)
+    return spec
+
+
+SPECS: dict[str, dict] = {
+    # -- process ------------------------------------------------------
+    "klogs_build_info": _m(
+        "gauge", "Constant 1, labeled with the build version.",
+        labels=("version",)),
+
+    # -- sink layer (FilteredSink / FilterStats view) -----------------
+    "klogs_sink_lines_total": _m(
+        "counter", "Lines that entered the filter stage."),
+    "klogs_sink_lines_matched_total": _m(
+        "counter", "Lines the filter kept (written to the sink)."),
+    "klogs_sink_bytes_in_total": _m(
+        "counter", "Raw bytes entering the filter stage."),
+    "klogs_sink_bytes_out_total": _m(
+        "counter", "Bytes written after filtering."),
+    "klogs_sink_batches_total": _m(
+        "counter", "Filter batches flushed."),
+    "klogs_sink_batch_latency_seconds": _m(
+        "histogram", "End-to-end batch latency: enqueue to verdicts, "
+        "sink-observed.", buckets=LATENCY_BUCKETS),
+    "klogs_sink_deadline_flush_total": _m(
+        "counter", "Flushes forced by the follow-mode deadline rather "
+        "than batch-size."),
+
+    # -- coalescer layer (AsyncFilterService) -------------------------
+    "klogs_coalescer_queue_depth": _m(
+        "gauge", "Caller batches waiting to coalesce into a group."),
+    "klogs_coalescer_pending_lines": _m(
+        "gauge", "Lines waiting to coalesce into a group."),
+    "klogs_coalescer_queue_wait_seconds": _m(
+        "histogram", "Per-caller wait from enqueue to device dispatch "
+        "(coalesce window + backpressure).", buckets=LATENCY_BUCKETS),
+    "klogs_coalescer_groups_total": _m(
+        "counter", "Coalesced groups dispatched to the engine."),
+    "klogs_coalescer_group_members": _m(
+        "histogram", "Caller batches merged per coalesced group.",
+        buckets=GROUP_MEMBER_BUCKETS),
+    "klogs_coalescer_group_lines": _m(
+        "histogram", "Lines per coalesced group.",
+        buckets=GROUP_LINE_BUCKETS),
+    "klogs_coalescer_group_splits_total": _m(
+        "counter", "Groups split because the combined payload would "
+        "exceed int32 offsets (2 GiB)."),
+    "klogs_coalescer_backpressure_wait_seconds": _m(
+        "histogram", "Wait for an in-flight slot (max_in_flight "
+        "semaphore) before dispatch.", buckets=LATENCY_BUCKETS),
+    "klogs_coalescer_dispatch_seconds": _m(
+        "histogram", "Device dispatch (enqueue) cost per group — NOT "
+        "the round trip; see klogs_engine_device_batch_seconds.",
+        buckets=LATENCY_BUCKETS),
+
+    # -- engine layer (NFAEngineFilter / tune) ------------------------
+    "klogs_engine_device_batch_seconds": _m(
+        "histogram", "Dispatch-to-verdicts-fetched device round trip "
+        "per group.", buckets=LATENCY_BUCKETS),
+    "klogs_engine_compile_total": _m(
+        "counter", "New (width, rows) batch geometries first seen by "
+        "the engine — each is one jit trace/compile."),
+    "klogs_engine_bucket_width_bytes": _m(
+        "histogram", "Padded line-width bucket per dispatched "
+        "sub-batch.", buckets=WIDTH_BUCKETS),
+    "klogs_engine_pad_bytes_total": _m(
+        "counter", "Padding waste: bucketed tensor bytes minus payload "
+        "bytes."),
+    "klogs_engine_payload_bytes_total": _m(
+        "counter", "Useful payload bytes packed into device batches."),
+    "klogs_engine_prefilter_lines_total": _m(
+        "counter", "Lines through the gated (prefiltered) kernel."),
+    "klogs_engine_prefilter_candidates_total": _m(
+        "counter", "Prefilter candidate lines (tiles ran the scan)."),
+    "klogs_engine_prefilter_tiles_total": _m(
+        "counter", "Kernel tiles considered by the prefilter gate."),
+    "klogs_engine_prefilter_tiles_live_total": _m(
+        "counter", "Kernel tiles that actually ran the scan loop."),
+    "klogs_engine_tune_runs_total": _m(
+        "counter", "Autotune sweeps completed (ops.tune.tune_grouped)."),
+    "klogs_engine_tune_best_lines_per_second": _m(
+        "gauge", "Winning configuration's measured throughput from the "
+        "last autotune sweep."),
+
+    # -- fanout layer (FanoutRunner) ----------------------------------
+    "klogs_fanout_active_streams": _m(
+        "gauge", "Log streams currently open."),
+    "klogs_fanout_stream_bytes_total": _m(
+        "counter", "Bytes received per container stream.",
+        labels=("pod", "container")),
+    "klogs_fanout_reconnects_total": _m(
+        "counter", "Follow-mode stream reconnect attempts.",
+        labels=("pod", "container")),
+    "klogs_fanout_stream_errors_total": _m(
+        "counter", "Streams that ended with a terminal error."),
+    "klogs_fanout_backpressure_stalls_total": _m(
+        "counter", "Sink writes that blocked longer than the stall "
+        "threshold (downstream backpressure)."),
+
+    # -- RPC layer (filterd gRPC server) ------------------------------
+    "klogs_rpc_requests_total": _m(
+        "counter", "RPCs received, by method.", labels=("method",)),
+    "klogs_rpc_errors_total": _m(
+        "counter", "RPCs that failed (including aborts), by method.",
+        labels=("method",)),
+    "klogs_rpc_request_seconds": _m(
+        "histogram", "Server-side RPC handling latency, by method.",
+        labels=("method",), buckets=LATENCY_BUCKETS),
+    "klogs_rpc_client_requests_total": _m(
+        "counter", "RPCs per client HOST (peer address normalized to "
+        "drop the per-connection port).", labels=("client",)),
+}
+
+
+def register_all(registry) -> None:
+    """Instantiate every inventory family in ``registry`` so a scrape
+    exposes the full instrument panel (zero-valued where idle) from the
+    first request — an operator's dashboard never has to guess whether
+    a missing series means 'no traffic yet' or 'not instrumented'."""
+    for name in SPECS:
+        registry.family(name)

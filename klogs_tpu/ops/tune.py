@@ -68,7 +68,8 @@ def _store(dp, batch_shape, device_kind: str, cfg: dict) -> None:
 
 def tune_grouped(dp, live: int, acc: int, batch, lengths,
                  repeats: int = 3, n_flight: int = 6,
-                 runner=None, quiet: bool = False, cls=None) -> dict:
+                 runner=None, quiet: bool = False, cls=None,
+                 registry=None) -> dict:
     """Sweep the candidate grid on the live device; returns the winning
     {"tile_b", "interleave", "lines_per_s"} and caches it.
 
@@ -153,6 +154,16 @@ def tune_grouped(dp, live: int, acc: int, batch, lengths,
     if not results:
         raise RuntimeError("kernel tuning failed for every candidate config")
     best = max(results, key=lambda r: r["lines_per_s"])
+    # Sweep telemetry: into the caller's registry when one is threaded
+    # through (a process serving a sidecar should scrape its own tune
+    # events), else the process-global default for standalone
+    # bench/operator runs.
+    if registry is None:
+        from klogs_tpu.obs import REGISTRY as registry
+
+    registry.family("klogs_engine_tune_runs_total").inc()
+    registry.family("klogs_engine_tune_best_lines_per_second").set(
+        best["lines_per_s"])
     try:
         import jax
 

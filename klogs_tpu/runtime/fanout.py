@@ -40,6 +40,10 @@ RECONNECT_HEALTHY_S = 5.0
 _BACKOFF_BASE_S = 0.5
 _BACKOFF_MAX_S = 10.0
 
+# A sink.write blocking past this counts as a backpressure stall (the
+# downstream filter/file/console is the bottleneck, not the apiserver).
+STALL_THRESHOLD_S = 0.05
+
 
 @dataclass
 class StreamJob:
@@ -115,6 +119,7 @@ class FanoutRunner:
         open_burst: int = DEFAULT_OPEN_BURST,
         max_reconnects: int = DEFAULT_MAX_RECONNECTS,
         create_files: bool = True,
+        registry=None,
     ):
         self.backend = backend
         self.namespace = namespace
@@ -128,6 +133,20 @@ class FanoutRunner:
         # -o stdout streams to the console only: job paths stay as
         # stable (pod, container) identities but no file is touched.
         self.create_files = create_files
+        # Fan-out instrumentation (an obs.Registry, wired by --metrics-
+        # port / --stats-json); None keeps the zero-overhead path.
+        self._m = None
+        if registry is not None:
+            self._m = {
+                "active": registry.family("klogs_fanout_active_streams"),
+                "bytes": registry.family("klogs_fanout_stream_bytes_total"),
+                "reconnects": registry.family(
+                    "klogs_fanout_reconnects_total"),
+                "errors": registry.family(
+                    "klogs_fanout_stream_errors_total"),
+                "stalls": registry.family(
+                    "klogs_fanout_backpressure_stalls_total"),
+            }
 
     async def _worker(self, job: StreamJob) -> StreamResult:
         result = StreamResult(job=job)
@@ -141,6 +160,11 @@ class FanoutRunner:
             since_time=self.log_opts.since_time,
         )
         sink = self.sink_factory(job)
+        # Hoist the labeled children: the chunk loop must not pay a
+        # labels() dict hop per chunk.
+        m_bytes = (self._m["bytes"].labels(pod=job.pod,
+                                           container=job.container)
+                   if self._m is not None else None)
         attempt = 0
         # Last moment data was actually received, persisted ACROSS
         # reconnects: an unproductive reconnect must not advance it, or
@@ -170,6 +194,8 @@ class FanoutRunner:
                     await stream.close()
                     return result
                 self._streams.append(stream)
+                if self._m is not None:
+                    self._m["active"].inc()
                 opened_at = time.monotonic()
                 # Gap re-fetch must start at the LAST RECEIVED chunk, not
                 # the stream open: a long-lived healthy follow stream that
@@ -180,16 +206,32 @@ class FanoutRunner:
                 got_data = False
                 stream_err: StreamError | None = None
                 try:
-                    async for chunk in stream:
-                        got_data = True
-                        last_data = time.monotonic()
-                        await sink.write(chunk)
+                    if m_bytes is None:
+                        async for chunk in stream:
+                            got_data = True
+                            last_data = time.monotonic()
+                            await sink.write(chunk)
+                    else:
+                        stalls = self._m["stalls"]
+                        async for chunk in stream:
+                            got_data = True
+                            last_data = time.monotonic()
+                            m_bytes.inc(len(chunk))
+                            await sink.write(chunk)
+                            # A slow write = the filter/file/console is
+                            # the bottleneck, not the apiserver: the
+                            # operator's signal to scale the sink side.
+                            if (time.monotonic() - last_data
+                                    >= STALL_THRESHOLD_S):
+                                stalls.inc()
                 except StreamError as e:
                     stream_err = e
                 finally:
                     await stream.close()
                     try:
                         self._streams.remove(stream)
+                        if self._m is not None:
+                            self._m["active"].dec()
                     except ValueError:
                         pass
 
@@ -255,6 +297,8 @@ class FanoutRunner:
         finally:
             await sink.close()
             result.bytes_written = sink.bytes_written
+            if self._m is not None and result.error is not None:
+                self._m["errors"].inc()
 
     async def _should_reconnect(self, job: StreamJob, attempt: int,
                                 err: "StreamError | None") -> bool:
@@ -274,6 +318,9 @@ class FanoutRunner:
             await asyncio.wait_for(self._stop_event.wait(), timeout=delay)
             return False  # stop fired during backoff
         except asyncio.TimeoutError:
+            if not self._stopping and self._m is not None:
+                self._m["reconnects"].labels(
+                    pod=job.pod, container=job.container).inc()
             return not self._stopping
 
     def _create_file(self, job: StreamJob) -> None:

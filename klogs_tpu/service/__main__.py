@@ -36,6 +36,12 @@ def main() -> None:
     ap.add_argument("--auth-token-file",
                     help="file with a shared bearer token (e.g. a mounted "
                     "Kubernetes Secret); RPCs without it are rejected")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    metavar="PORT",
+                    help="serve Prometheus /metrics plus /healthz "
+                    "(liveness) and /readyz (readiness; unready until "
+                    "the warmup batch clears the cold-start compile) on "
+                    "this HTTP port (0 = ephemeral; binds 127.0.0.1)")
     ns = ap.parse_args()
     if ns.auth_token_file:
         # Fail fast on a bad path/empty file; the server re-reads the
@@ -52,7 +58,8 @@ def main() -> None:
                           tls_cert=ns.tls_cert, tls_key=ns.tls_key,
                           tls_client_ca=ns.tls_client_ca,
                           auth_token_file=ns.auth_token_file,
-                          exclude=ns.exclude))
+                          exclude=ns.exclude,
+                          metrics_port=ns.metrics_port))
     except KeyboardInterrupt:
         pass
     except RegexSyntaxError as e:  # subclasses ValueError: catch first

@@ -25,7 +25,9 @@ Partial TLS configuration (cert without key, client-ca without cert) is
 a constructor error, never a silent plaintext fallback.
 """
 
+import asyncio
 import hmac
+import time
 
 import grpc
 
@@ -36,19 +38,37 @@ from klogs_tpu.version import BUILD_VERSION
 
 def _make_filter(patterns: list[str], backend: str,
                  ignore_case: bool = False,
-                 exclude: "list[str] | None" = None):
+                 exclude: "list[str] | None" = None,
+                 stats=None):
     from klogs_tpu.filters.base import build_include_exclude
+
+    made = []
 
     def one(pats):
         if backend == "cpu":
             from klogs_tpu.filters.cpu import best_host_filter
 
-            return best_host_filter(pats, ignore_case=ignore_case)[0]
-        from klogs_tpu.filters.tpu import NFAEngineFilter
+            f = best_host_filter(pats, ignore_case=ignore_case)[0]
+        else:
+            from klogs_tpu.filters.tpu import NFAEngineFilter
 
-        return NFAEngineFilter(pats, ignore_case=ignore_case)
+            # Stats ride the first-built side only (≙ make_pipeline's
+            # rule: feeding both combiner inputs would double-count).
+            f = NFAEngineFilter(pats, ignore_case=ignore_case,
+                                stats=stats if not made else None)
+        made.append(f)
+        return f
 
     return build_include_exclude(one, patterns, exclude)
+
+
+def _client_host(peer: str) -> str:
+    """gRPC peer -> bounded-cardinality client label: the HOST only.
+    Ports churn per connection ('ipv4:127.0.0.1:54321'), so keeping
+    them would mint a new series per reconnect."""
+    if peer.startswith(("ipv4:", "ipv6:")):
+        return peer.split(":", 1)[1].rsplit(":", 1)[0]
+    return peer or "unknown"
 
 
 class FilterServer:
@@ -59,7 +79,10 @@ class FilterServer:
                  tls_client_ca: str | None = None,
                  auth_token: str | None = None,
                  auth_token_file: str | None = None,
-                 exclude: "list[str] | None" = None):
+                 exclude: "list[str] | None" = None,
+                 metrics_port: int | None = None,
+                 metrics_host: str = "127.0.0.1",
+                 registry=None):
         if bool(tls_cert) != bool(tls_key):
             raise ValueError(
                 "tls_cert and tls_key must be provided together "
@@ -81,9 +104,48 @@ class FilterServer:
         self.tls_client_ca = tls_client_ca
         self.auth_token = auth_token
         self.auth_token_file = auth_token_file
+        # Observability sidecar (opt-in, --metrics-port): the registry
+        # backs FilterStats AND the engine/coalescer/RPC families, so
+        # /metrics is one consistent panel over the live pipeline.
+        # Without it the server runs the zero-instrumentation path.
+        self.metrics_port = metrics_port
+        self.metrics_host = metrics_host
+        self.registry = None
+        self.health = None
+        self._stats = None
+        self._http = None
+        self._warmup_task: asyncio.Task | None = None
+        self._m_rpc = None
+        if metrics_port is not None:
+            from klogs_tpu import obs
+            from klogs_tpu.filters.base import FilterStats
+
+            # Per-SERVER registry by default: a restarted in-process
+            # filterd must not inherit the previous instance's
+            # counters into its /metrics.
+            self.registry = registry if registry is not None else obs.Registry()
+            # The whole inventory up front: a scrape during cold start
+            # already shows every layer's (zero-valued) families.
+            obs.register_all(self.registry)
+            self.registry.family("klogs_build_info").labels(
+                version=BUILD_VERSION).set(1)
+            self._stats = FilterStats(registry=self.registry)
+            self._m_rpc = {
+                "req": self.registry.family("klogs_rpc_requests_total"),
+                "err": self.registry.family("klogs_rpc_errors_total"),
+                "lat": self.registry.family("klogs_rpc_request_seconds"),
+                "client": self.registry.family(
+                    "klogs_rpc_client_requests_total"),
+            }
+            self.health = obs.Health()
+            # Liveness: the coalescer loop must still accept work —
+            # a closed service means restart; a merely-cold one does not.
+            self.health.add_live_check(
+                "coalescer", lambda: not self._service._closed)
         self._service = AsyncFilterService(
             _make_filter(patterns, backend, ignore_case=ignore_case,
-                         exclude=self.exclude))
+                         exclude=self.exclude, stats=self._stats),
+            stats=self._stats)
         self._server: grpc.aio.Server | None = None
 
     @property
@@ -116,6 +178,51 @@ class FilterServer:
         await context.abort(grpc.StatusCode.UNAUTHENTICATED,
                             "missing or wrong bearer token")
         return False  # unreachable; abort raises
+
+    def _instrumented(self, method: str, handler):
+        """RPC-layer metrics wrapper: requests/errors/latency by
+        method, plus per-client-host counts. Identity when metrics are
+        off (no per-RPC overhead)."""
+        if self._m_rpc is None:
+            return handler
+        m = self._m_rpc
+        req = m["req"].labels(method=method)
+        err = m["err"].labels(method=method)
+        lat = m["lat"].labels(method=method)
+
+        async def wrapped(request: bytes, context) -> bytes:
+            t0 = time.perf_counter()
+            req.inc()
+            m["client"].labels(
+                client=_client_host(context.peer() or "")).inc()
+            try:
+                return await handler(request, context)
+            except BaseException:
+                # Aborts (UNAUTHENTICATED / INVALID_ARGUMENT) raise
+                # through here too — they ARE failed RPCs.
+                err.inc()
+                raise
+            finally:
+                lat.observe(time.perf_counter() - t0)
+
+        return wrapped
+
+    async def _warmup(self) -> None:
+        """Cold-start gate behind /readyz: push one real (tiny) framed
+        batch through the coalescer and engine. Success proves the
+        engine compiled, the device answered, and the coalescer loop
+        runs — the three things 'ready' means here. Until then the
+        server is live but NOT ready (routing traffic to a compiling
+        filterd queues RPCs behind a multi-second jit trace)."""
+        from klogs_tpu.filters.base import frame_lines
+
+        try:
+            payload, offsets, _ = frame_lines([b"klogs-warmup probe"])
+            await self._service.match_framed(payload, offsets)
+            self.health.set_ready()
+        except Exception as e:
+            print(f"klogs filterd: warmup batch failed ({e}); "
+                  "/readyz stays unready", flush=True)
 
     async def _hello(self, request: bytes, context) -> bytes:
         await self._check_auth(context)
@@ -157,10 +264,12 @@ class FilterServer:
         handler = grpc.method_handlers_generic_handler(
             transport.SERVICE,
             {
-                "Hello": grpc.unary_unary_rpc_method_handler(self._hello),
-                "Match": grpc.unary_unary_rpc_method_handler(self._match),
+                "Hello": grpc.unary_unary_rpc_method_handler(
+                    self._instrumented("Hello", self._hello)),
+                "Match": grpc.unary_unary_rpc_method_handler(
+                    self._instrumented("Match", self._match)),
                 "MatchFramed": grpc.unary_unary_rpc_method_handler(
-                    self._match_framed),
+                    self._instrumented("MatchFramed", self._match_framed)),
             },
         )
         # Jumbo batches (thousands of long lines) exceed gRPC's 4 MB
@@ -200,12 +309,45 @@ class FilterServer:
         else:
             self.port = self._server.add_insecure_port(addr)
         await self._server.start()
+        if self.metrics_port is not None:
+            from klogs_tpu.obs import MetricsHTTPServer
+
+            self._http = MetricsHTTPServer(
+                self.registry, health=self.health,
+                host=self.metrics_host, port=self.metrics_port)
+            try:
+                self.metrics_port = await self._http.start()
+            except OSError as e:
+                # Unbindable metrics port: tear the already-started
+                # gRPC server down (serve()'s finally is not armed
+                # yet) and surface the friendly ValueError path.
+                self._http = None
+                await self._server.stop(0)
+                self._service.close()
+                raise ValueError(
+                    f"cannot bind metrics port "
+                    f"{self.metrics_host}:{self.metrics_port}: {e}") from e
+            # Readiness flips when the warmup batch lands — NOT here:
+            # /readyz during the cold-start compile must answer 503
+            # while /healthz already answers 200.
+            self._warmup_task = asyncio.get_running_loop().create_task(
+                self._warmup())
         return self.port
 
     async def wait(self) -> None:
         await self._server.wait_for_termination()
 
     async def stop(self, grace: float = 1.0) -> None:
+        if self._warmup_task is not None:
+            self._warmup_task.cancel()
+            try:
+                await self._warmup_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._warmup_task = None
+        if self._http is not None:
+            await self._http.stop()
+            self._http = None
         if self._server is not None:
             await self._server.stop(grace)
         self._service.close()
@@ -230,6 +372,10 @@ async def serve(patterns: list[str], backend: str, host: str, port: int,
     print(f"klogs filterd: serving {len(server.patterns)} pattern(s) "
           f"[{server.backend}] on {where} ({mode})",
           flush=True)
+    if server.metrics_port is not None:
+        print(f"klogs filterd: metrics on http://{server.metrics_host}:"
+              f"{server.metrics_port}/metrics (health: /healthz, "
+              "readiness: /readyz)", flush=True)
     try:
         await server.wait()
     finally:

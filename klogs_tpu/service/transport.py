@@ -88,6 +88,18 @@ def decode_framed_request(data: bytes):
     doc = unpack(data)
     n = int(doc["n"])
     payload = doc["data"]
+    if not isinstance(payload, (bytes, bytearray)):
+        # A msgpack str payload passes every offset check below
+        # (len() works on str) and would only blow up INSIDE the
+        # shared coalescer, failing innocent callers' RPCs (ADVICE
+        # r5, confirmed repro). Type-check here so it fails its own.
+        raise ValueError(
+            f"framed request: payload must be bytes, got "
+            f"{type(payload).__name__}")
+    if not isinstance(doc["offs"], (bytes, bytearray)):
+        raise ValueError(
+            f"framed request: offs must be bytes, got "
+            f"{type(doc['offs']).__name__}")
     offsets = np.frombuffer(doc["offs"], dtype=np.int32)
     if n < 0 or len(offsets) != n + 1:
         raise ValueError(

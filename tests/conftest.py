@@ -34,3 +34,19 @@ def _no_colors():
     term.set_colors(False)
     yield
     term.set_colors(None)
+
+
+async def http_get(port: int, path: str) -> tuple[int, bytes]:
+    """Raw-socket GET against a localhost obs sidecar -> (status,
+    body). Shared by test_obs and test_service so the sidecar's
+    response framing is asserted in exactly one shape."""
+    import asyncio
+
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    await writer.wait_closed()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    return int(head.split()[1]), body

@@ -124,6 +124,23 @@ def test_best_host_filter_ladder(monkeypatch):
         best_host_filter([r"(a)\1"])  # forced dfa on unsupported syntax
 
 
+def test_conditional_group_refs_stay_on_sequential_engine():
+    """(?(1)...) / (?(name)...) bind by group NUMBER/name, which a
+    combined alternation renumbers — the repro set silently dropped
+    b'abc' on CombinedRegexFilter (ADVICE r5). Such sets must stay on
+    the K-sequential engine, whose verdicts are the oracle."""
+    pats = ["(x)y", "(a)?b(?(1)c|d)"]
+    filt, kind = best_host_filter(pats)
+    assert kind == "re"
+    assert filt.match_lines([b"abc", b"xy", b"bd", b"abd", b"zzz"]) == [
+        RegexFilter(pats).match_lines([l])[0]
+        for l in (b"abc", b"xy", b"bd", b"abd", b"zzz")]
+    assert filt.match_lines([b"abc"]) == [True]  # the silent-drop repro
+    # Named conditionals take the same exit.
+    filt, kind = best_host_filter(["(?P<q>x)?y(?(q)z|w)"])
+    assert kind == "re"
+
+
 def test_property_dfa_vs_re_oracle():
     """Random pattern sets x random lines: the DFA agrees with the
     K-sequential `re` oracle wherever the compiler subset admits the

@@ -67,6 +67,14 @@ def test_process_id_zero_not_treated_as_missing(record, monkeypatch):
     assert record == [("c:1", 2, 0)]
 
 
+# Capability probe result shared across the parametrizations: when the
+# installed jaxlib lacks CPU multiprocess collectives (gloo), the first
+# run discovers it and the rest skip instantly instead of re-spawning
+# workers that can only fail the same way.
+_MP_UNSUPPORTED = "Multiprocess computations aren't implemented"
+_mp_unsupported_seen = False
+
+
 @pytest.mark.parametrize("impl", ["gspmd", "shard_map"])
 def test_live_two_process_mesh_match(impl, tmp_path):
     """LIVE two-controller run (round-5): two real processes handshake
@@ -75,12 +83,15 @@ def test_live_two_process_mesh_match(impl, tmp_path):
     mask bit-for-bit. Round 4 recorded process_count()==1 here; the
     culprit was the ambient TPU platform plugin — with JAX_PLATFORMS
     pinned to cpu BEFORE backend init the handshake federates."""
+    global _mp_unsupported_seen
     import json
     import os
     import socket
     import subprocess
     import sys
 
+    if _mp_unsupported_seen:
+        pytest.skip("jaxlib lacks CPU multiprocess collectives (gloo)")
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -110,6 +121,11 @@ def test_live_two_process_mesh_match(impl, tmp_path):
         if p.returncode != 0:
             fail.append(f"pid{pid} rc={p.returncode}: "
                         f"{stdout.decode()[-800:]}")
+    if fail and all(_MP_UNSUPPORTED in f for f in fail):
+        # Environment gate, not a regression: this jaxlib build cannot
+        # run cross-process CPU collectives at all.
+        _mp_unsupported_seen = True
+        pytest.skip("jaxlib lacks CPU multiprocess collectives (gloo)")
     assert not fail, "\n".join(fail)
 
     docs = [json.loads(out.read_text()) for out in outs]

@@ -324,6 +324,46 @@ def test_malformed_framed_request_rejected_cleanly():
     assert got.tolist() == [True, False]
 
 
+def test_framed_request_rejects_str_payload():
+    """A msgpack STR payload passes every offset check (len() works on
+    str) and used to reach the shared coalescer, where the group concat
+    blew up for every coalesced collector (ADVICE r5, confirmed repro).
+    It must be rejected at decode so only its own RPC fails."""
+    grpc = pytest.importorskip("grpc")
+    from klogs_tpu.service import transport
+    from klogs_tpu.service.client import RemoteFilterClient
+    from klogs_tpu.service.server import FilterServer
+
+    offs = np.array([0, 5, 7], dtype=np.int32)
+    # Decode-level: str payload and str offs both fail loudly.
+    for doc in ({"n": 2, "offs": offs.tobytes(), "data": "ERRORxy"},
+                {"n": 2, "offs": "not-bytes", "data": b"ERRORxy"}):
+        with pytest.raises(ValueError, match="must be bytes"):
+            transport.decode_framed_request(transport.pack(doc))
+
+    async def run():
+        server = FilterServer(PATTERNS, backend="cpu", port=0)
+        port = await server.start()
+        client = RemoteFilterClient(f"127.0.0.1:{port}")
+        try:
+            await client.hello()
+            req = transport.pack({"n": 2, "offs": offs.tobytes(),
+                                  "data": "ERRORxy"})  # str, not bin
+            with pytest.raises(grpc.aio.AioRpcError) as ei:
+                await client._match_framed_rpc(req)
+            assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+            # The shared coalescer survives: a well-formed batch from
+            # an innocent caller still round-trips.
+            good = await client.match_framed(b"ERRORxy", offs)
+            return good
+        finally:
+            await client.aclose()
+            await server.stop()
+
+    got = asyncio.run(run())
+    assert got.tolist() == [True, False]
+
+
 def test_find_newlines_and_framed_batcher():
     if native.hostops is None:
         pytest.skip("native extension unavailable")

@@ -239,9 +239,12 @@ def test_bad_token_surfaces_as_stream_error_on_logs(tmp_path):
 
 
 def _self_signed_ca() -> bytes:
-    """Throwaway self-signed cert to exercise the CA-loading path."""
+    """Throwaway self-signed cert to exercise the CA-loading path.
+    Skips (not errors) where the optional ``cryptography`` package is
+    absent — the CA-loading path itself needs no such dependency."""
     import datetime
 
+    pytest.importorskip("cryptography")
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import ec

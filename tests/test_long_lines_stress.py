@@ -57,7 +57,11 @@ def test_pattern_spanning_many_chunks():
     assert f.match_lines([good, bad]) == expect == [True, False]
 
 
-@pytest.mark.parametrize("kernel", ["jnp", "interpret"])
+@pytest.mark.parametrize("kernel", [
+    "jnp",
+    # interpret runs the same routing ~90s slower; tier-1 keeps jnp.
+    pytest.param("interpret", marks=pytest.mark.slow),
+])
 def test_huge_lines_route_to_seqscan(kernel, monkeypatch):
     """Lines past SEQ_SCAN_BYTES take the sequence-parallel path and
     still agree with the host regex, mixed with short/long lines."""

@@ -96,6 +96,7 @@ def test_sharded_chunked_budget():
                               step_bytes_budget=1 << 16) is False
 
 
+@pytest.mark.slow  # ~100s: tier-1 keeps test_property_vs_oracle instead
 def test_match_lines_scan_batched_vs_oracle():
     """Concurrent jumbo lines of mixed sizes: one vmapped program per
     chunk-count bucket, verdicts equal to re."""
@@ -121,6 +122,8 @@ def test_match_lines_scan_batched_vs_oracle():
     assert got == exp
 
 
+@pytest.mark.slow  # ~125s; the one-dispatch invariant also rides
+# test_engine_filter_concurrent_huge_lines in tier-1
 def test_match_lines_scan_single_program_per_bucket(monkeypatch):
     """>=8 concurrent jumbo lines in one size bucket must produce ONE
     device program invocation (no per-line dispatch/recompile)."""

@@ -332,6 +332,92 @@ def test_exclude_patterns_over_service():
     asyncio.run(run())
 
 
+from tests.conftest import http_get as _http_get  # noqa: E402
+
+
+def test_metrics_scrape_on_live_coalesced_server():
+    """The acceptance path: /metrics on a live filterd serving
+    coalesced framed batches is valid Prometheus exposition covering
+    all five instrumented layers, and /healthz (liveness) vs /readyz
+    (readiness) split correctly across the cold-start warmup."""
+    import threading
+
+    import numpy as np
+
+    from klogs_tpu.filters.base import frame_lines
+    from klogs_tpu.obs import Registry
+
+    reg = Registry()  # private: exact-count assertions stay hermetic
+
+    async def run():
+        server = FilterServer(PATTERNS, backend="cpu", port=0,
+                              metrics_port=0, registry=reg)
+        # Deterministic cold start: gate the engine's first fetch so
+        # the warmup batch (and therefore readiness) waits on us.
+        release = threading.Event()
+        engine = server._service._filter
+        orig_fetch = engine.fetch_framed
+        gated = [True]
+
+        def gated_fetch(handle):
+            if gated[0]:
+                gated[0] = False
+                release.wait(5)
+            return orig_fetch(handle)
+
+        engine.fetch_framed = gated_fetch
+        port = await server.start()
+        mport = server.metrics_port
+        clients = []
+        try:
+            # Mid-"compile": alive (don't restart) but NOT ready
+            # (don't route) — the cold-start distinction.
+            status, body = await _http_get(mport, "/healthz")
+            assert status == 200
+            status, body = await _http_get(mport, "/readyz")
+            assert status == 503
+            release.set()
+            await asyncio.wait_for(server._warmup_task, 10)
+            status, _ = await _http_get(mport, "/readyz")
+            assert status == 200
+
+            # Concurrent collectors shipping framed batches -> one
+            # coalesced device group on the server.
+            clients = [RemoteFilterClient(f"127.0.0.1:{port}")
+                       for _ in range(3)]
+            batches = [[b"an ERROR %d" % i, b"fine %d" % i]
+                       for i in range(3)]
+            results = await asyncio.gather(*[
+                c.match_framed(*frame_lines(b)[:2])
+                for c, b in zip(clients, batches)])
+            for got in results:
+                assert got.tolist() == [True, False]
+
+            status, body = await _http_get(mport, "/metrics")
+            assert status == 200
+            text = body.decode()
+            # All five instrumented layers in one exposition.
+            for layer in ("klogs_engine_", "klogs_coalescer_",
+                          "klogs_sink_", "klogs_fanout_", "klogs_rpc_"):
+                assert layer in text, f"{layer} missing from scrape"
+            # ...and live values, not just registered families.
+            assert reg.family("klogs_rpc_requests_total").labels(
+                method="MatchFramed").value == 3
+            assert reg.family("klogs_coalescer_groups_total").value >= 1
+            # warmup + client batches all crossed the engine
+            assert reg.family(
+                "klogs_engine_device_batch_seconds").count >= 2
+            assert 'klogs_rpc_requests_total{method="MatchFramed"} 3' \
+                in text
+            assert "klogs_build_info" in text
+        finally:
+            for c in clients:
+                await c.aclose()
+            await server.stop()
+
+    asyncio.run(run())
+
+
 def test_exclude_only_service():
     async def run():
         server = FilterServer([], backend="cpu", port=0, exclude=["debug"])

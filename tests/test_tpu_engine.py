@@ -50,6 +50,41 @@ def test_mixed_length_bucketing():
     assert f.match_lines(lines) == expect
 
 
+def test_framed_bucket_widths_clamped_to_chunk_bytes():
+    """dispatch_framed's width buckets must match _bucket_len exactly:
+    clamped to chunk_bytes, so a non-power-of-two chunk_bytes never
+    mints an EXTRA jit shape above it (extra compile + padding on every
+    top-bucket batch)."""
+    from klogs_tpu.filters.base import frame_lines
+    from klogs_tpu.filters.tpu import _bucket_len
+    from klogs_tpu.native import hostops
+
+    if hostops is None or not hasattr(hostops, "pack_classify_framed"):
+        pytest.skip("native framed packer unavailable")
+    f = NFAEngineFilter(["needle"], kernel="interpret", chunk_bytes=3000)
+    assert f._use_cls()
+    lines = [b"short needle", b"x" * 300 + b"needle",
+             b"y" * 2500 + b"needle", b"z" * 2999]
+    seen_widths = []
+    orig = hostops.pack_classify_framed
+
+    def spy(payload, offsets, n, sel, width, rows, *rest):
+        seen_widths.append(width)
+        return orig(payload, offsets, n, sel, width, rows, *rest)
+
+    hostops.pack_classify_framed = spy
+    try:
+        payload, offsets, _ = frame_lines(lines)
+        got = f.fetch_framed(f.dispatch_framed(payload, offsets))
+    finally:
+        hostops.pack_classify_framed = orig
+    assert got.tolist() == RegexFilter(["needle"]).match_lines(lines)
+    # Every bucket ≤ chunk_bytes, and each equals the list-path rule.
+    assert seen_widths and all(w <= 3000 for w in seen_widths)
+    assert sorted(seen_widths) == sorted(
+        {_bucket_len(len(ln), 3000) for ln in lines})
+
+
 def test_match_all_shortcut():
     f = NFAEngineFilter(["a|"])  # nullable alternative → match-all
     assert f.match_lines([b"", b"zzz", b"x" * 5000]) == [True, True, True]
