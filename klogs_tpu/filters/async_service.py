@@ -261,7 +261,11 @@ class AsyncFilterService:
             self._kick_handle = None
         if self._tasks:
             await asyncio.gather(*list(self._tasks), return_exceptions=True)
-        self._pool.shutdown(wait=True)
+        # All in-flight fetches were just gathered, so the join is
+        # near-instant — but it still joins threads, which must not
+        # happen on the event loop (every other stream's flush would
+        # stall behind it).
+        await asyncio.to_thread(self._pool.shutdown)
         self._filter.close()
 
     def close(self) -> None:

@@ -16,6 +16,7 @@ line (re.search semantics, unanchored).
 """
 
 import abc
+import threading
 import time
 
 
@@ -70,7 +71,11 @@ class FilterStats:
         # Warmup boundary: timestamp when the FIRST batch started
         # filtering. lines_per_sec measures from here, not from pipeline
         # construction — otherwise jit warmup deflates short runs
-        # (VERDICT r1).
+        # (VERDICT r1). Written by the dispatch loop AND by synchronous
+        # record_batch fallbacks that benches drive from plain threads,
+        # so the first-write race is settled under a lock (declared in
+        # the lock-discipline table, tools/analysis).
+        self._t_lock = threading.Lock()
         self.first_batch_started_at: float | None = None
 
     # -- counter views (the pre-registry attribute API) ---------------
@@ -116,15 +121,18 @@ class FilterStats:
         is not overstated by back-computing the start from the first
         completion (which credits the whole first-batch latency as
         warmup)."""
-        if self.first_batch_started_at is None:
-            self.first_batch_started_at = (
-                t if t is not None else time.perf_counter())
+        with self._t_lock:
+            if self.first_batch_started_at is None:
+                self.first_batch_started_at = (
+                    t if t is not None else time.perf_counter())
 
     def record_batch(self, n_lines: int, n_matched: int, n_bytes_in: int,
                      n_bytes_out: int, latency_s: float) -> None:
-        if self.first_batch_started_at is None:
-            # Fallback for synchronous paths that never mark dispatch.
-            self.first_batch_started_at = time.perf_counter() - latency_s
+        with self._t_lock:
+            if self.first_batch_started_at is None:
+                # Fallback for synchronous paths that never mark dispatch.
+                self.first_batch_started_at = (
+                    time.perf_counter() - latency_s)
         self._lines_in.inc(n_lines)
         self._lines_matched.inc(n_matched)
         self._bytes_in.inc(n_bytes_in)

@@ -162,7 +162,8 @@ def _compiler_fingerprint() -> str:
 _FINGERPRINT = _compiler_fingerprint()
 
 
-def _cache_path(patterns, ignore_case: bool, max_states: int) -> str:
+def _cache_path(patterns: "list[str]", ignore_case: bool,
+                max_states: int) -> str:
     import hashlib
     import os
 

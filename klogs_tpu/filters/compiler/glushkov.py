@@ -206,24 +206,24 @@ class _Builder:
         if isinstance(node, Alt):
             nulls, first, last = 0, [], []
             for part in node.parts:
-                n, f, l = self.visit(part)
+                n, f, la = self.visit(part)
                 nulls |= n
                 first += f
-                last += l
+                last += la
             return nulls, first, last
         if isinstance(node, Cat):
             nulls, first, last = _FULL, [], []
             for part in node.parts:
-                n, f, l = self.visit(part)
+                n, f, la = self.visit(part)
                 for i, ti in last:
                     for j, tj in f:
                         self.edge(i, j, ti & tj)
                 if nulls:  # prefix nullable: its bits constrain entry
                     first += [(j, tj & nulls) for j, tj in f if tj & nulls]
                 if n:  # part nullable: its bits constrain earlier exits
-                    last = l + [(i, ti & n) for i, ti in last if ti & n]
+                    last = la + [(i, ti & n) for i, ti in last if ti & n]
                 else:
-                    last = l
+                    last = la
                 # Empty match of the whole Cat: both sides empty on the
                 # SAME adjacency — intersect.
                 nulls &= n

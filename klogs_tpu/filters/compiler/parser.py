@@ -105,6 +105,20 @@ _DOT = _ALL_BYTES - {0x0A}  # '.' excludes \n (re default, no DOTALL)
 # per-pattern cap here and the union-automaton cap in glushkov.py.
 MAX_POSITIONS = 4096
 
+# Regex features that are valid `re` but OUTSIDE this compiler's
+# subset AND whose meaning depends on group NUMBERING: numbered
+# backreferences, named backreferences, and conditional group
+# references. They matter beyond "unsupported": when a pattern set
+# falls back to the host engines, a combined alternation
+# ``(?:p1)|(?:p2)`` RENUMBERS groups, silently resolving these to the
+# wrong group (the PR 3 ``(?(1))`` bug — lines dropped with no error).
+# ``best_host_filter`` (filters/cpu.py) builds its fallback classifier
+# from THIS tuple, and the ``dispatch-parity`` static-analysis pass
+# (tools/analysis) probes both sides, so the two feature tables cannot
+# drift apart again. Each token is one alternation branch of the
+# classifier regex.
+GROUP_REF_TOKENS = (r"\\[1-9]", r"\(\?P=", r"\(\?\(")
+
 
 def max_positions_cap() -> int:
     """Effective position cap (env override or MAX_POSITIONS). Read
@@ -136,7 +150,7 @@ def _casefold(s: frozenset) -> frozenset:
 
 
 class _Parser:
-    def __init__(self, pattern: str, ignore_case: bool = False):
+    def __init__(self, pattern: str, ignore_case: bool = False) -> None:
         # Patterns arrive as str from the CLI; we match raw bytes, so
         # encode utf-8 — the same bytes RegexFilter's re.compile(p.encode())
         # sees, making byte-wise parsing here exactly equivalent to the
@@ -168,7 +182,7 @@ class _Parser:
             )
         self.pos += 1
 
-    def _leaf(self, **kw) -> Sym:
+    def _leaf(self, **kw: object) -> Sym:
         self.n_leaves += 1
         if self.n_leaves > self.max_positions:
             raise RegexSyntaxError(

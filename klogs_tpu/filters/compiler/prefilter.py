@@ -130,7 +130,7 @@ def _alt_cnf(cnfs: list[frozenset]) -> frozenset:
     return acc
 
 
-def _summarize(node) -> _Summary:
+def _summarize(node: object) -> _Summary:
     if isinstance(node, (Epsilon, Boundary)):
         # \b/\B are zero-width: they preserve byte adjacency (a
         # mandatory pair across one remains mandatory) and add no

@@ -23,6 +23,13 @@ fallbacks keep full `re` syntax working).
 import re
 
 from klogs_tpu.filters.base import LogFilter
+from klogs_tpu.filters.compiler.parser import GROUP_REF_TOKENS
+
+# Renumbering-sensitive feature classifier for best_host_filter's
+# combined-alternation fallback, built from the compiler's own table
+# (one source of truth; the dispatch-parity pass in tools/analysis
+# keeps it honest — see docs/STATIC_ANALYSIS.md).
+_GROUP_REF_RE = re.compile("|".join(GROUP_REF_TOKENS))
 
 
 class RegexFilter(LogFilter):
@@ -158,7 +165,7 @@ def best_host_filter(patterns: list[str], ignore_case: bool = False):
     # resolve to the wrong group and drop lines (ADVICE r5 repro:
     # ['(x)y', '(a)?b(?(1)c|d)'] on b'abc'). Those sets stay on the
     # K-sequential engine.
-    if any(re.search(r"\\[1-9]|\(\?P=|\(\?\(", p) for p in patterns):
+    if any(_GROUP_REF_RE.search(p) for p in patterns):
         return RegexFilter(patterns, ignore_case=ignore_case), "re"
     try:
         return (CombinedRegexFilter(patterns, ignore_case=ignore_case),

@@ -18,6 +18,7 @@ stripped before matching, so ``$`` sees the logical end of line.
 """
 
 import os
+import threading
 
 import numpy as np
 
@@ -170,6 +171,13 @@ class NFAEngineFilter(LogFilter):
         self._chunk_bytes = chunk_bytes
         self._engine = engine  # optional parallel engine (klogs_tpu.parallel)
         self._stats = stats  # optional FilterStats for engine visibility
+        # Degrade flags and the jit-shape set are written by fetch-time
+        # retry closures running in AsyncFilterService's executor
+        # threads while the loop thread dispatches — mutations go under
+        # this lock (declared in the lock-discipline table,
+        # tools/analysis). Reads stay lock-free: a stale read of a
+        # monotonic degrade flag only delays the fallback one batch.
+        self._state_lock = threading.Lock()
         # Batch geometries already traced: a new (width, rows) pair is
         # one jit compile — surfaced as a compile-event counter so an
         # operator can see shape churn (each event is a latency cliff).
@@ -280,8 +288,10 @@ class NFAEngineFilter(LogFilter):
             return
         self._stats.record_engine_batch(width, rows, payload_bytes)
         key = (width, rows)
-        if key not in self._shapes_seen:
+        with self._state_lock:
+            first_seen = key not in self._shapes_seen
             self._shapes_seen.add(key)
+        if first_seen:
             self._stats.record_compile()
 
     def _cls_args(self):
@@ -507,7 +517,8 @@ class NFAEngineFilter(LogFilter):
             # operator asked to measure exactly that kernel; if it is
             # the async fault the rerun fails again and raises loudly).
             if record and chain_defaulted:
-                self._chain_fallback = True
+                with self._state_lock:
+                    self._chain_fallback = True
             return run_plain(dict(kw, mask_block=1) if chain_defaulted
                              else kw)
 
@@ -518,7 +529,8 @@ class NFAEngineFilter(LogFilter):
             # the prefilter); only degrade the chain if the plain rerun
             # also fails. np.asarray forces the rerun synchronous so a
             # second async fault surfaces here, not at the caller.
-            self._pf_tables = None
+            with self._state_lock:
+                self._pf_tables = None
             try:
                 return np.asarray(run_plain(kw))
             except Exception as e:
@@ -549,7 +561,8 @@ class NFAEngineFilter(LogFilter):
                 term.warning(
                     "prefiltered kernel unavailable (%s); "
                     "falling back to plain NFA", str(e)[:120])
-                self._pf_tables = None
+                with self._state_lock:
+                    self._pf_tables = None
         try:
             mask = run_plain(kw)
         except Exception as e:
@@ -601,7 +614,8 @@ class NFAEngineFilter(LogFilter):
 
             def plain_retry(record: bool = True):
                 if record:
-                    self._chain_fallback = True
+                    with self._state_lock:
+                        self._chain_fallback = True
                 return self._pallas.match_batch_grouped_pallas(
                     self._dp_grouped, self._g_live, self._g_acc,
                     batch, lengths, interpret=interpret,

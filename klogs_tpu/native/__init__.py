@@ -17,7 +17,6 @@ both paths).
 import hashlib
 import os
 import subprocess
-import sys
 import sysconfig
 
 _DIR = os.path.dirname(os.path.abspath(__file__))

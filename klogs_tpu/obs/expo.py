@@ -6,8 +6,13 @@ expects; ``snapshot`` produces the JSON-able dict behind the CLI's
 no second bookkeeping path to drift.
 """
 
+from typing import TYPE_CHECKING, Iterable, Sequence
 
-def _fmt(v) -> str:
+if TYPE_CHECKING:
+    from klogs_tpu.obs.metrics import Registry
+
+
+def _fmt(v: "float | int") -> str:
     """Numbers render canonically: integral floats without the '.0'
     (Prometheus parsers take either; goldens want stability)."""
     if isinstance(v, float) and v == int(v) and abs(v) < 2**53:
@@ -24,7 +29,8 @@ def _escape_label(s: str) -> str:
             .replace("\n", "\\n"))
 
 
-def _labelstr(names, values, extra=()) -> str:
+def _labelstr(names: Sequence[str], values: Sequence[str],
+              extra: Iterable[tuple] = ()) -> str:
     pairs = [(n, v) for n, v in zip(names, values)]
     pairs.extend(extra)
     if not pairs:
@@ -33,7 +39,7 @@ def _labelstr(names, values, extra=()) -> str:
     return "{" + body + "}"
 
 
-def render(registry) -> str:
+def render(registry: "Registry") -> str:
     """Registry -> Prometheus text exposition."""
     out: list[str] = []
     for fam in registry.collect():
@@ -59,7 +65,7 @@ def render(registry) -> str:
     return "\n".join(out) + "\n"
 
 
-def snapshot(registry) -> dict:
+def snapshot(registry: "Registry") -> dict:
     """Registry -> JSON-able dict (--stats-json). Histograms carry
     bucket bounds/counts plus sum/count; labeled families list one
     entry per child."""

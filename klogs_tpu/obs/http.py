@@ -18,9 +18,12 @@ keep watching the coalescer loop afterwards.
 
 import asyncio
 import json
-from typing import Callable
+from typing import TYPE_CHECKING, Callable
 
 from klogs_tpu.obs.expo import render
+
+if TYPE_CHECKING:
+    from klogs_tpu.obs.metrics import Registry
 
 _REQ_TIMEOUT_S = 5.0
 
@@ -34,7 +37,7 @@ class Health:
     the cold-start gate the warmup batch flips.
     """
 
-    def __init__(self):
+    def __init__(self) -> None:
         self._ready = False
         self.live_checks: dict[str, Callable[[], bool]] = {}
         self.ready_checks: dict[str, Callable[[], bool]] = {}
@@ -49,7 +52,7 @@ class Health:
         self._ready = ready
 
     @staticmethod
-    def _run(checks) -> tuple[bool, dict]:
+    def _run(checks: dict[str, Callable[[], bool]]) -> tuple[bool, dict]:
         detail = {}
         ok = True
         for name, fn in checks.items():
@@ -79,8 +82,8 @@ class MetricsHTTPServer:
     (cluster deployments front this with the pod network, where the
     scrape config in docs/OBSERVABILITY.md points)."""
 
-    def __init__(self, registry, health: "Health | None" = None,
-                 host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, registry: "Registry", health: "Health | None" = None,
+                 host: str = "127.0.0.1", port: int = 0) -> None:
         self.registry = registry
         self.health = health
         self.host = host

@@ -14,7 +14,12 @@ scale (hundreds of pods per collector); anything keyed by raw peer
 address is normalized to the host (ports churn per connection).
 """
 
+from typing import TYPE_CHECKING
+
 from klogs_tpu.obs.metrics import LATENCY_BUCKETS
+
+if TYPE_CHECKING:
+    from klogs_tpu.obs.metrics import Registry
 
 # Power-of-two ladders matching the engine's bucketing discipline.
 WIDTH_BUCKETS = (128, 256, 512, 1024, 2048, 4096, 8192,
@@ -24,7 +29,8 @@ GROUP_LINE_BUCKETS = (64, 256, 1024, 4096, 8192, 16384,
                       65536, 262144, 1048576)
 
 
-def _m(mtype, help, labels=(), buckets=None):
+def _m(mtype: str, help: str, labels: tuple = (),
+       buckets: "tuple | None" = None) -> dict:
     spec = {"type": mtype, "help": help}
     if labels:
         spec["labels"] = tuple(labels)
@@ -143,7 +149,7 @@ SPECS: dict[str, dict] = {
 }
 
 
-def register_all(registry) -> None:
+def register_all(registry: "Registry") -> None:
     """Instantiate every inventory family in ``registry`` so a scrape
     exposes the full instrument panel (zero-valued where idle) from the
     first request — an operator's dashboard never has to guess whether

@@ -27,6 +27,7 @@ Design rules:
 
 import random
 import threading
+from typing import Any, Iterable
 
 # Bounded reservoir per histogram child: constant memory over unbounded
 # series while p50/p99 stay statistically sound (moved here from
@@ -52,7 +53,7 @@ class _Reservoir:
 
     __slots__ = ("xs", "count", "_rng")
 
-    def __init__(self):
+    def __init__(self) -> None:
         self.xs: list[float] = []
         self.count = 0
         self._rng = random.Random(0)
@@ -71,9 +72,9 @@ class Counter:
     """Monotonic counter. ``inc`` with a negative amount raises — a
     decreasing counter silently corrupts every rate() over it."""
 
-    def __init__(self):
+    def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._value = 0
+        self._value = 0.0
 
     def inc(self, amount: float = 1) -> None:
         if amount < 0:
@@ -82,7 +83,7 @@ class Counter:
             self._value += amount
 
     @property
-    def value(self):
+    def value(self) -> float:
         with self._lock:
             return self._value
 
@@ -90,9 +91,9 @@ class Counter:
 class Gauge:
     """Point-in-time value (queue depth, active streams)."""
 
-    def __init__(self):
+    def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._value = 0
+        self._value = 0.0
 
     def set(self, value: float) -> None:
         with self._lock:
@@ -107,7 +108,7 @@ class Gauge:
             self._value -= amount
 
     @property
-    def value(self):
+    def value(self) -> float:
         with self._lock:
             return self._value
 
@@ -121,7 +122,7 @@ class Histogram:
     as a parallel bookkeeping path.
     """
 
-    def __init__(self, buckets=LATENCY_BUCKETS):
+    def __init__(self, buckets: Iterable[float] = LATENCY_BUCKETS) -> None:
         self._lock = threading.Lock()
         self.buckets = tuple(sorted(buckets))
         if not self.buckets:
@@ -166,7 +167,8 @@ class Family:
     """
 
     def __init__(self, name: str, mtype: str, help: str = "",
-                 labelnames: tuple = (), buckets=None):
+                 labelnames: tuple = (),
+                 buckets: "Iterable[float] | None" = None) -> None:
         if mtype not in _TYPES:
             raise ValueError(f"unknown metric type {mtype!r}")
         self.name = name
@@ -179,12 +181,12 @@ class Family:
         if not self.labelnames:
             self._children[()] = self._make_child()
 
-    def _make_child(self):
+    def _make_child(self) -> Any:
         if self.type == "histogram":
             return Histogram(self._buckets or LATENCY_BUCKETS)
         return _TYPES[self.type]()
 
-    def labels(self, **labelvalues):
+    def labels(self, **labelvalues: object) -> Any:
         if set(labelvalues) != set(self.labelnames):
             raise ValueError(
                 f"{self.name} takes labels {self.labelnames}, "
@@ -196,14 +198,14 @@ class Family:
                 child = self._children[key] = self._make_child()
             return child
 
-    def children(self):
+    def children(self) -> list:
         """Sorted (labelvalues, child) pairs — a stable exposition
         order regardless of observation order."""
         with self._lock:
             return sorted(self._children.items())
 
     # -- unlabeled delegation -----------------------------------------
-    def _default(self):
+    def _default(self) -> Any:
         try:
             return self._children[()]
         except KeyError:
@@ -227,7 +229,7 @@ class Family:
         return self._default().percentile(q)
 
     @property
-    def value(self):
+    def value(self) -> float:
         return self._default().value
 
     @property
@@ -243,12 +245,13 @@ class Registry:
     private instances keep tests and independent pipelines isolated.
     """
 
-    def __init__(self):
+    def __init__(self) -> None:
         self._lock = threading.Lock()
         self._families: dict[str, Family] = {}
 
     def register(self, name: str, mtype: str, help: str = "",
-                 labelnames: tuple = (), buckets=None) -> Family:
+                 labelnames: tuple = (),
+                 buckets: "Iterable[float] | None" = None) -> Family:
         """Get-or-create; re-registration with a different shape is a
         bug worth failing loudly on."""
         with self._lock:
@@ -265,13 +268,16 @@ class Registry:
             self._families[name] = fam
             return fam
 
-    def counter(self, name, help="", labelnames=()) -> Family:
+    def counter(self, name: str, help: str = "",
+                labelnames: tuple = ()) -> Family:
         return self.register(name, "counter", help, labelnames)
 
-    def gauge(self, name, help="", labelnames=()) -> Family:
+    def gauge(self, name: str, help: str = "",
+              labelnames: tuple = ()) -> Family:
         return self.register(name, "gauge", help, labelnames)
 
-    def histogram(self, name, help="", labelnames=(), buckets=None) -> Family:
+    def histogram(self, name: str, help: str = "", labelnames: tuple = (),
+                  buckets: "Iterable[float] | None" = None) -> Family:
         return self.register(name, "histogram", help, labelnames, buckets)
 
     def family(self, name: str) -> Family:

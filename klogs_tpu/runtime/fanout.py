@@ -330,8 +330,16 @@ class FanoutRunner:
         os.makedirs(os.path.dirname(job.path) or ".", exist_ok=True)
         open(job.path, "wb").close()
 
-    def _spawn(self, job: StreamJob, tasks: list) -> None:
-        self._create_file(job)
+    def _create_all_files(self, jobs: list) -> None:
+        """run()'s up-front phase, batched so the whole sweep costs one
+        executor hop (called via asyncio.to_thread)."""
+        for job in jobs:
+            self._create_file(job)
+
+    async def _spawn(self, job: StreamJob, tasks: list) -> None:
+        # makedirs + truncate are disk I/O; in follow mode the loop is
+        # already streaming every other container, so they run off it.
+        await asyncio.to_thread(self._create_file, job)
         tasks.append(asyncio.create_task(self._worker(job)))
 
     async def _discover_loop(self, plan_new, interval_s: float,
@@ -367,7 +375,7 @@ class FanoutRunner:
                     # seen only AFTER a successful spawn: a transient
                     # file-creation failure must leave the job eligible
                     # for the next poll, not silently drop it forever.
-                    self._spawn(j, tasks)
+                    await self._spawn(j, tasks)
                     seen.add((j.pod, j.container, j.init))
             except Exception as e:
                 # Includes _spawn's file creation (full disk, lost
@@ -395,9 +403,10 @@ class FanoutRunner:
         # Two phases, as the reference does it (cmd/root.go:245-257):
         # create/truncate EVERY log file before any worker starts, so a
         # file-creation failure propagates with zero tasks running (no
-        # orphaned streams to leak).
-        for job in jobs:
-            self._create_file(job)
+        # orphaned streams to leak). Off-loop in ONE thread hop:
+        # truncating hundreds of files is disk I/O, and an in-process
+        # metrics sidecar may already be serving on this loop.
+        await asyncio.to_thread(self._create_all_files, jobs)
         tasks: list[asyncio.Task] = [
             asyncio.create_task(self._worker(j)) for j in jobs]
 

@@ -62,6 +62,15 @@ def _make_filter(patterns: list[str], backend: str,
     return build_include_exclude(one, patterns, exclude)
 
 
+def _read_tls(path: str, what: str) -> bytes:
+    try:
+        with open(path, "rb") as f:
+            return f.read()
+    except OSError as e:
+        # ValueError: __main__'s friendly one-liner path.
+        raise ValueError(f"cannot read {what} {path}: {e}") from e
+
+
 def _client_host(peer: str) -> str:
     """gRPC peer -> bounded-cardinality client label: the HOST only.
     Ports churn per connection ('ipv4:127.0.0.1:54321'), so keeping
@@ -167,7 +176,13 @@ class FilterServer:
     async def _check_auth(self, context) -> bool:
         if not self.auth_enabled:
             return True
-        token = self._current_token()
+        # The token-file re-read is disk I/O on a per-RPC path: off the
+        # event loop, or one slow/NFS-mounted Secret volume stalls every
+        # concurrent collector's RPCs behind it.
+        if self.auth_token_file:
+            token = await asyncio.to_thread(self._current_token)
+        else:
+            token = self.auth_token
         meta = dict(context.invocation_metadata() or ())
         got = meta.get("authorization", "")
         # Compare utf-8 bytes: compare_digest on str raises TypeError
@@ -289,18 +304,15 @@ class FilterServer:
         else:
             addr = f"{self.host}:{self.port}"
         if self.tls_cert and self.tls_key:
-            def read(path, what):
-                try:
-                    with open(path, "rb") as f:
-                        return f.read()
-                except OSError as e:
-                    # ValueError: __main__'s friendly one-liner path.
-                    raise ValueError(
-                        f"cannot read {what} {path}: {e}") from e
-
-            key = read(self.tls_key, "TLS key")
-            cert = read(self.tls_cert, "TLS certificate")
-            ca = (read(self.tls_client_ca, "client CA bundle")
+            # One-time reads, but start() runs on the loop (an in-process
+            # collector may already be streaming): disk I/O goes through
+            # a worker thread like every other blocking read here.
+            key = await asyncio.to_thread(_read_tls, self.tls_key,
+                                          "TLS key")
+            cert = await asyncio.to_thread(_read_tls, self.tls_cert,
+                                           "TLS certificate")
+            ca = (await asyncio.to_thread(_read_tls, self.tls_client_ca,
+                                          "client CA bundle")
                   if self.tls_client_ca else None)
             creds = grpc.ssl_server_credentials(
                 [(key, cert)], root_certificates=ca,

@@ -25,7 +25,12 @@ The reference's closest analog is its apiserver REST client
 that design; this is the second boundary the TPU architecture adds.
 """
 
+from typing import TYPE_CHECKING, Any
+
 import msgpack
+
+if TYPE_CHECKING:
+    import numpy
 
 SERVICE = "klogs.Filter"
 HELLO = f"/{SERVICE}/Hello"
@@ -33,11 +38,11 @@ MATCH = f"/{SERVICE}/Match"
 MATCH_FRAMED = f"/{SERVICE}/MatchFramed"
 
 
-def pack(obj) -> bytes:
+def pack(obj: object) -> bytes:
     return msgpack.packb(obj, use_bin_type=True)
 
 
-def unpack(data: bytes):
+def unpack(data: bytes) -> Any:
     return msgpack.unpackb(data, raw=False)
 
 
@@ -67,7 +72,8 @@ def decode_match_response(data: bytes) -> list[bool]:
 # the 9.8M lines/s in-process engine). Hello advertises
 # {"framed": True}; clients fall back to Match against older servers.
 
-def encode_framed_request(payload: bytes, offsets) -> bytes:
+def encode_framed_request(payload: bytes,
+                          offsets: "numpy.ndarray") -> bytes:
     import numpy as np
 
     offs = np.ascontiguousarray(offsets, dtype=np.int32)
@@ -75,7 +81,7 @@ def encode_framed_request(payload: bytes, offsets) -> bytes:
                  "data": payload})
 
 
-def decode_framed_request(data: bytes):
+def decode_framed_request(data: bytes) -> "tuple[bytes, numpy.ndarray]":
     """-> (payload: bytes, offsets: int32 np.ndarray[n+1]).
 
     Validates the offsets array fully: the server feeds it into a
@@ -113,7 +119,7 @@ def decode_framed_request(data: bytes):
     return payload, offsets
 
 
-def encode_framed_response(mask) -> bytes:
+def encode_framed_response(mask: "numpy.ndarray") -> bytes:
     """mask: numpy bool/uint8 array -> raw byte-per-verdict body."""
     import numpy as np
 
@@ -121,7 +127,7 @@ def encode_framed_response(mask) -> bytes:
         mask, dtype=np.uint8).tobytes()})
 
 
-def decode_framed_response(data: bytes):
+def decode_framed_response(data: bytes) -> "numpy.ndarray":
     """-> numpy bool verdict array (no per-line Python objects)."""
     import numpy as np
 

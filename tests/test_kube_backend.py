@@ -4,7 +4,6 @@ coverage of its cluster-touching code; we do better)."""
 
 import asyncio
 import base64
-import json
 
 import pytest
 

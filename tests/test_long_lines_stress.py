@@ -3,7 +3,6 @@ carried-state path, mixed with short lines, on both execution paths —
 the long-context scaling story (SURVEY.md §5) at realistic sizes."""
 
 import random
-import re
 
 import pytest
 

@@ -10,7 +10,6 @@ import numpy as np
 import pytest
 
 from klogs_tpu.filters.compiler.prefilter import (
-    PrefilterProgram,
     candidates_host,
     compile_prefilter,
     mandatory_clauses,

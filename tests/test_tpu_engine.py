@@ -5,7 +5,6 @@ long-context: carried NFA state across chunks of a line)."""
 import random
 import re
 
-import numpy as np
 import pytest
 
 from klogs_tpu.filters.cpu import RegexFilter

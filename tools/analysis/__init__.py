@@ -1,0 +1,13 @@
+"""Project-native static-analysis suite (``python -m tools.analysis``).
+
+Encodes the cross-layer invariants behind every correctness bug fixed
+in PR 3 — engine-dispatch feature drift, int32 frame-offset overflow,
+blocking/poisoning paths into the shared coalescer — as AST-level
+passes that run in tier-1, so those bug *classes* stay dead instead of
+being re-chased one instance at a time. Rule catalog and suppression
+syntax: docs/STATIC_ANALYSIS.md.
+"""
+
+from tools.analysis.core import Finding, Pass, Project, Report, run
+
+__all__ = ["Finding", "Pass", "Project", "Report", "run"]

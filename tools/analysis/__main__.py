@@ -1,0 +1,66 @@
+"""CLI entry: ``python -m tools.analysis [--root DIR] [--json] ...``.
+
+Exit 0 = no unsuppressed findings; 1 = findings or an analyzer error.
+Tier-1 runs this over the repo tree (tests/test_analysis.py), so a new
+violation of any registered invariant fails the gate.
+"""
+
+import argparse
+import os
+import sys
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    if __package__ in (None, ""):  # direct-script invocation
+        sys.path.insert(0, os.path.abspath(
+            os.path.join(os.path.dirname(__file__), os.pardir, os.pardir)))
+    from tools.analysis.core import run
+    from tools.analysis.passes import all_passes
+
+    default_root = os.path.abspath(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     os.pardir, os.pardir))
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analysis",
+        description="klogs-tpu project-native invariant lint")
+    ap.add_argument("--root", default=default_root,
+                    help="tree to analyze (default: this repo)")
+    ap.add_argument("--rules", default=None, metavar="R1,R2",
+                    help="run only these rule ids")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--list", action="store_true", dest="list_rules",
+                    help="list registered rules and exit")
+    ns = ap.parse_args(argv)
+
+    passes = all_passes()
+    if ns.list_rules:
+        for p in passes:
+            print(f"{p.rule:18s} {p.doc}")
+        return 0
+    rules = None
+    if ns.rules:
+        rules = [r.strip() for r in ns.rules.split(",") if r.strip()]
+        known = {p.rule for p in passes}
+        bad = [r for r in rules if r not in known]
+        if bad:
+            print(f"unknown rule(s): {', '.join(bad)} "
+                  f"(see --list)", file=sys.stderr)
+            return 2
+    report = run(ns.root, rules=rules, passes=passes)
+    if ns.as_json:
+        print(report.to_json())
+    else:
+        for f in report.findings:
+            print(f.format())
+        for e in report.errors:
+            print(f"analysis error: {e}", file=sys.stderr)
+        n_rules = len(rules) if rules is not None else len(passes)
+        print(f"tools.analysis: {len(report.active)} finding(s), "
+              f"{len(report.suppressed)} suppressed, "
+              f"{n_rules} rule(s) checked")
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,226 @@
+"""Pass-manager core for the project-native static-analysis suite.
+
+Generic linters see syntax; every correctness bug PR 3 fixed was a
+*cross-layer invariant* (engine-dispatch drift, int32 offset wrap, a
+blocking payload path into the shared coalescer) that only a checker
+with project knowledge can state. This module is the small machinery
+those checkers share:
+
+- ``Project``: a source tree rooted anywhere (the real repo in tier-1,
+  a fixture tree in tests), with lazily parsed ASTs per file.
+- ``Pass``: one named rule (``rule`` id, ``doc`` rationale) producing
+  ``Finding``s. Passes are registered in ``tools.analysis.passes``.
+- Suppressions: ``# klogs: ignore[rule-id]`` on the flagged line or the
+  line above waives that rule there (``ignore[*]`` waives all). A
+  suppressed finding is still reported — as suppressed — so waivers
+  stay visible instead of rotting silently.
+- ``run``: execute passes, apply suppressions, return an exit code
+  (non-zero iff any unsuppressed finding), with human or JSON output.
+
+Passes must stay import-light (ast/re + pure-CPU project modules, never
+jax): the whole suite runs inside tier-1's budget as one short test.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import asdict, dataclass, field
+
+
+@dataclass
+class Finding:
+    """One rule violation at a source location. ``line`` 0 means the
+    finding is file- or project-level (e.g. a docs-parity mismatch) and
+    cannot be suppressed inline."""
+
+    rule: str
+    path: str  # repo-relative, '/'-separated
+    line: int
+    message: str
+    suppressed: bool = False
+
+    def format(self) -> str:
+        where = f"{self.path}:{self.line}" if self.line else self.path
+        tag = " (suppressed)" if self.suppressed else ""
+        return f"{where}: [{self.rule}]{tag} {self.message}"
+
+
+_SUPPRESS_RE = re.compile(r"#\s*klogs:\s*ignore\[([a-z0-9*,-]+)\]")
+
+
+class SourceFile:
+    """One parsed source file: text, AST (lazy), and the per-line
+    suppression table."""
+
+    def __init__(self, root: str, relpath: str):
+        self.relpath = relpath
+        self.path = os.path.join(root, *relpath.split("/"))
+        with open(self.path, encoding="utf-8") as f:
+            self.text = f.read()
+        self._tree: ast.AST | None = None
+        self._suppress: dict[int, set[str]] | None = None
+
+    @property
+    def tree(self) -> ast.AST:
+        if self._tree is None:
+            # A syntax error is not a finding: the tree is unanalyzable,
+            # so crash loudly (py_compile/tier-1 owns syntax).
+            self._tree = ast.parse(self.text, filename=self.path)
+        return self._tree
+
+    def _suppressions(self) -> dict[int, set[str]]:
+        if self._suppress is None:
+            table: dict[int, set[str]] = {}
+            for i, line in enumerate(self.text.splitlines(), start=1):
+                m = _SUPPRESS_RE.search(line)
+                if m:
+                    table[i] = {r.strip() for r in m.group(1).split(",")}
+            self._suppress = table
+        return self._suppress
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        """True when the flagged line (or the line above, for comments
+        that would overlong the flagged one) waives ``rule``."""
+        table = self._suppressions()
+        for ln in (line, line - 1):
+            rules = table.get(ln)
+            if rules and (rule in rules or "*" in rules):
+                return True
+        return False
+
+
+class Project:
+    """A source tree; passes ask it for files by relative path or
+    prefix. Missing files yield None / empty — a pass scoped to a file
+    a fixture tree doesn't seed simply has nothing to say there."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self._cache: dict[str, SourceFile | None] = {}
+
+    def file(self, relpath: str) -> SourceFile | None:
+        if relpath not in self._cache:
+            try:
+                self._cache[relpath] = SourceFile(self.root, relpath)
+            except OSError:
+                self._cache[relpath] = None
+        return self._cache[relpath]
+
+    def files(self, *prefixes: str) -> list[SourceFile]:
+        """Every .py file under the given repo-relative prefixes (a
+        prefix may also name a single file)."""
+        out: list[SourceFile] = []
+        for prefix in prefixes:
+            full = os.path.join(self.root, *prefix.split("/"))
+            if os.path.isfile(full):
+                sf = self.file(prefix)
+                if sf is not None:
+                    out.append(sf)
+                continue
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d != "__pycache__")
+                rel_dir = os.path.relpath(dirpath, self.root).replace(
+                    os.sep, "/")
+                for fn in sorted(filenames):
+                    if not fn.endswith(".py"):
+                        continue
+                    sf = self.file(f"{rel_dir}/{fn}")
+                    if sf is not None:
+                        out.append(sf)
+        return out
+
+    def read_text(self, relpath: str) -> str | None:
+        """Non-Python project files (docs) — no AST, no suppression."""
+        try:
+            with open(os.path.join(self.root, *relpath.split("/")),
+                      encoding="utf-8") as f:
+                return f.read()
+        except OSError:
+            return None
+
+
+class Pass:
+    """One named invariant. Subclasses set ``rule`` (the id that
+    appears in output and ``ignore[...]`` comments) and ``doc`` (one
+    line of rationale, shown by --list), and implement ``run``."""
+
+    rule = "base"
+    doc = ""
+
+    def run(self, project: Project) -> list[Finding]:
+        raise NotImplementedError
+
+    def finding(self, path: str, line: int, message: str) -> Finding:
+        return Finding(self.rule, path, line, message)
+
+
+@dataclass
+class Report:
+    findings: list[Finding] = field(default_factory=list)
+    errors: list[str] = field(default_factory=list)
+
+    @property
+    def active(self) -> list[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def suppressed(self) -> list[Finding]:
+        return [f for f in self.findings if f.suppressed]
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if (self.active or self.errors) else 0
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "findings": [asdict(f) for f in self.findings],
+                "errors": list(self.errors),
+                "counts": {
+                    "active": len(self.active),
+                    "suppressed": len(self.suppressed),
+                },
+            },
+            indent=1,
+        )
+
+
+def run(root: str, rules: "list[str] | None" = None,
+        passes: "list[Pass] | None" = None) -> Report:
+    """Run the (selected) passes over ``root`` and fold in
+    suppressions. A pass that raises is an analyzer bug and is reported
+    as an error (non-zero exit) rather than silently passing the tree
+    it failed to check."""
+    if passes is None:
+        from tools.analysis.passes import all_passes
+
+        passes = all_passes()
+    project = Project(root)
+    report = Report()
+    if rules is not None:
+        # A typoed rule id must not silently select nothing — that
+        # would turn a gate into a vacuous pass.
+        known = {p.rule for p in passes}
+        for r in rules:
+            if r not in known:
+                report.errors.append(f"unknown rule {r!r} "
+                                     f"(known: {', '.join(sorted(known))})")
+    for p in passes:
+        if rules is not None and p.rule not in rules:
+            continue
+        try:
+            found = p.run(project)
+        except Exception as e:  # noqa: BLE001 - analyzer must not lie
+            report.errors.append(f"pass {p.rule} crashed: {e!r}")
+            continue
+        for f in found:
+            sf = project.file(f.path) if f.line else None
+            if sf is not None and sf.is_suppressed(f.rule, f.line):
+                f.suppressed = True
+            report.findings.append(f)
+    report.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return report

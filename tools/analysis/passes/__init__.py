@@ -1,0 +1,25 @@
+"""Pass registry: one instance of every registered invariant.
+
+Order is the report order for project-level (line-0) findings; keep
+the five core invariants first, docs parity last.
+"""
+
+
+def all_passes():
+    from tools.analysis.passes.async_blocking import AsyncBlockingPass
+    from tools.analysis.passes.cli_docs import CliDocsPass
+    from tools.analysis.passes.dispatch_parity import DispatchParityPass
+    from tools.analysis.passes.int32_guard import Int32GuardPass
+    from tools.analysis.passes.lock_discipline import LockDisciplinePass
+    from tools.analysis.passes.metrics_docs import MetricsDocsPass
+    from tools.analysis.passes.traced_purity import TracedPurityPass
+
+    return [
+        AsyncBlockingPass(),
+        LockDisciplinePass(),
+        TracedPurityPass(),
+        DispatchParityPass(),
+        Int32GuardPass(),
+        MetricsDocsPass(),
+        CliDocsPass(),
+    ]

@@ -1,0 +1,184 @@
+"""async-blocking: no blocking calls on the event loop.
+
+PR 3's coalescer work made one fact load-bearing: EVERY sink, RPC, and
+stream in the process shares one event loop, so a single blocking call
+in an async path stalls every stream's flush at once (the same failure
+shape as the str-payload poisoning bug — one caller degrading the
+shared path). This pass walks ``async def`` bodies (including sync
+helpers *defined inside* them, which run on the loop) in the service,
+sidecar, coalescer, sink, and fanout layers and flags known blocking
+primitives: ``time.sleep``, bare ``open()``, non-awaited
+``.acquire()`` / ``.result()``, zero-arg ``.join()`` (thread join —
+``sep.join(parts)`` always has an argument), ``Executor.shutdown(wait=
+True)``, ``subprocess.*`` and ``os.system``.
+
+One level of propagation: a *sync* method containing a blocking
+primitive is itself flagged at any call site inside an async def of
+the same module (e.g. an async RPC handler calling a helper that does
+``open()`` per request).
+"""
+
+import ast
+
+from tools.analysis.core import Finding, Pass, Project, SourceFile
+
+SCOPE = (
+    "klogs_tpu/service",
+    "klogs_tpu/obs/http.py",
+    "klogs_tpu/filters/async_service.py",
+    "klogs_tpu/filters/sink.py",
+    "klogs_tpu/runtime",
+)
+
+_SUBPROCESS_FNS = {"run", "call", "check_call", "check_output", "Popen"}
+# Non-awaited method calls that block the calling thread.
+_BLOCKING_METHODS = {"acquire", "result"}
+
+
+def _dotted(node: ast.AST) -> str:
+    """'a.b.c' for Attribute/Name chains, '' otherwise."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _blocking_kind(call: ast.Call, awaited: bool) -> str | None:
+    """Why this call blocks the loop, or None."""
+    func = call.func
+    if isinstance(func, ast.Name):
+        if func.id == "open":
+            return "blocking file I/O (open)"
+        return None
+    if not isinstance(func, ast.Attribute):
+        return None
+    dotted = _dotted(func)
+    if dotted == "time.sleep":
+        return "time.sleep blocks the event loop (use asyncio.sleep)"
+    if dotted == "os.system" or dotted == "socket.create_connection":
+        return f"{dotted} blocks the event loop"
+    if (dotted.startswith("subprocess.")
+            and func.attr in _SUBPROCESS_FNS):
+        return f"{dotted} blocks the event loop"
+    if awaited:
+        return None
+    if func.attr in _BLOCKING_METHODS:
+        return (f"non-awaited .{func.attr}() blocks the event loop "
+                "(thread lock / concurrent future)")
+    if func.attr == "join" and not call.keywords and (
+            not call.args
+            or (len(call.args) == 1
+                and isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, (int, float)))):
+        # str/bytes .join always takes an iterable; a zero-arg or
+        # numeric-timeout .join is a thread join.
+        return ".join() is a thread join and blocks the loop"
+    if func.attr == "shutdown":
+        # Executor.shutdown blocks unless wait=False is EXPLICIT —
+        # the bare call defaults to wait=True.
+        waits = [kw for kw in call.keywords if kw.arg == "wait"]
+        if not waits or not (
+                isinstance(waits[0].value, ast.Constant)
+                and waits[0].value.value is False):
+            return ("executor .shutdown() joins worker threads on the "
+                    "event loop (wait defaults to True; pass "
+                    "wait=False or offload to a thread)")
+    return None
+
+
+class _FuncIndex(ast.NodeVisitor):
+    """Collects every function def with its enclosing-async context."""
+
+    def __init__(self) -> None:
+        self.async_defs: list[ast.AsyncFunctionDef] = []
+        self.sync_defs: list[ast.FunctionDef] = []
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self.async_defs.append(node)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.sync_defs.append(node)
+        self.generic_visit(node)
+
+
+def _awaited_calls(root: ast.AST) -> set[int]:
+    return {id(n.value) for n in ast.walk(root)
+            if isinstance(n, ast.Await) and isinstance(n.value, ast.Call)}
+
+
+def _own_nodes(fn: ast.AST) -> list[ast.AST]:
+    """Nodes of ``fn`` including nested *sync* defs (they run on the
+    loop when called) but excluding nested async defs (their bodies are
+    separate loop entries, visited on their own)."""
+    out: list[ast.AST] = []
+    stack: list[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.AsyncFunctionDef):
+            continue
+        out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+class AsyncBlockingPass(Pass):
+    rule = "async-blocking"
+    doc = ("no blocking primitives inside async bodies in the "
+           "service/sidecar/coalescer/sink/fanout layers")
+
+    def run(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for sf in project.files(*SCOPE):
+            findings.extend(self._check_file(sf))
+        return findings
+
+    def _check_file(self, sf: SourceFile) -> list[Finding]:
+        idx = _FuncIndex()
+        idx.visit(sf.tree)
+        awaited = _awaited_calls(sf.tree)
+        findings: list[Finding] = []
+
+        # Sync functions/methods that contain a blocking primitive
+        # directly — call sites in async defs get the propagated flag.
+        nested_in_async = {
+            id(d) for a in idx.async_defs for d in _own_nodes(a)
+            if isinstance(d, ast.FunctionDef)}
+        blocking_sync: dict[str, str] = {}
+        for fn in idx.sync_defs:
+            if id(fn) in nested_in_async:
+                continue  # already covered as part of the async body
+            for node in _own_nodes(fn):
+                if isinstance(node, ast.Call):
+                    kind = _blocking_kind(node, id(node) in awaited)
+                    if kind:
+                        blocking_sync[fn.name] = kind
+                        break
+
+        for adef in idx.async_defs:
+            for node in _own_nodes(adef):
+                if not isinstance(node, ast.Call):
+                    continue
+                kind = _blocking_kind(node, id(node) in awaited)
+                if kind:
+                    findings.append(self.finding(
+                        sf.relpath, node.lineno,
+                        f"{kind} inside async def {adef.name}()"))
+                    continue
+                callee = None
+                if (isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "self"):
+                    callee = node.func.attr
+                elif isinstance(node.func, ast.Name):
+                    callee = node.func.id
+                if callee in blocking_sync and id(node) not in awaited:
+                    findings.append(self.finding(
+                        sf.relpath, node.lineno,
+                        f"async def {adef.name}() calls {callee}(), "
+                        f"which does {blocking_sync[callee]}"))
+        return findings

@@ -1,0 +1,63 @@
+"""cli-docs: every CLI flag is documented, every documented flag
+exists (same shape as the metrics-docs check).
+
+Code side: the long option strings passed to ``add_argument`` in
+``klogs_tpu/cli.py`` (positional string args starting with ``--``;
+help text is ignored, so prose like "combine with --match" inside a
+help string never counts as a flag definition). Docs side: every
+``--flag`` token anywhere in docs/CLI.md — including prose, so a stale
+flag *mention* is flagged too, not just a stale table row.
+"""
+
+import ast
+import re
+
+from tools.analysis.core import Finding, Pass, Project
+
+CLI_PATH = "klogs_tpu/cli.py"
+DOC_PATH = "docs/CLI.md"
+
+_DOC_FLAG = re.compile(r"(?<![\w-])--[a-z][a-z0-9-]*")
+
+
+def cli_flags(tree: ast.AST) -> set:
+    flags = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_argument"):
+            for arg in node.args:
+                if (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)
+                        and arg.value.startswith("--")):
+                    flags.add(arg.value)
+    return flags
+
+
+def doc_flags(doc: str) -> set:
+    return set(_DOC_FLAG.findall(doc))
+
+
+class CliDocsPass(Pass):
+    rule = "cli-docs"
+    doc = "klogs_tpu/cli.py flags and docs/CLI.md agree both ways"
+
+    def run(self, project: Project) -> list[Finding]:
+        sf = project.file(CLI_PATH)
+        doc = project.read_text(DOC_PATH)
+        if sf is None or doc is None:
+            return []  # fixture tree without one side
+        in_code = cli_flags(sf.tree)
+        in_docs = doc_flags(doc)
+        findings = []
+        for flag in sorted(in_code - in_docs):
+            findings.append(self.finding(
+                CLI_PATH, 0,
+                f"{flag} is defined in cli.py but never appears in "
+                f"{DOC_PATH} (undocumented flag)"))
+        for flag in sorted(in_docs - in_code):
+            findings.append(self.finding(
+                DOC_PATH, 0,
+                f"{flag} appears in {DOC_PATH} but no add_argument "
+                "defines it (stale documentation)"))
+        return findings

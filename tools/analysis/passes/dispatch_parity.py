@@ -1,0 +1,222 @@
+"""dispatch-parity: the compiler's rejected-feature table and the CPU
+fallback classifier enumerate the same regex feature set.
+
+The PR 3 bug this encodes: conditional group references ``(?(1)...)``
+are rejected by the compiler (so the pattern set falls back to a host
+`re` engine), but the fallback *classifier* in ``best_host_filter``
+didn't know the token — the set landed on the combined-alternation
+engine, whose group renumbering silently resolves ``(?(1))`` to the
+wrong group and drops lines. Same class as LogGrep-style static scheme
+extraction: dispatch is decided by a static feature classification, so
+the classification tables on both sides must be one table.
+
+Mechanically: ``filters/compiler/parser.py`` owns
+``GROUP_REF_TOKENS`` (the renumbering-sensitive features the compiler
+rejects), ``filters/cpu.py`` must build ``_GROUP_REF_RE`` from exactly
+those tokens and consult it in ``best_host_filter``. The pass verifies
+the structure (AST) and the semantics (a probe pattern per feature
+must be classifier-matched and compiler-rejected; supported-subset
+probes must be neither)."""
+
+import ast
+
+from tools.analysis.core import Finding, Pass, Project
+
+PARSER_PATH = "klogs_tpu/filters/compiler/parser.py"
+CPU_PATH = "klogs_tpu/filters/cpu.py"
+
+# One probe per renumbering-sensitive feature: valid `re`, must be
+# rejected by the compiler AND matched by the fallback classifier.
+PROBES = {
+    "numbered backreference": r"(x)y\1",
+    "named backreference (?P=name)": r"(?P<g>x)(?P=g)",
+    "conditional group reference (?(1)...)": r"(a)?b(?(1)c|d)",
+}
+
+# In-subset probes: must compile in the compiler AND not be classified
+# as group-ref (over-routing silently gives up the DFA/combined-re
+# engines — a perf cliff with no error).
+NEGATIVE_PROBES = (
+    r"(?:a)b", r"(?P<n>a)x", r"(?i)x", r"a{2,3}", r"[a-z]+$", r"a|b",
+    r"\d+\.\d+",
+)
+
+
+def _module_assign(tree: ast.AST, name: str) -> "ast.expr | None":
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    return node.value
+    return None
+
+
+def _str_tuple(node: "ast.expr | None") -> "list | None":
+    if isinstance(node, (ast.Tuple, ast.List)) and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in node.elts):
+        return [e.value for e in node.elts]
+    return None
+
+
+class DispatchParityPass(Pass):
+    rule = "dispatch-parity"
+    doc = ("compiler-rejected regex features and the CPU fallback "
+           "classifier agree (the PR 3 (?(1)) drift)")
+
+    def run(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        parser_sf = project.file(PARSER_PATH)
+        cpu_sf = project.file(CPU_PATH)
+        if parser_sf is None and cpu_sf is None:
+            return findings  # fixture tree without these layers
+
+        tokens = None
+        if parser_sf is not None:
+            tokens = _str_tuple(
+                _module_assign(parser_sf.tree, "GROUP_REF_TOKENS"))
+            if tokens is None:
+                findings.append(self.finding(
+                    PARSER_PATH, 0,
+                    "GROUP_REF_TOKENS (literal tuple of renumbering-"
+                    "sensitive feature tokens) is missing — the CPU "
+                    "classifier has no source of truth"))
+        if cpu_sf is None:
+            return findings
+
+        classifier = self._classifier_pattern(cpu_sf, tokens, findings)
+        if classifier is not None:
+            self._probe(classifier, findings)
+        self._check_consulted(cpu_sf, findings)
+        self._check_compiler_semantics(parser_sf, findings)
+        return findings
+
+    def _classifier_pattern(self, cpu_sf, tokens, findings):
+        """The regex string _GROUP_REF_RE compiles, resolving the
+        canonical '|'.join(GROUP_REF_TOKENS) form through the parser
+        table; a drifted literal is compared token-by-token."""
+        value = _module_assign(cpu_sf.tree, "_GROUP_REF_RE")
+        if value is None:
+            findings.append(self.finding(
+                CPU_PATH, 0,
+                "_GROUP_REF_RE module-level classifier is missing "
+                "(best_host_filter cannot route group-ref patterns "
+                "off the combined-alternation engine)"))
+            return None
+        arg = None
+        if isinstance(value, ast.Call) and value.args:
+            arg = value.args[0]  # re.compile(<arg>)
+        if (isinstance(arg, ast.Call)
+                and isinstance(arg.func, ast.Attribute)
+                and arg.func.attr == "join" and arg.args
+                and isinstance(arg.args[0], ast.Name)
+                and arg.args[0].id == "GROUP_REF_TOKENS"):
+            if tokens is None:
+                return None  # already reported on the parser side
+            return "|".join(tokens)
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            if tokens is not None:
+                have = set(arg.value.split("|"))
+                want = set(tokens)
+                for missing in sorted(want - have):
+                    findings.append(self.finding(
+                        CPU_PATH, value.lineno,
+                        f"classifier literal drifted: token {missing!r} "
+                        "from parser.GROUP_REF_TOKENS is not checked "
+                        "(build _GROUP_REF_RE from the shared table)"))
+            return arg.value
+        findings.append(self.finding(
+            CPU_PATH, value.lineno,
+            "_GROUP_REF_RE is not built from parser.GROUP_REF_TOKENS "
+            "(use re.compile('|'.join(GROUP_REF_TOKENS)))"))
+        return None
+
+    def _probe(self, classifier: str, findings: list) -> None:
+        import re
+
+        try:
+            cre = re.compile(classifier)
+        except re.error as e:
+            findings.append(self.finding(
+                CPU_PATH, 0, f"classifier regex does not compile: {e}"))
+            return
+        for feature, probe in PROBES.items():
+            re.compile(probe)  # the probe itself must be valid `re`
+            if not cre.search(probe):
+                findings.append(self.finding(
+                    CPU_PATH, 0,
+                    f"classifier misses {feature}: probe {probe!r} "
+                    "would route to the combined-alternation engine, "
+                    "whose group renumbering silently changes its "
+                    "meaning (the PR 3 bug)"))
+        for probe in NEGATIVE_PROBES:
+            if cre.search(probe):
+                findings.append(self.finding(
+                    CPU_PATH, 0,
+                    f"classifier over-routes: in-subset probe {probe!r} "
+                    "is classified as a group-ref pattern and silently "
+                    "loses the DFA/combined engines"))
+
+    def _check_consulted(self, cpu_sf, findings: list) -> None:
+        for node in cpu_sf.tree.body:
+            if (isinstance(node, ast.FunctionDef)
+                    and node.name == "best_host_filter"):
+                for sub in ast.walk(node):
+                    if (isinstance(sub, ast.Attribute)
+                            and sub.attr == "search"
+                            and isinstance(sub.value, ast.Name)
+                            and sub.value.id == "_GROUP_REF_RE"):
+                        return
+                findings.append(self.finding(
+                    CPU_PATH, node.lineno,
+                    "best_host_filter never consults _GROUP_REF_RE — "
+                    "group-ref pattern sets will reach the combined-"
+                    "alternation engine"))
+                return
+        # Absent/renamed entry point must fail loudly, not make the
+        # consultation check vacuous.
+        findings.append(self.finding(
+            CPU_PATH, 0,
+            "best_host_filter() not found at module level — the "
+            "engine-selection entry point this pass audits is gone or "
+            "renamed (update the pass alongside the refactor)"))
+
+    def _check_compiler_semantics(self, parser_sf, findings: list) -> None:
+        """Live check against the importable compiler: every token's
+        probe must be REJECTED (if the subset ever grows to support a
+        feature, its token should leave the table), and every negative
+        probe accepted (else this pass's own table went stale). Only
+        meaningful when the analyzed parser IS the importable one — on
+        a foreign ``--root`` tree this would report on the wrong code,
+        so it is skipped there (the AST checks above still run)."""
+        import os
+
+        import klogs_tpu.filters.compiler.parser as live_parser
+
+        if parser_sf is None or (
+                os.path.realpath(parser_sf.path)
+                != os.path.realpath(live_parser.__file__)):
+            return
+        from klogs_tpu.filters.compiler.parser import (
+            RegexSyntaxError,
+            parse,
+        )
+
+        for feature, probe in PROBES.items():
+            try:
+                parse(probe)
+            except RegexSyntaxError:
+                continue
+            findings.append(self.finding(
+                PARSER_PATH, 0,
+                f"compiler now ACCEPTS {feature} (probe {probe!r}); "
+                "it no longer belongs in GROUP_REF_TOKENS — update the "
+                "table and this pass's probes together"))
+        for probe in NEGATIVE_PROBES:
+            try:
+                parse(probe)
+            except RegexSyntaxError:
+                findings.append(self.finding(
+                    PARSER_PATH, 0,
+                    f"compiler rejects in-subset probe {probe!r}; the "
+                    "dispatch-parity probe table is stale"))

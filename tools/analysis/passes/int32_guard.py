@@ -1,0 +1,143 @@
+"""int32-guard: frame-offset arithmetic routes through the guarded
+helpers, and the guards themselves stay in place.
+
+Offsets ride int32 (device-friendly, half the index bandwidth of
+int64). PR 3 fixed the silent failure mode twice: a pure-Python
+``frame_lines`` cumsum wrapping past INT32_MAX into negative offsets
+(empty mis-sliced lines downstream), and a coalesced group whose
+concatenated payload wrapped member offset *shifts*. The fix was to
+centralize: ``filters/base.frame_lines`` raises OverflowError at the
+boundary, the coalescer splits groups under ``GROUP_PAYLOAD_LIMIT``,
+and the wire decoder validates monotonic 0..len(payload) offsets.
+
+This pass holds both halves of that bargain:
+
+1. No NEW unguarded offset builders: ``np.cumsum`` /
+   ``np.add.accumulate`` anywhere in ``klogs_tpu/`` outside the
+   allow-listed guard modules (``ops/`` is excluded — device-side
+   jnp/np math there never builds host frame offsets).
+2. The guards themselves cannot be silently deleted:
+   ``frame_lines`` must still raise OverflowError against
+   ``_INT32_MAX``; the coalescer's ``_run_group`` must still reference
+   ``GROUP_PAYLOAD_LIMIT``; ``decode_framed_request`` must still
+   validate via ``np.diff`` and raise ValueError.
+"""
+
+import ast
+
+from tools.analysis.core import Finding, Pass, Project
+
+SCOPE = ("klogs_tpu",)
+EXCLUDE_PREFIXES = ("klogs_tpu/ops/",)
+# Modules allowed to build offsets directly — they carry the guards.
+ALLOW = {
+    "klogs_tpu/filters/base.py",
+    "klogs_tpu/native/__init__.py",
+}
+
+_ACCUM_CALLS = {"np.cumsum", "numpy.cumsum", "np.add.accumulate",
+                "numpy.add.accumulate"}
+
+# (file, function, requirement) triples for rule 2; ``requirement`` is
+# checked by the matching _has_* predicate below.
+GUARDS = (
+    ("klogs_tpu/filters/base.py", "frame_lines", "overflow-raise"),
+    ("klogs_tpu/filters/async_service.py", "_run_group", "group-limit"),
+    ("klogs_tpu/service/transport.py", "decode_framed_request",
+     "offsets-validated"),
+)
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _find_function(tree: ast.AST, name: str):
+    for node in ast.walk(tree):
+        if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == name):
+            return node
+    return None
+
+
+def _has_overflow_raise(fn) -> bool:
+    raises = any(
+        isinstance(n, ast.Raise) and isinstance(n.exc, ast.Call)
+        and _dotted(n.exc.func).endswith("OverflowError")
+        for n in ast.walk(fn))
+    bound = any(isinstance(n, ast.Name) and n.id == "_INT32_MAX"
+                for n in ast.walk(fn))
+    return raises and bound
+
+
+def _has_group_limit(fn) -> bool:
+    return any(isinstance(n, ast.Name) and n.id == "GROUP_PAYLOAD_LIMIT"
+               for n in ast.walk(fn))
+
+
+def _has_offsets_validation(fn) -> bool:
+    diffs = any(isinstance(n, ast.Call)
+                and _dotted(n.func) in ("np.diff", "numpy.diff")
+                for n in ast.walk(fn))
+    raises = any(isinstance(n, ast.Raise) and isinstance(n.exc, ast.Call)
+                 and _dotted(n.exc.func).endswith("ValueError")
+                 for n in ast.walk(fn))
+    return diffs and raises
+
+
+_PREDICATES = {
+    "overflow-raise": (_has_overflow_raise,
+                       "no OverflowError raise against _INT32_MAX — the "
+                       "int32 wrap guard PR 3 added is gone"),
+    "group-limit": (_has_group_limit,
+                    "no GROUP_PAYLOAD_LIMIT reference — coalesced groups "
+                    "can again concatenate past int32 and wrap member "
+                    "offset shifts negative"),
+    "offsets-validated": (_has_offsets_validation,
+                          "no np.diff monotonicity validation + "
+                          "ValueError — one client's malformed offsets "
+                          "can poison the shared coalescer again"),
+}
+
+
+class Int32GuardPass(Pass):
+    rule = "int32-guard"
+    doc = ("offset building routes through the guarded helpers; the "
+           "PR 3 int32 guards stay present")
+
+    def run(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for sf in project.files(*SCOPE):
+            if sf.relpath in ALLOW or any(
+                    sf.relpath.startswith(p) for p in EXCLUDE_PREFIXES):
+                continue
+            for node in ast.walk(sf.tree):
+                if (isinstance(node, ast.Call)
+                        and _dotted(node.func) in _ACCUM_CALLS):
+                    findings.append(self.finding(
+                        sf.relpath, node.lineno,
+                        f"{_dotted(node.func)}() builds offsets outside "
+                        "the guarded helpers — use filters.base."
+                        "frame_lines (it fails loudly past int32 "
+                        "instead of wrapping negative)"))
+        for relpath, fname, req in GUARDS:
+            sf = project.file(relpath)
+            if sf is None:
+                continue
+            fn = _find_function(sf.tree, fname)
+            predicate, message = _PREDICATES[req]
+            if fn is None:
+                findings.append(self.finding(
+                    relpath, 0,
+                    f"guarded helper {fname}() is gone; {message}"))
+            elif not predicate(fn):
+                findings.append(self.finding(
+                    relpath, fn.lineno, f"{fname}(): {message}"))
+        return findings

@@ -1,0 +1,214 @@
+"""lock-discipline: declared shared state is only mutated under its
+declared lock (or only from the event loop, for loop-confined fields).
+
+The obs registry is scraped from the sidecar's loop while pipeline
+threads record into it, and the engine's degrade flags are flipped by
+fetch-time retry closures running in executor threads while the
+dispatch (loop) thread reads them — exactly the cross-thread shape
+that produced PR 3's poisoned-coalescer class of bug. The shared
+fields and their locks are declared in ``SHARED_STATE`` below; the
+pass then proves every *mutation* of a declared field in its class
+is lexically inside ``with self.<lock>:`` (kind ``lock``) or inside an
+``async def`` method (kind ``loop`` — loop-confined state must never
+be touched from a sync method, which executor threads can reach).
+
+``__init__`` is exempt: construction happens-before sharing. Reads are
+deliberately out of scope — the invariant that bit us is torn/lost
+*writes*.
+"""
+
+import ast
+from dataclasses import dataclass
+
+from tools.analysis.core import Finding, Pass, Project, SourceFile
+
+
+@dataclass(frozen=True)
+class Decl:
+    kind: str  # "lock" | "loop"
+    lock: "str | None"
+    fields: frozenset
+
+
+def _decl(kind: str, lock: "str | None", *fields: str) -> Decl:
+    return Decl(kind, lock, frozenset(fields))
+
+
+# The annotation table: file -> class -> declaration. Adding a shared
+# field here is the act of declaring its synchronization contract.
+SHARED_STATE: dict = {
+    "klogs_tpu/obs/metrics.py": {
+        "Counter": _decl("lock", "_lock", "_value"),
+        "Gauge": _decl("lock", "_lock", "_value"),
+        "Histogram": _decl("lock", "_lock", "bucket_counts", "sum",
+                           "count", "_reservoir"),
+        "Family": _decl("lock", "_lock", "_children"),
+        "Registry": _decl("lock", "_lock", "_families"),
+    },
+    "klogs_tpu/filters/base.py": {
+        # Written by the dispatch loop AND by sync fallback paths that
+        # benches drive from plain threads.
+        "FilterStats": _decl("lock", "_t_lock", "first_batch_started_at"),
+    },
+    "klogs_tpu/filters/tpu.py": {
+        # Degrade flags are flipped by fetch-time retry closures that
+        # run in AsyncFilterService's executor threads while the loop
+        # thread dispatches; the jit-shape set is read/written on both.
+        "NFAEngineFilter": _decl("lock", "_state_lock", "_chain_fallback",
+                                 "_pf_tables", "_shapes_seen"),
+    },
+    "klogs_tpu/runtime/fanout.py": {
+        # Event-loop-confined: no lock, so no sync method (reachable
+        # from executor threads) may ever mutate them.
+        "FanoutRunner": _decl("loop", None, "_streams", "_stopping"),
+    },
+}
+
+_MUTATORS = {
+    "append", "extend", "insert", "remove", "pop", "clear", "add",
+    "discard", "update", "setdefault", "popitem", "sort", "reverse",
+}
+
+
+def _self_attr(node: ast.AST, fields: frozenset) -> "str | None":
+    """Field name when ``node`` is ``self.<field>`` for a declared
+    field, else None."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self" and node.attr in fields):
+        return node.attr
+    return None
+
+
+def _mutated_field(node: ast.AST, fields: frozenset) -> "str | None":
+    """Declared field this node mutates, if any. Only Assign/AugAssign/
+    AnnAssign/Delete/Call nodes can mutate, so each mutation reports
+    exactly once during a full walk."""
+    if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            for el in (t.elts if isinstance(t, ast.Tuple) else [t]):
+                f = _self_attr(el, fields)
+                if f:
+                    return f
+                # self.<field>[k] = v  /  self.<field>.x = v
+                if isinstance(el, (ast.Subscript, ast.Attribute)):
+                    f = _self_attr(el.value, fields)
+                    if f:
+                        return f
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            f = _self_attr(t, fields)
+            if f is None and isinstance(t, (ast.Subscript, ast.Attribute)):
+                f = _self_attr(t.value, fields)
+            if f:
+                return f
+    elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        if node.func.attr in _MUTATORS:
+            return _self_attr(node.func.value, fields)
+    return None
+
+
+def _holds_lock(node: "ast.With | ast.AsyncWith", lock: str) -> bool:
+    for item in node.items:
+        ctx = item.context_expr
+        if isinstance(ctx, ast.Call):  # e.g. contextlib wrappers
+            ctx = ctx.func
+        if (isinstance(ctx, ast.Attribute)
+                and isinstance(ctx.value, ast.Name)
+                and ctx.value.id == "self" and ctx.attr == lock):
+            return True
+    return False
+
+
+class LockDisciplinePass(Pass):
+    rule = "lock-discipline"
+    doc = ("declared shared fields are mutated only under their "
+           "declared lock / only from the event loop")
+
+    def run(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for relpath, classes in sorted(SHARED_STATE.items()):
+            sf = project.file(relpath)
+            if sf is None:
+                continue
+            seen = set()
+            for node in sf.tree.body:
+                if isinstance(node, ast.ClassDef) and node.name in classes:
+                    seen.add(node.name)
+                    self._check_class(sf, node, classes[node.name],
+                                      findings)
+            # A declaration the tree no longer matches is a silently
+            # vacuous gate (renamed class/field escapes all checks) —
+            # fail loudly so the table is updated with the refactor.
+            for name in sorted(set(classes) - seen):
+                findings.append(self.finding(
+                    relpath, 0,
+                    f"class {name} is declared in SHARED_STATE but not "
+                    "found in this file — the lock-discipline table is "
+                    "stale (renamed class escapes the gate)"))
+        return findings
+
+    def _check_class(self, sf: SourceFile, cls: ast.ClassDef, decl: Decl,
+                     findings: list) -> None:
+        present = {n.attr for n in ast.walk(cls)
+                   if isinstance(n, ast.Attribute)
+                   and isinstance(n.value, ast.Name)
+                   and n.value.id == "self"}
+        for field in sorted(decl.fields - present):
+            findings.append(self.finding(
+                sf.relpath, cls.lineno,
+                f"{cls.name}.{field} is declared in SHARED_STATE but "
+                "never referenced in the class — the lock-discipline "
+                "table is stale (renamed field escapes the gate)"))
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                continue
+            if method.name == "__init__":
+                continue
+            is_async = isinstance(method, ast.AsyncFunctionDef)
+            for stmt in method.body:
+                self._visit(sf, cls, method, stmt, decl,
+                            locked=False, is_async=is_async,
+                            findings=findings)
+
+    def _visit(self, sf, cls, method, node, decl: Decl, locked: bool,
+               is_async: bool, findings: list) -> None:
+        field = _mutated_field(node, decl.fields)
+        if field is not None:
+            if decl.kind == "lock" and not locked:
+                findings.append(self.finding(
+                    sf.relpath, node.lineno,
+                    f"{cls.name}.{field} is declared shared but mutated "
+                    f"in {method.name}() outside "
+                    f"'with self.{decl.lock}:'"))
+            elif decl.kind == "loop" and not is_async:
+                findings.append(self.finding(
+                    sf.relpath, node.lineno,
+                    f"{cls.name}.{field} is declared event-loop-confined "
+                    f"but mutated in sync method {method.name}() "
+                    "(reachable from executor threads)"))
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = locked or (decl.lock is not None
+                               and _holds_lock(node, decl.lock))
+            for item in node.items:
+                self._visit(sf, cls, method, item.context_expr, decl,
+                            locked, is_async, findings)
+            for stmt in node.body:
+                self._visit(sf, cls, method, stmt, decl, inner, is_async,
+                            findings)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # A nested def is a new execution context: the enclosing
+            # lock is NOT held when it eventually runs (retry closures
+            # are exactly this trap), and a nested sync def may run off
+            # the loop.
+            nested_async = isinstance(node, ast.AsyncFunctionDef)
+            for stmt in node.body:
+                self._visit(sf, cls, method, stmt, decl, False,
+                            nested_async, findings)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._visit(sf, cls, method, child, decl, locked, is_async,
+                        findings)

@@ -1,0 +1,108 @@
+"""metrics-docs: the metric inventory in code and docs must agree.
+
+Folded in from ``tools/check_metrics_docs.py`` (which now shims to
+this pass so its standalone CLI and the tier-1 test keep working).
+Compares the metric names in ``klogs_tpu/obs/inventory.py`` — the
+single place metric names/types/help live; ``Registry.family``
+resolves through SPECS, so any name used in code is in SPECS by
+construction — against the inventory table in docs/OBSERVABILITY.md,
+in both directions: a SPECS entry missing from the table is an
+undocumented metric; a table row naming no SPECS entry is stale
+documentation.
+
+Root-correctness: when the analyzed tree (``--root``) contains
+``klogs_tpu/obs/inventory.py``, the names come from THAT file's AST
+(the SPECS dict literal keys), so analyzing another checkout reports
+on its code, not this environment's; only when the file is absent
+(docs-only fixture trees) does the live import fill in.
+"""
+
+import ast
+import re
+
+from tools.analysis.core import Finding, Pass, Project
+
+DOC_PATH = "docs/OBSERVABILITY.md"
+INVENTORY_PATH = "klogs_tpu/obs/inventory.py"
+
+# Inventory-table rows only: "| `klogs_...` | type | ..." — prose
+# mentions of metric names elsewhere in the doc are not inventory.
+_ROW = re.compile(r"^\|\s*`(klogs_[a-z0-9_]+)`\s*\|", re.MULTILINE)
+
+
+def _live_names() -> set:
+    from klogs_tpu.obs.inventory import SPECS
+
+    return set(SPECS)
+
+
+def _ast_names(tree: ast.AST) -> "set | None":
+    """Keys of the module-level SPECS dict literal, or None when the
+    file defines no such table."""
+    for node in tree.body:
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):
+            target = node.target
+        if (isinstance(target, ast.Name) and target.id == "SPECS"
+                and isinstance(getattr(node, "value", None), ast.Dict)):
+            return {k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+    return None
+
+
+def check(doc_path: "str | None" = None) -> list[str]:
+    """Returns a list of problems (empty = consistent). ``doc_path``
+    defaults to the repo's docs/OBSERVABILITY.md — the signature the
+    pre-fold ``tools.check_metrics_docs.check`` exposed."""
+    import os
+
+    if doc_path is None:
+        root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            *[os.pardir] * 3)
+        doc_path = os.path.join(root, "docs", "OBSERVABILITY.md")
+    try:
+        with open(doc_path, encoding="utf-8") as f:
+            doc = f.read()
+    except OSError as e:
+        return [f"cannot read {doc_path}: {e}"]
+    return compare(doc, _live_names())
+
+
+def compare(doc: str, names: set) -> list[str]:
+    documented = set(_ROW.findall(doc))
+    problems = []
+    for name in sorted(names - documented):
+        problems.append(
+            f"{name} is registered in obs/inventory.py but missing from "
+            "the docs/OBSERVABILITY.md inventory table")
+    for name in sorted(documented - names):
+        problems.append(
+            f"{name} is documented in docs/OBSERVABILITY.md but not in "
+            "obs/inventory.py SPECS (stale doc row?)")
+    return problems
+
+
+class MetricsDocsPass(Pass):
+    rule = "metrics-docs"
+    doc = "obs.inventory.SPECS and the docs/OBSERVABILITY.md table agree"
+
+    def run(self, project: Project) -> list[Finding]:
+        doc = project.read_text(DOC_PATH)
+        if doc is None:
+            return []  # fixture tree without the doc
+        names = None
+        inv = project.file(INVENTORY_PATH)
+        if inv is not None:
+            names = _ast_names(inv.tree)
+            if names is None:
+                return [self.finding(
+                    INVENTORY_PATH, 0,
+                    "no module-level SPECS dict literal found — the "
+                    "metric inventory table is gone")]
+        if names is None:
+            names = _live_names()
+        return [self.finding(DOC_PATH, 0, problem)
+                for problem in compare(doc, names)]

@@ -1,0 +1,249 @@
+"""traced-purity: no host side effects inside traced code, and no
+import-time jax work.
+
+Two invariants that protect the engine's cold start and CPU-only mode:
+
+1. Inside jit/pallas-traced functions in ``ops/`` (decorated with
+   ``jax.jit`` / ``partial(jax.jit, ...)``, or wrapped via a
+   ``jax.jit(fn)`` call), no host side effects: ``print``, ``.item()``
+   / ``.tolist()`` (forces a device sync per trace), ``open``,
+   ``time.*`` reads, ``os.environ``, or NumPy calls on non-constant
+   arguments (an ``np.*`` call on a traced value silently falls back
+   to host execution inside the trace; scalar constants like
+   ``np.uint32(0)`` are fine and idiomatic). ``jax.debug.*`` is the
+   sanctioned escape hatch and is allowed.
+
+2. No module-import-time jax usage: (a) module-level statements in
+   ``ops/`` must not *call* into jax/jnp/pallas (constants built at
+   import time allocate device buffers before the CLI even parses
+   flags); (b) outside ``ops/`` and ``parallel/`` — the two
+   designated lazily-imported device packages — ``import jax`` must be
+   function-scoped or inside a try/except guard, or ``--backend=cpu``
+   pays jax's import cost (and breaks where jax is absent: pyproject
+   makes it an optional extra).
+"""
+
+import ast
+
+from tools.analysis.core import Finding, Pass, Project, SourceFile
+
+OPS_SCOPE = ("klogs_tpu/ops",)
+# Whole-package scan for the import placement rule.
+PKG_SCOPE = ("klogs_tpu",)
+# Modules allowed to import jax at module level: the device packages,
+# only ever imported from inside function bodies elsewhere.
+JAX_IMPORT_OK = ("klogs_tpu/ops/", "klogs_tpu/parallel/")
+
+_JAX_ROOTS = {"jax", "jnp", "pl", "pltpu"}
+
+
+def _root_name(node: ast.AST) -> "str | None":
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _jax_aliases(tree: ast.AST) -> set:
+    """Local names bound to jax modules by this file's imports."""
+    names = set(_JAX_ROOTS)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax" or a.name.startswith("jax."):
+                    names.add((a.asname or a.name).split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and node.module.startswith("jax"):
+                for a in node.names:
+                    names.add(a.asname or a.name)
+    return names
+
+
+def _is_constant(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_is_constant(e) for e in node.elts)
+    if isinstance(node, ast.UnaryOp):
+        return _is_constant(node.operand)
+    if isinstance(node, ast.BinOp):
+        return _is_constant(node.left) and _is_constant(node.right)
+    return False
+
+
+def _is_type_checking(test: ast.AST) -> bool:
+    return (isinstance(test, ast.Name) and test.id == "TYPE_CHECKING") or (
+        isinstance(test, ast.Attribute) and test.attr == "TYPE_CHECKING")
+
+
+def _import_time_nodes(tree: ast.AST, skip_try: bool = False):
+    """Nodes that execute at module import: the whole module tree MINUS
+    function/lambda bodies (they run later, when called) and
+    ``if TYPE_CHECKING:`` blocks (never at runtime — the sanctioned
+    annotation-import idiom). Class bodies stay in — they execute at
+    import. ``skip_try`` additionally prunes ``try:`` subtrees (the
+    module-level guard idiom)."""
+    stack = list(tree.body) if isinstance(tree, ast.Module) else [tree]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.If) and _is_type_checking(node.test):
+            continue
+        if skip_try and isinstance(node, ast.Try):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _decorated_jit(fn) -> bool:
+    for dec in fn.decorator_list:
+        target = dec
+        if isinstance(dec, ast.Call):
+            # partial(jax.jit, ...) / functools.partial(jax.jit, ...)
+            if _dotted(dec.func).endswith("partial") and dec.args:
+                target = dec.args[0]
+            else:
+                target = dec.func
+        if _dotted(target).endswith("jit"):
+            return True
+    return False
+
+
+class TracedPurityPass(Pass):
+    rule = "traced-purity"
+    doc = ("no host side effects in jit/pallas-traced code; no "
+           "import-time jax work; jax imports lazy outside ops/parallel")
+
+    def run(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for sf in project.files(*OPS_SCOPE):
+            self._check_ops_file(sf, findings)
+        for sf in project.files(*PKG_SCOPE):
+            if not any(sf.relpath.startswith(p) for p in JAX_IMPORT_OK):
+                self._check_import_placement(sf, findings)
+        return findings
+
+    # -- rule 2a: import-time device work in ops/ ----------------------
+    def _check_ops_file(self, sf: SourceFile, findings: list) -> None:
+        aliases = _jax_aliases(sf.tree)
+        for node in _import_time_nodes(sf.tree):
+            if (isinstance(node, ast.Call)
+                    and _root_name(node.func) in aliases
+                    # jit/partial WRAPPING is lazy (tracing happens on
+                    # first call) — only actual array/device calls do
+                    # import-time work.
+                    and not _dotted(node.func).endswith("jit")):
+                findings.append(self.finding(
+                    sf.relpath, node.lineno,
+                    f"module-level call to {_dotted(node.func)}() "
+                    "runs device work at import time (move it "
+                    "inside a function)"))
+        # rule 1 needs the traced-function set.
+        traced = self._traced_functions(sf.tree)
+        for fn in traced:
+            self._check_traced_body(sf, fn, aliases, findings)
+
+    def _traced_functions(self, tree: ast.AST) -> list:
+        """jit-decorated defs, plus defs whose NAME is passed to a
+        ``jax.jit(...)`` / ``partial(jax.jit, ...)`` call in the file."""
+        defs: dict = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.FunctionDef):
+                defs.setdefault(node.name, node)
+        traced: list = []
+        wrapped: set = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and _dotted(
+                    node.func).endswith("jit"):
+                for arg in node.args[:1]:
+                    if isinstance(arg, ast.Name):
+                        wrapped.add(arg.id)
+        for name, fn in defs.items():
+            if _decorated_jit(fn) or name in wrapped:
+                traced.append(fn)
+        return traced
+
+    # -- rule 1: host effects inside a traced body ---------------------
+    def _check_traced_body(self, sf: SourceFile, fn, aliases: set,
+                           findings: list) -> None:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                if (isinstance(node, ast.Subscript)
+                        and _dotted(node.value) == "os.environ"):
+                    findings.append(self.finding(
+                        sf.relpath, node.lineno,
+                        f"os.environ read inside traced {fn.name}() "
+                        "(trace-time constant burned into the jit "
+                        "cache; read it before tracing)"))
+                continue
+            func = node.func
+            dotted = _dotted(func)
+            if isinstance(func, ast.Name) and func.id == "print":
+                findings.append(self.finding(
+                    sf.relpath, node.lineno,
+                    f"print() inside traced {fn.name}() (runs at trace "
+                    "time only; use jax.debug.print for runtime "
+                    "values)"))
+            elif isinstance(func, ast.Name) and func.id == "open":
+                findings.append(self.finding(
+                    sf.relpath, node.lineno,
+                    f"open() inside traced {fn.name}() is a host side "
+                    "effect"))
+            elif (isinstance(func, ast.Attribute)
+                    and func.attr in ("item", "tolist")
+                    and _root_name(func) not in ("self",)):
+                findings.append(self.finding(
+                    sf.relpath, node.lineno,
+                    f".{func.attr}() inside traced {fn.name}() forces "
+                    "a host sync on a traced value"))
+            elif dotted.startswith("time.") or dotted == "os.environ.get":
+                findings.append(self.finding(
+                    sf.relpath, node.lineno,
+                    f"{dotted}() inside traced {fn.name}() is a "
+                    "trace-time host read (hoist it out of the traced "
+                    "function)"))
+            elif (_root_name(func) == "np"
+                    and not dotted.startswith("np.debug")):
+                args = list(node.args) + [kw.value for kw in node.keywords]
+                if not all(_is_constant(a) for a in args):
+                    findings.append(self.finding(
+                        sf.relpath, node.lineno,
+                        f"{dotted}() on non-constant arguments inside "
+                        f"traced {fn.name}() (NumPy can't see traced "
+                        "values; use jnp, or hoist host math out of "
+                        "the trace)"))
+
+    # -- rule 2b: jax import placement outside device packages ---------
+    def _check_import_placement(self, sf: SourceFile,
+                                findings: list) -> None:
+        # Walk everything that runs at import (if/for/with blocks
+        # included — `if cond: import jax` still imports jax), pruning
+        # function bodies (lazy, allowed) and try: subtrees (the
+        # guarded-import idiom).
+        for node in _import_time_nodes(sf.tree, skip_try=True):
+            is_jax = False
+            if isinstance(node, ast.Import):
+                is_jax = any(a.name == "jax" or a.name.startswith("jax.")
+                             for a in node.names)
+            elif isinstance(node, ast.ImportFrom):
+                is_jax = bool(node.module
+                              and node.module.startswith("jax"))
+            if is_jax:
+                findings.append(self.finding(
+                    sf.relpath, node.lineno,
+                    "module-level jax import outside ops/ and "
+                    "parallel/ breaks CPU-only mode and taxes cold "
+                    "start (import inside the function that needs "
+                    "it, or guard with try/except)"))

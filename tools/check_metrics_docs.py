@@ -1,20 +1,16 @@
 #!/usr/bin/env python
 """Lint: the metric inventory in code and docs must agree.
 
-Compares ``klogs_tpu.obs.inventory.SPECS`` (the single place metric
-names/types/help live; ``Registry.family`` resolves through it, so a
-name used anywhere in the code is in SPECS by construction) against the
-inventory table in docs/OBSERVABILITY.md, in both directions:
-
-- a SPECS entry missing from the doc table = undocumented metric;
-- a doc table row naming no SPECS entry = stale documentation.
-
-Run standalone (exit 1 on any finding) or via tier-1
+Folded into the project-native static-analysis suite as the
+``metrics-docs`` pass (tools/analysis/passes/metrics_docs.py — see
+docs/STATIC_ANALYSIS.md); this shim keeps the standalone CLI and the
+``from tools.check_metrics_docs import check`` tier-1 entry point
+working unchanged. Run standalone (exit 1 on any finding), via
+``python -m tools.analysis``, or via tier-1
 tests/test_obs.py::test_metrics_docs_lint.
 """
 
 import os
-import re
 import sys
 
 _ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
@@ -23,35 +19,13 @@ DOC = os.path.join(_ROOT, "docs", "OBSERVABILITY.md")
 if __package__ in (None, ""):  # standalone `python tools/check_...py`
     sys.path.insert(0, os.path.abspath(_ROOT))
 
-# Inventory-table rows only: "| `klogs_...` | type | ..." — prose
-# mentions of metric names elsewhere in the doc are not inventory.
-_ROW = re.compile(r"^\|\s*`(klogs_[a-z0-9_]+)`\s*\|", re.MULTILINE)
+from tools.analysis.passes.metrics_docs import check  # noqa: E402
 
-
-def check(doc_path: str = DOC) -> list[str]:
-    """Returns a list of problems (empty = consistent)."""
-    from klogs_tpu.obs.inventory import SPECS
-
-    try:
-        with open(doc_path) as f:
-            doc = f.read()
-    except OSError as e:
-        return [f"cannot read {doc_path}: {e}"]
-    documented = set(_ROW.findall(doc))
-    problems = []
-    for name in sorted(set(SPECS) - documented):
-        problems.append(
-            f"{name} is registered in obs/inventory.py but missing from "
-            "the docs/OBSERVABILITY.md inventory table")
-    for name in sorted(documented - set(SPECS)):
-        problems.append(
-            f"{name} is documented in docs/OBSERVABILITY.md but not in "
-            "obs/inventory.py SPECS (stale doc row?)")
-    return problems
+__all__ = ["check", "DOC", "main"]
 
 
 def main() -> int:
-    problems = check()
+    problems = check(DOC)
     for p in problems:
         print(f"check_metrics_docs: {p}", file=sys.stderr)
     if not problems:
