@@ -277,7 +277,8 @@ def make_pipeline_for(opts: Options, registry=None):
     try:
         return make_pipeline(opts.match, opts.backend, remote=opts.remote,
                              ignore_case=opts.ignore_case,
-                             exclude=opts.exclude, registry=registry)
+                             exclude=opts.exclude, registry=registry,
+                             on_filter_error=opts.on_filter_error)
     except _re.error as e:
         term.fatal("invalid --match/--exclude pattern %r: %s", e.pattern, e)
     except RegexSyntaxError as e:
@@ -353,6 +354,19 @@ async def _run_async_inner(
     select_keys: Iterable[str] | None = None,
 ) -> int:
     widgets.splash_screen()
+    # Chaos layer: a KLOGS_FAULTS spec scripts the registered fault
+    # points for this run (grammar in docs/RESILIENCE.md). Loud when
+    # armed — nobody should discover a forgotten fault spec from
+    # mystery retries in production.
+    from klogs_tpu.resilience import FAULTS, FaultSpecError
+
+    fault_spec = os.environ.get("KLOGS_FAULTS")
+    if fault_spec:
+        try:
+            FAULTS.load_spec(fault_spec)
+        except FaultSpecError as e:
+            term.fatal("invalid KLOGS_FAULTS: %s", e)
+        term.warning("Fault injection ACTIVE (KLOGS_FAULTS=%s)", fault_spec)
     backend = backend or make_backend(opts)
     profiling = False
     if opts.profile:
@@ -430,6 +444,14 @@ async def _run_async_inner(
 
             obs_registry.family("klogs_build_info").labels(
                 version=_ver).set(1)
+        # Resilience observability rides the same per-run registry:
+        # fault firings, kube retry attempts (the backend exists before
+        # the registry, hence the late bind), breaker state (bound in
+        # the remote client via make_pipeline's registry).
+        FAULTS.bind_registry(obs_registry)
+        backend_bind = getattr(backend, "bind_registry", None)
+        if backend_bind is not None and obs_registry is not None:
+            backend_bind(obs_registry)
 
         pipeline = make_pipeline_for(opts, registry=obs_registry)
         inner_factory = make_inner_sink_factory(opts)
@@ -491,13 +513,19 @@ async def _run_async_inner(
             # waits (the point of starting the watch before deploying).
             interrupted = False
             if opts.follow and (jobs or plan_new is not None):
+                own_stop = stop is None
+                if own_stop:
+                    stop = asyncio.Event()
+                # The flusher gets the stop event so an
+                # --on-filter-error=abort escalation from an idle
+                # stream's stale flush tears the run down instead of
+                # dying silently in a background task.
                 flusher = (
-                    asyncio.create_task(pipeline.run_deadline_flusher())
+                    asyncio.create_task(pipeline.run_deadline_flusher(stop))
                     if pipeline is not None else None
                 )
                 sigint_installed = False
-                if stop is None:
-                    stop = asyncio.Event()
+                if own_stop:
                     # Ctrl-C parity+: the reference exits with streams
                     # still running and buffers unflushed (SURVEY §3.3
                     # quirk class). First SIGINT = graceful stop (same

@@ -40,6 +40,9 @@ New (north-star) flags, absent from the reference:
                     mutually exclusive with -s/--since)
   --backend         filter engine: cpu (host regex) | tpu (batch NFA)
   --remote          gate writes via a klogs-filterd service (gRPC)
+  --on-filter-error what to do when the filter service is unavailable
+                    after retries: pass | drop | abort (default abort;
+                    see docs/RESILIENCE.md)
   --profile         write a JAX profiler trace of the run to DIR
   --stats           print lines/sec, matched %, batch-latency summary
   --metrics-port    serve Prometheus /metrics + /healthz for this run
@@ -75,6 +78,7 @@ class Options:
     ignore_case: bool = False
     backend: str = "cpu"
     remote: str | None = None
+    on_filter_error: str = "abort"
     stats: bool = False
     metrics_port: int | None = None
     stats_json: str | None = None
@@ -183,6 +187,16 @@ def build_parser() -> argparse.ArgumentParser:
         metavar="HOST:PORT",
         help="Filter via a remote klogs-filterd service "
         "(python -m klogs_tpu.service) instead of an in-process engine",
+    )
+    p.add_argument(
+        "--on-filter-error",
+        choices=["pass", "drop", "abort"],
+        default="abort",
+        dest="on_filter_error",
+        help="With --match/--exclude: how sinks degrade when the filter "
+        "service stays unavailable (retries exhausted, circuit breaker "
+        "open): write lines UNFILTERED (pass), discard them (drop), or "
+        "end the run with one clear error (abort, default)",
     )
     p.add_argument(
         "--stats",
@@ -310,6 +324,7 @@ def parse_args(argv: list[str] | None = None) -> Options:
         ignore_case=ns.ignore_case,
         backend=ns.backend,
         remote=ns.remote,
+        on_filter_error=ns.on_filter_error,
         stats=ns.stats,
         metrics_port=ns.metrics_port,
         stats_json=ns.stats_json,

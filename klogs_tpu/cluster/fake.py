@@ -13,13 +13,19 @@ import time
 from dataclasses import dataclass, field
 from typing import AsyncIterator, Callable
 
-from klogs_tpu.cluster.backend import ClusterBackend, LogStream, StreamError
+from klogs_tpu.cluster.backend import (
+    ClusterBackend,
+    ClusterError,
+    LogStream,
+    StreamError,
+)
 from klogs_tpu.cluster.types import (
     ContainerInfo,
     LogOptions,
     PodInfo,
     match_label_selector,
 )
+from klogs_tpu.resilience import FAULTS, InjectedFault
 
 LEVELS = ("INFO", "DEBUG", "WARN", "ERROR")
 
@@ -304,6 +310,15 @@ class FakeCluster(ClusterBackend):
     async def list_pods(
         self, namespace: str, label_selector: str | None = None
     ) -> list[PodInfo]:
+        # Chaos fault point: the same name KubeBackend fires, so a
+        # KLOGS_FAULTS script behaves identically against the hermetic
+        # backend (the fake has no retry layer of its own; injected
+        # faults surface as the errors callers must tolerate).
+        if FAULTS.active:
+            try:
+                await FAULTS.fire("kube.list_pods")
+            except InjectedFault as e:
+                raise ClusterError(f"list pods in {namespace!r}: {e}") from e
         pods = self.namespaces.get(namespace, {})
         out = []
         for pod in pods.values():
@@ -317,6 +332,12 @@ class FakeCluster(ClusterBackend):
     async def open_log_stream(
         self, namespace: str, pod: str, opts: LogOptions
     ) -> LogStream:
+        if FAULTS.active:
+            try:
+                await FAULTS.fire("kube.log_stream")
+            except InjectedFault as e:
+                raise StreamError(
+                    f"open log stream {pod}/{opts.container}: {e}") from e
         try:
             fp = self.namespaces[namespace][pod]
             fc = fp.containers[opts.container]

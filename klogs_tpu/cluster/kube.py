@@ -30,10 +30,24 @@ from klogs_tpu.cluster.backend import (
 )
 from klogs_tpu.cluster.kubeconfig import ClusterCreds, KubeconfigError, load_creds
 from klogs_tpu.cluster.types import ContainerInfo, LogOptions, PodInfo
+from klogs_tpu.resilience import FAULTS, InjectedFault, RetryPolicy
 from klogs_tpu.ui import term
 
 BURST = 100  # ≙ rest config Burst (cmd/root.go:80)
 CHUNK_BYTES = 64 * 1024
+
+# Control-plane retry (resilience subsystem): transient apiserver
+# weather — 5xx, dropped connections, connect timeouts — on the
+# list/discovery GETs is retried with jittered backoff before the
+# friendly ClusterError surfaces. Short budget: these gate interactive
+# startup, so worst-case added latency stays under ~2s.
+DEFAULT_RETRY = RetryPolicy(max_attempts=4, base_s=0.25, max_s=2.0,
+                            jitter=0.1)
+
+
+class _TransientHTTPError(Exception):
+    """Internal: a 5xx the retry loop may still fix; never escapes
+    _get_json (converted to ClusterError on exhaustion)."""
 
 
 class KubeLogStream(LogStream):
@@ -47,16 +61,24 @@ class KubeLogStream(LogStream):
         try:
             async for chunk in self._resp.content.iter_chunked(CHUNK_BYTES):
                 yield chunk
-        except aiohttp.ClientError as e:
-            raise StreamError(f"log stream failed: {e}") from e
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            # TimeoutError is not a ClientError subclass but is the
+            # same mid-stream "connection went away" UX; the fanout
+            # layer owns the reconnect policy either way.
+            raise StreamError(
+                f"log stream failed: {str(e) or 'read timed out'}") from e
 
     async def close(self) -> None:
         self._resp.close()
 
 
 class KubeBackend(ClusterBackend):
-    def __init__(self, creds: ClusterCreds):
+    def __init__(self, creds: ClusterCreds,
+                 retry: "RetryPolicy | None" = None, registry=None):
         self._creds = creds
+        self._retry = retry if retry is not None else DEFAULT_RETRY
+        self._retries_metric = None
+        self.bind_registry(registry)
         # Auth is resolved PER REQUEST (not baked into session headers):
         # exec-plugin tokens rotate (~1h on GKE/EKS), and a --follow run
         # outliving its token would otherwise 401 until restart. The
@@ -97,24 +119,44 @@ class KubeBackend(ClusterBackend):
     def current_context(self) -> tuple[str, str]:
         return self._creds.context_name, self._creds.namespace
 
-    async def _get_json(self, path: str, params: dict | None = None):
-        """Control-plane GET. Failures surface as ClusterError with a
+    def bind_registry(self, registry) -> None:
+        """Late obs wiring (the backend exists before the per-run
+        registry does): point the kube retry counter at this run."""
+        if registry is not None:
+            self._retries_metric = registry.family(
+                "klogs_retry_attempts_total").labels(site="kube")
+
+    async def _get_json(self, path: str, params: dict | None = None,
+                        fault_point: "str | None" = None):
+        """Control-plane GET. Transient failures (5xx, ClientError,
+        connect timeout, injected faults) are retried under the shared
+        RetryPolicy; what survives surfaces as ClusterError with a
         one-line human message (the app boundary prints it and exits 1,
         ≙ the reference's pterm panic, cmd/root.go:110,130) instead of a
-        raw aiohttp traceback."""
-        try:
-            for attempt in (0, 1):
+        raw aiohttp traceback. The one-shot 401 token refresh (client-go
+        transport parity) rides INSIDE the loop and consumes no retry
+        budget."""
+        attempt = 0
+        refreshed = False  # the one-shot forced token refresh happened
+        force = False      # force the provider on the NEXT header fetch
+        while True:
+            try:
+                if fault_point is not None and FAULTS.active:
+                    await FAULTS.fire(fault_point)
                 async with self._session.get(
                     path, params=params or {},
-                    headers=await self._auth_headers(force_refresh=attempt > 0),
+                    headers=await self._auth_headers(force_refresh=force),
                 ) as resp:
+                    force = False
                     if resp.status == 404:
                         return None
-                    if (resp.status == 401 and attempt == 0
+                    if (resp.status == 401 and not refreshed
                             and self._creds.token_provider is not None):
                         # Token rejected before its cached expiry (e.g.
                         # revoked/rotated server-side): force the helper
                         # once and retry, like client-go's transport.
+                        refreshed = True
+                        force = True
                         continue
                     if resp.status in (401, 403):
                         word = ("Unauthorized" if resp.status == 401
@@ -125,6 +167,13 @@ class KubeBackend(ClusterBackend):
                             f"kubeconfig credentials (context "
                             f"{self._creds.context_name!r})"
                         )
+                    if resp.status >= 500:
+                        # Transient apiserver weather (client-go retries
+                        # these at the transport layer too).
+                        body = (await resp.text())[:200]
+                        raise _TransientHTTPError(
+                            f"apiserver error HTTP {resp.status} on "
+                            f"{path}: {body}")
                     if resp.status >= 400:
                         body = (await resp.text())[:200]
                         raise ClusterError(
@@ -132,13 +181,25 @@ class KubeBackend(ClusterBackend):
                             f"{body}"
                         )
                     return await resp.json()
-        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
-            # asyncio.TimeoutError: aiohttp's total-timeout is not a
-            # ClientError subclass but is the same "can't reach it" UX.
-            raise ClusterError(
-                f"cannot reach apiserver {self._creds.server}: "
-                f"{e or 'request timed out'}"
-            ) from e
+            except (aiohttp.ClientError, asyncio.TimeoutError,
+                    InjectedFault, _TransientHTTPError) as e:
+                # asyncio.TimeoutError: aiohttp's total-timeout is not a
+                # ClientError subclass but is the same "can't reach it"
+                # UX. InjectedFault: chaos scripts drive this exact
+                # retry path (docs/RESILIENCE.md).
+                if not self._retry.retries_left(attempt):
+                    if isinstance(e, _TransientHTTPError):
+                        raise ClusterError(
+                            f"{e} (after {attempt + 1} attempts)") from e
+                    raise ClusterError(
+                        f"cannot reach apiserver {self._creds.server}: "
+                        f"{str(e) or 'request timed out'} "
+                        f"(after {attempt + 1} attempts)"
+                    ) from e
+                if self._retries_metric is not None:
+                    self._retries_metric.inc()
+                await self._retry.sleep(attempt)
+                attempt += 1
 
     async def namespace_exists(self, namespace: str) -> bool:
         return await self._get_json(f"/api/v1/namespaces/{namespace}") is not None
@@ -152,7 +213,8 @@ class KubeBackend(ClusterBackend):
     ) -> list[PodInfo]:
         params = {"labelSelector": label_selector} if label_selector else None
         data = await self._get_json(
-            f"/api/v1/namespaces/{namespace}/pods", params
+            f"/api/v1/namespaces/{namespace}/pods", params,
+            fault_point="kube.list_pods",
         )
         if data is None:
             return []
@@ -175,6 +237,8 @@ class KubeBackend(ClusterBackend):
         if opts.since_time is not None:
             params["sinceTime"] = opts.since_time
         try:
+            if FAULTS.active:
+                await FAULTS.fire("kube.log_stream")
             resp = None
             for attempt in (0, 1):
                 resp = await self._session.get(
@@ -197,8 +261,15 @@ class KubeBackend(ClusterBackend):
                     f"GET log for {pod}/{opts.container}: "
                     f"HTTP {resp.status}: {body}"
                 )
-        except aiohttp.ClientError as e:
-            raise StreamError(f"open log stream {pod}/{opts.container}: {e}") from e
+        except (aiohttp.ClientError, asyncio.TimeoutError,
+                InjectedFault) as e:
+            # asyncio.TimeoutError: the sock_connect=30 bound above is
+            # NOT a ClientError — before the resilience work a connect
+            # timeout escaped as a raw traceback instead of the
+            # StreamError the fanout reconnect policy handles.
+            raise StreamError(
+                f"open log stream {pod}/{opts.container}: "
+                f"{str(e) or 'connect timed out'}") from e
         return KubeLogStream(resp)
 
     async def close(self) -> None:

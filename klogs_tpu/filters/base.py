@@ -66,6 +66,13 @@ class FilterStats:
         self._bucket_width = r.family("klogs_engine_bucket_width_bytes")
         self._pad_bytes = r.family("klogs_engine_pad_bytes_total")
         self._payload_bytes = r.family("klogs_engine_payload_bytes_total")
+        # Degrade-policy visibility (--on-filter-error, resilience):
+        # batches/lines that bypassed or skipped filtering because the
+        # filter service was unavailable.
+        self._degraded_batches = r.family(
+            "klogs_filter_degraded_batches_total")
+        self._degraded_lines = r.family(
+            "klogs_filter_degraded_lines_total")
         self.pf_disabled_reason: str | None = None
         self.started_at = time.perf_counter()
         # Warmup boundary: timestamp when the FIRST batch started
@@ -157,6 +164,14 @@ class FilterStats:
         """A flush forced by the follow-mode deadline (not batch size)
         — the signal that sinks are running latency-bound."""
         self._deadline_flushes.inc()
+
+    def record_degraded(self, action: str, n_lines: int) -> None:
+        """One sink flush handled by the --on-filter-error degrade
+        policy instead of the filter (service unavailable): ``action``
+        is what happened to its lines (pass = written unfiltered,
+        drop = discarded)."""
+        self._degraded_batches.labels(action=action).inc()
+        self._degraded_lines.labels(action=action).inc(n_lines)
 
     def record_engine_batch(self, width: int, rows: int,
                             payload_bytes: int) -> None:

@@ -20,6 +20,7 @@ from typing import Callable
 
 from klogs_tpu.filters.base import FilterStats, LogFilter
 from klogs_tpu.filters.framer import LineFramer
+from klogs_tpu.resilience import Unavailable
 from klogs_tpu.runtime.fanout import StreamJob
 from klogs_tpu.runtime.sink import FileSink, Sink
 from klogs_tpu.ui import term
@@ -35,6 +36,7 @@ class FilteredSink(Sink):
         deadline_s: float = 0.05,
         on_close: "Callable[[FilteredSink], None] | None" = None,
         service: "AsyncFilterService | None" = None,
+        on_filter_error: str = "abort",
     ):
         self._inner = inner
         self._filter = log_filter
@@ -45,6 +47,12 @@ class FilteredSink(Sink):
         self._on_close = on_close
         self._closed = False
         self._service = service
+        # Degrade routing when the filter service is Unavailable
+        # (retries exhausted / breaker open): "pass" writes the batch
+        # unfiltered, "drop" discards it, "abort" (default) propagates
+        # — one friendly fatal line, reference-style.
+        self._on_filter_error = on_filter_error
+        self._degrade_warned = False
         # Fully-framed hot path when the native module and a framed
         # service are both present: chunks accumulate in ONE contiguous
         # buffer (C newline sweep), the verdicts come back as a numpy
@@ -118,7 +126,12 @@ class FilteredSink(Sink):
             from klogs_tpu.filters.base import frame_lines
 
             payload, offsets, bytes_in = frame_lines(pending)
-            mask_arr = await self._service.match_framed(payload, offsets)
+            try:
+                mask_arr = await self._service.match_framed(payload, offsets)
+            except Unavailable as e:
+                await self._degrade(e, n_lines=len(pending), payload=payload)
+                return
+            self._note_recovered()
             latency = time.perf_counter() - t0
             n_kept = int(np.count_nonzero(mask_arr))
             mask_b = np.ascontiguousarray(mask_arr, dtype=np.uint8).tobytes()
@@ -129,7 +142,13 @@ class FilteredSink(Sink):
                     ln for ln, keep in zip(pending, mask_b) if keep)
         else:
             if self._service is not None:
-                mask = await self._service.match(pending)
+                try:
+                    mask = await self._service.match(pending)
+                except Unavailable as e:
+                    await self._degrade(e, n_lines=len(pending),
+                                        payload=b"".join(pending))
+                    return
+                self._note_recovered()
             else:
                 mask = self._filter.match_lines(pending)
             latency = time.perf_counter() - t0
@@ -160,7 +179,12 @@ class FilteredSink(Sink):
             return
         t0 = time.perf_counter()
         if self._service is not None:
-            mask_arr = await self._service.match_framed(payload, offsets)
+            try:
+                mask_arr = await self._service.match_framed(payload, offsets)
+            except Unavailable as e:
+                await self._degrade(e, n_lines=n, payload=payload)
+                return
+            self._note_recovered()
         else:
             # Direct sync engine (--backend=cpu): the DFA scan releases
             # the GIL and runs at millions of lines/s — no service hop.
@@ -180,6 +204,33 @@ class FilteredSink(Sink):
             n_bytes_out=len(out),
             latency_s=latency,
         )
+
+    async def _degrade(self, e: Unavailable, *, n_lines: int,
+                       payload: bytes) -> None:
+        """Route a batch whose filter service is Unavailable per
+        --on-filter-error: pass = write unfiltered, drop = discard,
+        abort = propagate (the run ends with one friendly line). The
+        choice is counted per action so a scrape shows exactly how many
+        lines rode each degrade path."""
+        if self._on_filter_error == "abort":
+            raise e
+        if not self._degrade_warned:
+            self._degrade_warned = True
+            term.warning(
+                "filter service unavailable (%s); --on-filter-error=%s: "
+                "%s lines until it recovers", e, self._on_filter_error,
+                "writing UNFILTERED" if self._on_filter_error == "pass"
+                else "DROPPING")
+        if self._on_filter_error == "pass" and payload:
+            await self._inner.write(payload)
+        self._stats.record_degraded(self._on_filter_error, n_lines)
+
+    def _note_recovered(self) -> None:
+        # One line when filtering resumes after a degraded stretch —
+        # the operator bookend to the degrade warning.
+        if self._degrade_warned:
+            self._degrade_warned = False
+            term.info("filter service recovered; filtering resumed")
 
     async def flush_if_stale(self) -> None:
         """Flush pending lines whose deadline has passed (called by the
@@ -203,12 +254,16 @@ class FilteredSink(Sink):
         self._closed = True
         if self._on_close is not None:
             self._on_close(self)
-        if self._batcher is None:
-            rest = self._framer.flush()
-            if rest is not None:
-                self._pending.append(rest)
-        await self._flush_pending(final=True)
-        await self._inner.close()
+        try:
+            if self._batcher is None:
+                rest = self._framer.flush()
+                if rest is not None:
+                    self._pending.append(rest)
+            await self._flush_pending(final=True)
+        finally:
+            # The inner sink (file fd) is released even when the final
+            # flush dies on an unavailable service or a full disk.
+            await self._inner.close()
 
     @property
     def bytes_written(self) -> int:
@@ -231,6 +286,9 @@ class FilterPipeline:
     patterns: list[str] | None = None
     ignore_case: bool = False
     exclude: list[str] | None = None
+    # --on-filter-error degrade routing for every sink this pipeline
+    # builds (pass|drop|abort; see FilteredSink).
+    on_filter_error: str = "abort"
     # Where gated lines land; None = the reference behavior (a FileSink
     # on job.path). ``-o stdout|both`` injects console/tee factories.
     inner_factory: "Callable[[StreamJob], Sink] | None" = None
@@ -247,24 +305,46 @@ class FilterPipeline:
             deadline_s=self.deadline_s,
             on_close=self._live_sinks.discard,
             service=self.service,
+            on_filter_error=self.on_filter_error,
         )
         self._live_sinks.add(sink)
         return sink
 
-    async def run_deadline_flusher(self) -> None:
+    async def run_deadline_flusher(self,
+                                   stop: "asyncio.Event | None" = None
+                                   ) -> None:
         """Follow-mode latency bound: periodically force pending lines in
         every live sink through the filter, so a matching line from a
         quiet container appears within ~deadline_s even if no further
-        chunks arrive. Run as a background task; cancel to stop."""
+        chunks arrive. Run as a background task; cancel to stop.
+
+        ``--on-filter-error=abort`` escalation: an Unavailable raised by
+        a stale flush means the documented "end the run with one clear
+        error" — set ``stop`` (graceful stream teardown) and re-raise so
+        the awaiter surfaces it, instead of quietly dropping the batch
+        of an idle stream that will never write again."""
         while True:
             await asyncio.sleep(self.deadline_s / 2)
             # Concurrent: a serial sweep over N slow flushes would make
             # the sweep period N x the flush latency (observed: minutes
             # at 200 sinks). With the coalescing service these merge
-            # into a handful of device batches anyway.
-            await asyncio.gather(
-                *[s.flush_if_stale() for s in list(self._live_sinks)]
+            # into a handful of device batches anyway. Per-sink fault
+            # isolation: one dead SINK (SinkError) must not kill the
+            # flusher for every healthy stream — its own worker
+            # surfaces that failure at the next write.
+            results = await asyncio.gather(
+                *[s.flush_if_stale() for s in list(self._live_sinks)],
+                return_exceptions=True,
             )
+            for r in results:
+                if isinstance(r, Unavailable):
+                    term.error("filter service unavailable and "
+                               "--on-filter-error=abort: stopping (%s)", r)
+                    if stop is not None:
+                        stop.set()
+                    raise r
+                if isinstance(r, Exception):
+                    term.warning("deadline flush failed: %s", r)
 
     async def start(self) -> None:
         """Pre-flight: remote services verify the collector's pattern
@@ -357,7 +437,8 @@ def make_pipeline(patterns: list[str], backend: str,
                   remote: str | None = None,
                   ignore_case: bool = False,
                   exclude: list[str] | None = None,
-                  registry=None) -> FilterPipeline:
+                  registry=None,
+                  on_filter_error: str = "abort") -> FilterPipeline:
     # ``registry`` (an obs.Registry) shares the stats backing store
     # with a /metrics sidecar or --stats-json dump; None keeps the
     # pipeline's numbers private (default, and what tests rely on).
@@ -377,12 +458,30 @@ def make_pipeline(patterns: list[str], backend: str,
         # rotated mounted Secret keeps working mid-follow). A bad combo
         # raises ServiceConfigError, which the CLI maps to one friendly
         # line — no SystemExit from library code.
+        # Per-RPC deadline: KLOGS_REMOTE_TIMEOUT_S bounds each attempt
+        # (retry/backoff/breaker defaults live in the client; see
+        # docs/RESILIENCE.md).
+        raw_timeout = os.environ.get("KLOGS_REMOTE_TIMEOUT_S", "30")
+        try:
+            rpc_timeout_s = float(raw_timeout)
+            if rpc_timeout_s <= 0:
+                raise ValueError("must be positive")
+        except ValueError as e:
+            from klogs_tpu.service.client import ServiceConfigError
+
+            # Zero/negative would DEADLINE_EXCEED every attempt with an
+            # error that never names this env var — reject it here.
+            raise ServiceConfigError(
+                f"KLOGS_REMOTE_TIMEOUT_S must be a positive number, got "
+                f"{raw_timeout!r}") from e
         service = RemoteFilterClient(
             remote,
             tls_ca=os.environ.get("KLOGS_REMOTE_TLS_CA"),
             tls_cert=os.environ.get("KLOGS_REMOTE_TLS_CERT"),
             tls_key=os.environ.get("KLOGS_REMOTE_TLS_KEY"),
-            auth_token_file=os.environ.get("KLOGS_REMOTE_TOKEN_FILE"))
+            auth_token_file=os.environ.get("KLOGS_REMOTE_TOKEN_FILE"),
+            rpc_timeout_s=rpc_timeout_s,
+            registry=registry)
         return FilterPipeline(
             log_filter=None,
             stats=stats,
@@ -392,6 +491,7 @@ def make_pipeline(patterns: list[str], backend: str,
             patterns=patterns,
             ignore_case=ignore_case,
             exclude=exclude,
+            on_filter_error=on_filter_error,
         )
     if backend not in ("cpu", "tpu"):
         raise ValueError(f"unknown filter backend {backend!r}")
@@ -424,4 +524,5 @@ def make_pipeline(patterns: list[str], backend: str,
         batch_lines=batch_lines,
         deadline_s=deadline_s,
         service=service,
+        on_filter_error=on_filter_error,
     )

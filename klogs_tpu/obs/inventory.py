@@ -134,6 +134,25 @@ SPECS: dict[str, dict] = {
         "counter", "Sink writes that blocked longer than the stall "
         "threshold (downstream backpressure)."),
 
+    # -- resilience layer (retry/breaker/faults/degrade) --------------
+    "klogs_retry_attempts_total": _m(
+        "counter", "Retries performed by the shared resilience policy, "
+        "by call site (rpc, kube, fanout).", labels=("site",)),
+    "klogs_breaker_state": _m(
+        "gauge", "Circuit-breaker state: 0=closed, 1=open, 2=half-open.",
+        labels=("breaker",)),
+    "klogs_faults_injected_total": _m(
+        "counter", "Chaos faults fired, by registered fault point "
+        "(test API or KLOGS_FAULTS).", labels=("point",)),
+    "klogs_filter_degraded_batches_total": _m(
+        "counter", "Sink flushes degraded because the filter service "
+        "was unavailable, by --on-filter-error action.",
+        labels=("action",)),
+    "klogs_filter_degraded_lines_total": _m(
+        "counter", "Lines written unfiltered (action=pass) or dropped "
+        "(action=drop) while the filter service was unavailable.",
+        labels=("action",)),
+
     # -- RPC layer (filterd gRPC server) ------------------------------
     "klogs_rpc_requests_total": _m(
         "counter", "RPCs received, by method.", labels=("method",)),

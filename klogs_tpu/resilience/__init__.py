@@ -1,0 +1,52 @@
+"""klogs_tpu.resilience — unified failure-handling policy core.
+
+The reference has no failure handling to inherit (SURVEY.md §5): a
+single transient gRPC failure or apiserver 5xx killed a pipeline. This
+package is the one implementation every layer converges on:
+
+- ``RetryPolicy``: exponential backoff with jitter, stop-event-aware
+  sleeps (a Ctrl-C during backoff aborts the wait, never the process).
+- ``Deadline``: per-attempt time budget (feeds gRPC ``timeout=``).
+- ``CircuitBreaker``: three-state (closed → open → half-open) fast-fail
+  gate with timed half-open probes.
+- ``retry_call``: the guarded-call combinator tying the three together,
+  reporting through ``obs`` (``klogs_retry_attempts_total``,
+  ``klogs_breaker_state``).
+- ``FaultInjector`` / ``FAULTS``: the chaos layer — registered fault
+  points (``rpc.match``, ``kube.list_pods``, ``kube.log_stream``,
+  ``sink.write``) wrapping the same call sites the policies guard,
+  scripted from tests (``FAULTS.arm``) or the ``KLOGS_FAULTS`` env spec
+  (grammar in docs/RESILIENCE.md).
+
+Call-site map: ``service/client.py`` (per-RPC deadline + retry on
+UNAVAILABLE/DEADLINE_EXCEEDED + breaker), ``cluster/kube.py``
+(transient 5xx/ClientError retry on list/discovery), ``runtime/
+fanout.py`` (reconnect backoff), ``runtime/sink.py`` (fail-fast sink
+errors), ``filters/sink.py`` (``--on-filter-error`` degrade routing).
+"""
+
+from klogs_tpu.resilience.faults import (
+    FAULTS,
+    KNOWN_POINTS,
+    FaultInjector,
+    FaultSpecError,
+    InjectedFault,
+)
+from klogs_tpu.resilience.policy import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    BreakerOpen,
+    CircuitBreaker,
+    Deadline,
+    RetryPolicy,
+    Unavailable,
+    retry_call,
+)
+
+__all__ = [
+    "BREAKER_CLOSED", "BREAKER_HALF_OPEN", "BREAKER_OPEN", "BreakerOpen",
+    "CircuitBreaker", "Deadline", "FAULTS", "FaultInjector",
+    "FaultSpecError", "InjectedFault", "KNOWN_POINTS", "RetryPolicy",
+    "Unavailable", "retry_call",
+]

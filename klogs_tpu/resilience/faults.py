@@ -1,0 +1,168 @@
+"""FaultInjector: the chaos layer over the policy-guarded call sites.
+
+Fault points are names wrapping exactly the calls the resilience
+policies guard, so an armed fault exercises the REAL recovery path
+(retry loop, breaker, degrade routing) rather than a test double:
+
+- ``rpc.match`` / ``rpc.hello`` — filterd RPC issue (service/client.py)
+- ``kube.list_pods``            — pod list/discovery (cluster/kube.py,
+                                  cluster/fake.py)
+- ``kube.log_stream``           — log-stream open (cluster/kube.py,
+                                  cluster/fake.py)
+- ``sink.write``                — sink write (runtime/sink.py)
+
+Arming: tests call ``FAULTS.arm(point, times=..., exc=..., delay_s=...)``
+with whatever exception type the site really raises; operators/CI use
+the ``KLOGS_FAULTS`` spec string (see ``FaultInjector.load_spec`` for
+the grammar), whose ``error`` faults raise ``InjectedFault`` — every
+guarded site classifies InjectedFault as a transient failure, so an
+env-armed script always drives the retry path.
+
+Zero-overhead when idle: sites guard with ``if FAULTS.active`` so a
+production run never pays an awaitable hop per chunk. Each firing
+counts into ``klogs_faults_injected_total{point=...}`` when a registry
+is bound, so a chaos run's /metrics scrape shows exactly which faults
+fired how often next to the recovery counters they provoked.
+"""
+
+import asyncio
+import re
+from dataclasses import dataclass
+from typing import Callable
+
+KNOWN_POINTS = frozenset({
+    "rpc.match", "rpc.hello", "kube.list_pods", "kube.log_stream",
+    "sink.write",
+})
+
+
+class InjectedFault(Exception):
+    """Raised by env-spec ``error`` faults. Guarded call sites treat it
+    as a transient failure of the wrapped operation."""
+
+
+class FaultSpecError(ValueError):
+    """Malformed KLOGS_FAULTS spec string."""
+
+
+@dataclass
+class _Rule:
+    times: "int | None"  # remaining firings; None = forever
+    exc: "Callable[[], BaseException] | None"
+    delay_s: float = 0.0
+
+
+# One clause: point:action[*times]; action = error | error(msg) |
+# delay(seconds). *N = N firings, bare * = every firing, absent = once.
+_CLAUSE = re.compile(
+    r"^(?P<point>[a-z_.]+):(?P<action>error|delay)"
+    r"(?:\((?P<arg>[^)]*)\))?(?P<star>\*(?P<times>\d+)?)?$")
+
+
+class FaultInjector:
+    def __init__(self) -> None:
+        self._rules: "dict[str, list[_Rule]]" = {}
+        self.counts: "dict[str, int]" = {}
+        self._registry = None
+
+    @property
+    def active(self) -> bool:
+        return bool(self._rules)
+
+    def bind_registry(self, registry) -> None:
+        """Point firing counters at this run's obs registry (or None to
+        detach — registries are per-run, the injector is per-process)."""
+        self._registry = registry
+
+    def arm(self, point: str, *, times: "int | None" = 1,
+            exc: "BaseException | Callable[[], BaseException] | None" = None,
+            delay_s: float = 0.0) -> None:
+        """Script ``point`` to misbehave on its next ``times`` firings
+        (None = every firing). ``exc`` may be an exception instance
+        (re-raised as that instance each firing) or a zero-arg factory;
+        None with a delay = latency-only fault."""
+        factory = None
+        if exc is not None:
+            factory = exc if callable(exc) else (lambda e=exc: e)
+        self._rules.setdefault(point, []).append(
+            _Rule(times=times, exc=factory, delay_s=delay_s))
+
+    def clear(self) -> None:
+        self._rules.clear()
+        self.counts.clear()
+
+    def load_spec(self, spec: str) -> None:
+        """Parse a ``KLOGS_FAULTS`` spec and REPLACE the current script
+        (the spec describes the whole scenario). Grammar, clauses
+        separated by ``;`` or ``,``::
+
+            point:error            raise InjectedFault once
+            point:error(msg)*3     raise InjectedFault(msg), 3 firings
+            point:delay(0.5)*      sleep 0.5s before EVERY firing
+
+        Unknown points are rejected — a typoed point would otherwise be
+        a chaos script that silently tests nothing.
+        """
+        rules: "dict[str, list[_Rule]]" = {}
+        for raw in re.split(r"[;,]", spec):
+            clause = raw.strip()
+            if not clause:
+                continue
+            m = _CLAUSE.match(clause)
+            if m is None:
+                raise FaultSpecError(
+                    f"bad fault clause {clause!r} (want "
+                    "point:error[(msg)][*N] or point:delay(seconds)[*N])")
+            point = m.group("point")
+            if point not in KNOWN_POINTS:
+                raise FaultSpecError(
+                    f"unknown fault point {point!r} (known: "
+                    f"{', '.join(sorted(KNOWN_POINTS))})")
+            if m.group("star") is None:
+                times: "int | None" = 1
+            elif m.group("times") is not None:
+                times = int(m.group("times"))
+            else:
+                times = None  # bare '*': every firing
+            arg = m.group("arg")
+            if m.group("action") == "delay":
+                try:
+                    delay = float(arg) if arg else 0.0
+                except ValueError as e:
+                    raise FaultSpecError(
+                        f"bad delay seconds in {clause!r}") from e
+                rules.setdefault(point, []).append(
+                    _Rule(times=times, exc=None, delay_s=delay))
+            else:
+                msg = arg or f"injected fault at {point}"
+                rules.setdefault(point, []).append(_Rule(
+                    times=times, exc=(lambda m=msg: InjectedFault(m))))
+        self._rules = rules
+        self.counts.clear()
+
+    async def fire(self, point: str) -> None:
+        """Apply the next armed rule for ``point`` (no-op when none):
+        count it, apply the delay, raise the scripted exception."""
+        rules = self._rules.get(point)
+        if not rules:
+            return
+        rule = rules[0]
+        if rule.times is not None:
+            rule.times -= 1
+            if rule.times <= 0:
+                rules.pop(0)
+                if not rules:
+                    del self._rules[point]
+        self.counts[point] = self.counts.get(point, 0) + 1
+        if self._registry is not None:
+            self._registry.family("klogs_faults_injected_total").labels(
+                point=point).inc()
+        if rule.delay_s:
+            await asyncio.sleep(rule.delay_s)
+        if rule.exc is not None:
+            raise rule.exc()
+
+
+# The process-wide injector every guarded site consults. Tests arm and
+# clear it; app.run_async loads KLOGS_FAULTS into it at startup.
+FAULTS = FaultInjector()

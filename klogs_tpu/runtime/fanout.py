@@ -22,7 +22,8 @@ from typing import Callable
 
 from klogs_tpu.cluster.backend import ClusterBackend, StreamError
 from klogs_tpu.cluster.types import LogOptions, PodInfo
-from klogs_tpu.runtime.sink import FileSink, Sink
+from klogs_tpu.resilience import RetryPolicy
+from klogs_tpu.runtime.sink import FileSink, Sink, SinkError
 from klogs_tpu.ui import term
 from klogs_tpu.utils.naming import log_file_name
 
@@ -32,9 +33,11 @@ DEFAULT_OPEN_BURST = 100
 
 # Follow-mode reconnection (improvement over the reference, which has no
 # retry anywhere — SURVEY.md §5 "Failure detection"): a follow stream
-# that dies is reopened with exponential backoff and a server-side
-# `since` covering the gap. A connection that delivered data and lived
-# this long counts as healthy and resets the attempt budget.
+# that dies is reopened via the shared resilience RetryPolicy with a
+# server-side `since` covering the gap. A connection that delivered
+# data and lived this long counts as healthy and resets the attempt
+# budget. The module-level backoff knobs feed the default policy and
+# are read at decision time (tests monkeypatch them).
 DEFAULT_MAX_RECONNECTS = 5
 RECONNECT_HEALTHY_S = 5.0
 _BACKOFF_BASE_S = 0.5
@@ -120,6 +123,7 @@ class FanoutRunner:
         max_reconnects: int = DEFAULT_MAX_RECONNECTS,
         create_files: bool = True,
         registry=None,
+        reconnect_policy: "RetryPolicy | None" = None,
     ):
         self.backend = backend
         self.namespace = namespace
@@ -130,6 +134,10 @@ class FanoutRunner:
         self._stopping = False
         self._stop_event = asyncio.Event()
         self.max_reconnects = max_reconnects
+        # Reconnect policy override; None = the default built from
+        # max_reconnects + the module backoff knobs at decision time
+        # (so test monkeypatching of _BACKOFF_* keeps working).
+        self.reconnect_policy = reconnect_policy
         # -o stdout streams to the console only: job paths stay as
         # stable (pod, container) identities but no file is touched.
         self.create_files = create_files
@@ -146,6 +154,8 @@ class FanoutRunner:
                     "klogs_fanout_stream_errors_total"),
                 "stalls": registry.family(
                     "klogs_fanout_backpressure_stalls_total"),
+                "retries": registry.family(
+                    "klogs_retry_attempts_total").labels(site="fanout"),
             }
 
     async def _worker(self, job: StreamJob) -> StreamResult:
@@ -205,6 +215,7 @@ class FanoutRunner:
                     last_data = opened_at
                 got_data = False
                 stream_err: StreamError | None = None
+                sink_err: SinkError | None = None
                 try:
                     if m_bytes is None:
                         async for chunk in stream:
@@ -226,6 +237,8 @@ class FanoutRunner:
                                 stalls.inc()
                 except StreamError as e:
                     stream_err = e
+                except SinkError as e:
+                    sink_err = e
                 finally:
                     await stream.close()
                     try:
@@ -234,6 +247,18 @@ class FanoutRunner:
                             self._m["active"].dec()
                     except ValueError:
                         pass
+
+                if sink_err is not None:
+                    # The sink is dead (disk full, revoked mount):
+                    # reconnecting the STREAM would loop straight back
+                    # into the same failure with nowhere to put the
+                    # bytes. End this job cleanly with the sink's one
+                    # clear error (resilience subsystem; the upstream
+                    # log stream itself is fine).
+                    term.error("Sink failed for container %s\n%s",
+                               job.container, sink_err)
+                    result.error = str(sink_err)
+                    return result
 
                 if not self.log_opts.follow or self._stopping:
                     if stream_err is not None and not self._stopping:
@@ -295,33 +320,59 @@ class FanoutRunner:
                     timestamps=self.log_opts.timestamps,
                 )
         finally:
-            await sink.close()
+            try:
+                await sink.close()
+            except SinkError as e:
+                # ENOSPC at the final flush: record ONE clear error
+                # (unless the worker already has one) without masking
+                # an in-flight exception from the try body.
+                if result.error is None:
+                    term.error("Sink close failed for container %s\n%s",
+                               job.container, e)
+                    result.error = str(e)
             result.bytes_written = sink.bytes_written
             if self._m is not None and result.error is not None:
                 self._m["errors"].inc()
 
+    def _reconnect_policy(self) -> RetryPolicy:
+        """The effective reconnect policy: the injected one, or the
+        default assembled from max_reconnects + the module backoff
+        knobs (read HERE, not at import, so tests can monkeypatch
+        them). RetryPolicy.max_attempts keeps its documented meaning —
+        ALL tries including the first — where the "first try" is the
+        initial stream open, so the default grants max_reconnects
+        retries (identical behavior, consistent semantics across the
+        rpc/kube/fanout sites)."""
+        if self.reconnect_policy is not None:
+            return self.reconnect_policy
+        return RetryPolicy(max_attempts=self.max_reconnects + 1,
+                           base_s=_BACKOFF_BASE_S, max_s=_BACKOFF_MAX_S,
+                           jitter=0.0)
+
     async def _should_reconnect(self, job: StreamJob, attempt: int,
                                 err: "StreamError | None") -> bool:
         """Backoff-gated reconnect decision for follow mode; sleeps the
-        backoff (stop-aware) when reconnecting."""
+        shared RetryPolicy's backoff (stop-aware) when reconnecting —
+        the same policy implementation the RPC and kube layers use.
+        ``attempt`` is the 0-based count of reconnects already spent."""
         if not self.log_opts.follow or self._stopping:
             return False
-        if attempt >= self.max_reconnects:
+        policy = self._reconnect_policy()
+        if not policy.retries_left(attempt):
             return False
-        delay = min(_BACKOFF_BASE_S * (2 ** attempt), _BACKOFF_MAX_S)
+        delay = policy.delay_s(attempt)
         term.warning(
             "Stream for %s/%s ended (%s); reconnecting in %.1fs (attempt %d/%d)",
             job.pod, job.container, err if err else "EOF", delay,
-            attempt + 1, self.max_reconnects,
+            attempt + 1, policy.max_attempts - 1,
         )
-        try:
-            await asyncio.wait_for(self._stop_event.wait(), timeout=delay)
+        if not await policy.wait(delay, self._stop_event):
             return False  # stop fired during backoff
-        except asyncio.TimeoutError:
-            if not self._stopping and self._m is not None:
-                self._m["reconnects"].labels(
-                    pod=job.pod, container=job.container).inc()
-            return not self._stopping
+        if not self._stopping and self._m is not None:
+            self._m["reconnects"].labels(
+                pod=job.pod, container=job.container).inc()
+            self._m["retries"].inc()
+        return not self._stopping
 
     def _create_file(self, job: StreamJob) -> None:
         # Create (truncate) the log file up front (cmd/root.go:245-257).
@@ -454,7 +505,16 @@ class FanoutRunner:
                         "pod discovery stopped unexpectedly: %s", e)
             if stop_task is not None:
                 stop_task.cancel()
-        return await asyncio.gather(*tasks)
+        try:
+            return await asyncio.gather(*tasks)
+        except Exception:
+            # A worker escalated (--on-filter-error=abort raising
+            # Unavailable): close every other stream and let the
+            # workers drain before the one clear error surfaces, so no
+            # task is destroyed pending at loop teardown.
+            await self.stop()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            raise
 
     async def stop(self) -> None:
         """Explicit teardown: close all live streams; workers then drain

@@ -8,9 +8,25 @@ that: chunks go to a buffered file object untouched.
 The filter stage (north star) slots in as a different Sink
 implementation at this same boundary (see klogs_tpu.filters.sink),
 leaving the unfiltered path byte-identical to the reference.
+
+Failure semantics (resilience subsystem): a write/flush failure (disk
+full, revoked mount) marks the sink FAILED with one clear error — a
+``SinkError`` naming the path — releases the fd immediately, and every
+later write re-raises that same error without touching the OS again.
+Retrying a dead disk in a loop helps nobody; the fanout worker ends
+the job cleanly on SinkError instead of burning its reconnect budget
+(see FanoutRunner._worker). ``sink.write`` is a registered chaos fault
+point (docs/RESILIENCE.md).
 """
 
 import abc
+
+from klogs_tpu.resilience.faults import FAULTS, InjectedFault
+
+
+class SinkError(Exception):
+    """A sink write/flush failed terminally; the message is the single
+    operator-facing line (path + cause)."""
 
 
 class Sink(abc.ABC):
@@ -19,7 +35,8 @@ class Sink(abc.ABC):
 
     @abc.abstractmethod
     async def close(self) -> None:
-        """Flush and release. Must be idempotent."""
+        """Flush and release. Must be idempotent — including after a
+        write/flush error already released the underlying resource."""
 
     async def flush(self) -> None:
         """Push buffered bytes through (for live tailing); default no-op."""
@@ -33,24 +50,63 @@ class FileSink(Sink):
     """Buffered whole-stream copy to one log file (bufio analog)."""
 
     def __init__(self, path: str, buffer_size: int = 1 << 16):
+        self._path = path
         # os.Create semantics: truncate on open (cmd/root.go:349)
         self._f = open(path, "wb", buffering=buffer_size)
         self._bytes = 0
         self._closed = False
+        self._failed: "str | None" = None
+
+    def _fail(self, what: str, e: BaseException) -> "SinkError":
+        """Mark failed (one clear error), release the fd, and return the
+        SinkError to raise. Buffered-but-unflushed bytes are already
+        lost to the underlying failure; holding the fd open would only
+        leak it for the rest of the run."""
+        self._failed = f"{what} {self._path} failed: {e}"
+        self._closed = True
+        try:
+            self._f.close()
+        except OSError:
+            pass  # close's own flush hits the same dead disk; fd is
+            # released regardless (BufferedWriter closes raw on error)
+        return SinkError(self._failed)
 
     async def write(self, chunk: bytes) -> None:
-        self._f.write(chunk)
+        if self._failed is not None:
+            raise SinkError(self._failed)
+        try:
+            if FAULTS.active:
+                await FAULTS.fire("sink.write")
+            self._f.write(chunk)
+        except (OSError, InjectedFault) as e:
+            raise self._fail("write to", e) from e
         self._bytes += len(chunk)
 
     async def flush(self) -> None:
-        if not self._closed:
+        if self._closed or self._failed is not None:
+            return
+        try:
             self._f.flush()
+        except OSError as e:
+            raise self._fail("flush of", e) from e
 
     async def close(self) -> None:
-        if not self._closed:
-            self._closed = True
+        if self._closed:
+            return
+        self._closed = True
+        try:
             self._f.flush()
-            self._f.close()
+        except OSError as e:
+            # Disk filled between the last write and close: surface ONE
+            # clear error, but never leak the fd (the pre-resilience
+            # bug: flush raised and close() was skipped entirely).
+            self._failed = f"flush of {self._path} failed: {e}"
+            raise SinkError(self._failed) from e
+        finally:
+            try:
+                self._f.close()
+            except OSError:
+                pass  # flush already reported; raw fd is released
 
     @property
     def bytes_written(self) -> int:

@@ -9,7 +9,31 @@ overlap without extra machinery.
 
 import grpc
 
+from klogs_tpu.resilience import (
+    CircuitBreaker,
+    RetryPolicy,
+    Unavailable,
+    retry_call,
+)
 from klogs_tpu.service import transport
+
+# Transient failure classes worth retrying: the server is restarting /
+# the LB dropped the stream (UNAVAILABLE) or one attempt overran its
+# per-attempt deadline (DEADLINE_EXCEEDED). Anything else — bad
+# request, auth, resource exhaustion — retrying cannot fix.
+_RETRYABLE_CODES = (grpc.StatusCode.UNAVAILABLE,
+                    grpc.StatusCode.DEADLINE_EXCEEDED)
+
+# Per-client defaults; override via constructor for library use.
+DEFAULT_RETRY = RetryPolicy(max_attempts=4, base_s=0.25, max_s=5.0,
+                            jitter=0.1)
+DEFAULT_BREAKER_THRESHOLD = 5
+DEFAULT_BREAKER_RESET_S = 10.0
+
+
+def _retryable(e: BaseException) -> bool:
+    return (isinstance(e, grpc.aio.AioRpcError)
+            and e.code() in _RETRYABLE_CODES)
 
 
 class PatternMismatch(RuntimeError):
@@ -44,7 +68,11 @@ class RemoteFilterClient:
     def __init__(self, target: str, tls_ca: str | None = None,
                  tls_cert: str | None = None, tls_key: str | None = None,
                  auth_token: str | None = None,
-                 auth_token_file: str | None = None):
+                 auth_token_file: str | None = None,
+                 retry: "RetryPolicy | None" = None,
+                 breaker: "CircuitBreaker | None" = None,
+                 rpc_timeout_s: "float | None" = 30.0,
+                 registry=None):
         if (tls_cert or tls_key) and not tls_ca:
             raise ServiceConfigError(
                 "tls_cert/tls_key (mTLS) require tls_ca — refusing to "
@@ -88,6 +116,18 @@ class RemoteFilterClient:
         # None until the first Hello; old servers (no "framed" key)
         # route match_framed through the legacy per-line Match.
         self._server_framed: bool | None = None
+        # Resilience (docs/RESILIENCE.md): every RPC runs under a
+        # per-attempt Deadline + retry on UNAVAILABLE/DEADLINE_EXCEEDED
+        # behind one breaker per client — consecutive failures trip it
+        # and subsequent calls fast-fail (Unavailable), which the
+        # FilteredSink routes per --on-filter-error instead of letting
+        # a dead filterd wedge every sink flush.
+        self._retry = retry if retry is not None else DEFAULT_RETRY
+        self._breaker = breaker if breaker is not None else CircuitBreaker(
+            name="rpc", failure_threshold=DEFAULT_BREAKER_THRESHOLD,
+            reset_timeout_s=DEFAULT_BREAKER_RESET_S, registry=registry)
+        self._rpc_timeout_s = rpc_timeout_s
+        self._registry = registry
 
     def _metadata(self):
         token = self._auth_token
@@ -108,12 +148,43 @@ class RemoteFilterClient:
             f"filter service at {self._target}: "
             f"{e.code().name}: {e.details()}")
 
-    async def hello(self) -> dict:
+    async def _call(self, rpc, request: bytes, fault_point: str):
+        """One guarded RPC: breaker gate, fresh per-attempt Deadline,
+        retry with jittered backoff on transient codes. A terminal
+        transient failure (retries exhausted / breaker open) raises
+        ``resilience.Unavailable`` — the type FilteredSink's
+        --on-filter-error degrade routing catches; any other RPC error
+        gets the friendly one-line ClusterError as before."""
+        async def attempt(deadline):
+            return await rpc(
+                request, metadata=self._metadata(),
+                timeout=(deadline.remaining()
+                         if deadline is not None else None))
+
         try:
-            info = transport.unpack(
-                await self._hello_rpc(b"", metadata=self._metadata()))
+            return await retry_call(
+                attempt, policy=self._retry, retryable=_retryable,
+                site="rpc",
+                describe=f"filter service at {self._target}",
+                breaker=self._breaker, deadline_s=self._rpc_timeout_s,
+                fault_point=fault_point, registry=self._registry)
+        except Unavailable as e:
+            cause = e.__cause__
+            if isinstance(cause, grpc.aio.AioRpcError):
+                # str(AioRpcError) is a multi-line debug blob; keep the
+                # pre-resilience one-line CODE: details form on the
+                # degrade/fatal path.
+                raise type(e)(
+                    f"filter service at {self._target}: "
+                    f"{cause.code().name}: {cause.details()} "
+                    f"(retries exhausted)") from cause
+            raise
         except grpc.aio.AioRpcError as e:
             raise self._friendly(e) from e
+
+    async def hello(self) -> dict:
+        info = transport.unpack(
+            await self._call(self._hello_rpc, b"", "rpc.hello"))
         self._server_framed = bool(info.get("framed", False))
         return info
 
@@ -142,12 +213,9 @@ class RemoteFilterClient:
             )
 
     async def match(self, lines: list[bytes]) -> list[bool]:
-        try:
-            resp = await self._match_rpc(
-                transport.encode_match_request(lines),
-                metadata=self._metadata())
-        except grpc.aio.AioRpcError as e:
-            raise self._friendly(e) from e
+        resp = await self._call(
+            self._match_rpc, transport.encode_match_request(lines),
+            "rpc.match")
         return transport.decode_match_response(resp)
 
     async def match_framed(self, payload: bytes, offsets):
@@ -164,12 +232,10 @@ class RemoteFilterClient:
 
             return np.asarray(
                 await self.match(split_frame(payload, offsets)), dtype=bool)
-        try:
-            resp = await self._match_framed_rpc(
-                transport.encode_framed_request(payload, offsets),
-                metadata=self._metadata())
-        except grpc.aio.AioRpcError as e:
-            raise self._friendly(e) from e
+        resp = await self._call(
+            self._match_framed_rpc,
+            transport.encode_framed_request(payload, offsets),
+            "rpc.match")
         return transport.decode_framed_response(resp)
 
     async def aclose(self) -> None:

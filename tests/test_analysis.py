@@ -570,6 +570,99 @@ def test_int32_guard_real_guards_present():
     assert _active(REPO, "int32-guard") == []
 
 
+# -- retry-discipline --------------------------------------------------
+
+def test_retry_discipline_hand_rolled_backoff(tmp_path):
+    """The pre-resilience shape: a loop that catches a failure and
+    sleeps a raw asyncio.sleep between attempts."""
+    root = _tree(tmp_path, {"klogs_tpu/cluster/conn.py": """
+        import asyncio
+        async def fetch(get):
+            for attempt in range(5):
+                try:
+                    return await get()
+                except OSError:
+                    await asyncio.sleep(0.5 * 2 ** attempt)
+        """})
+    found = _active(root, "retry-discipline")
+    assert len(found) == 1 and "RetryPolicy" in found[0].message
+
+
+def test_retry_discipline_time_sleep_in_any_loop(tmp_path):
+    """time.sleep in a loop is flagged even without an except handler —
+    sync backoff can never be stop-aware."""
+    root = _tree(tmp_path, {"klogs_tpu/runtime/poll.py": """
+        import time
+        def wait_ready(check):
+            while not check():
+                time.sleep(1.0)
+        """})
+    found = _active(root, "retry-discipline")
+    assert len(found) == 1 and "time.sleep" in found[0].message
+
+
+def test_retry_discipline_allows_policy_and_periodic_loops(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/runtime/ok.py": """
+        import asyncio
+        async def reconnect(policy, open_stream, stop):
+            attempt = 0
+            while True:
+                try:
+                    return await open_stream()
+                except OSError:
+                    # the blessed wait: policy method, stop-aware
+                    if not await policy.sleep(attempt, stop):
+                        return None
+                    attempt += 1
+
+        async def flusher(sinks, deadline_s):
+            while True:
+                # periodic loop, no except handler: not a retry loop
+                await asyncio.sleep(deadline_s / 2)
+                for s in sinks:
+                    await s.flush_if_stale()
+
+        async def poller(stop, interval_s):
+            while not stop.is_set():
+                try:
+                    await asyncio.wait_for(stop.wait(), timeout=interval_s)
+                    return
+                except asyncio.TimeoutError:
+                    pass
+        """})
+    assert _active(root, "retry-discipline") == []
+
+
+def test_retry_discipline_suppression_and_nested_def_exempt(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/service/x.py": """
+        import asyncio
+        async def waived(get):
+            while True:
+                try:
+                    return await get()
+                except OSError:
+                    await asyncio.sleep(1)  # klogs: ignore[retry-discipline]
+
+        async def outer(items):
+            for it in items:
+                try:
+                    it.go()
+                except OSError:
+                    pass
+
+                async def helper():
+                    # nested def: runs elsewhere, not this loop's backoff
+                    await asyncio.sleep(0.1)
+        """})
+    report = run(str(tmp_path), rules=["retry-discipline"])
+    assert [f for f in report.findings if not f.suppressed] == []
+    assert len([f for f in report.findings if f.suppressed]) == 1
+
+
+def test_retry_discipline_real_tree_clean():
+    assert _active(REPO, "retry-discipline") == []
+
+
 # -- docs parity (metrics-docs, cli-docs) ------------------------------
 
 def test_metrics_docs_shim_still_works():

@@ -1,0 +1,367 @@
+"""Chaos suite for the resilience subsystem: scripted fault scenarios
+driven end-to-end, each asserting the documented terminal state —
+retried transparently, degraded per --on-filter-error, or failed with
+ONE clear error — with follow-mode line integrity and the recovery
+metrics visible in a scrape.
+
+Scenarios (docs/RESILIENCE.md):
+1. filterd flaking then recovering  -> RPC retry, breaker trip+probe
+2. kube list 5xx bursts             -> tests/test_kube_backend.py
+                                       (lives with the aiohttp fake
+                                       apiserver helpers)
+3. mid-stream log disconnects       -> gap-covering since bounds, no
+                                       line dropped across reconnect
+4. sink ENOSPC                      -> job ends cleanly, fd released
+"""
+
+import asyncio
+import os
+import re
+
+import pytest
+
+from klogs_tpu import obs
+from klogs_tpu.cluster.fake import FakeCluster, Faults
+from klogs_tpu.cluster.types import LogOptions
+from klogs_tpu.resilience import (
+    FAULTS,
+    BreakerOpen,
+    CircuitBreaker,
+    InjectedFault,
+    RetryPolicy,
+    Unavailable,
+)
+from klogs_tpu.runtime import fanout as fanout_mod
+from klogs_tpu.runtime.fanout import FanoutRunner, StreamJob, plan_jobs
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.clear()
+    FAULTS.bind_registry(None)
+    yield
+    FAULTS.clear()
+    FAULTS.bind_registry(None)
+
+
+@pytest.fixture(autouse=True)
+def _fast_backoff(monkeypatch):
+    monkeypatch.setattr(fanout_mod, "_BACKOFF_BASE_S", 0.01)
+    monkeypatch.setattr(fanout_mod, "_BACKOFF_MAX_S", 0.05)
+
+
+FAST = RetryPolicy(max_attempts=4, base_s=0.005, max_s=0.02, jitter=0.0)
+
+
+# ---- Scenario 1: filterd flaking, then recovering --------------------
+
+
+def test_rpc_flake_retried_transparently_with_metrics():
+    """Two injected RPC faults against a LIVE filterd: the client's
+    retry loop absorbs them, verdicts are correct, and the retry +
+    fault counters are scrapeable."""
+    grpc = pytest.importorskip("grpc")  # noqa: F841
+    from klogs_tpu.service.client import RemoteFilterClient
+    from klogs_tpu.service.server import FilterServer
+
+    registry = obs.Registry()
+    obs.register_all(registry)
+    FAULTS.bind_registry(registry)
+    lines = [b"an ERROR here", b"all good", b"ERROR again"]
+
+    async def scenario():
+        server = FilterServer(["ERROR"], backend="cpu", port=0)
+        port = await server.start()
+        client = RemoteFilterClient(f"127.0.0.1:{port}", retry=FAST,
+                                    registry=registry)
+        try:
+            FAULTS.arm("rpc.match", times=2, exc=InjectedFault("flake"))
+            return await client.match(lines)
+        finally:
+            await client.aclose()
+            await server.stop()
+
+    got = run(asyncio.wait_for(scenario(), timeout=30))
+    assert got == [True, False, True]
+    text = obs.render(registry)
+    assert 'klogs_retry_attempts_total{site="rpc"} 2' in text, text
+    assert 'klogs_faults_injected_total{point="rpc.match"} 2' in text
+
+
+def test_rpc_dead_filterd_trips_breaker_then_recovers():
+    """A filterd that stays down: retries exhaust into Unavailable,
+    consecutive failures open the breaker (later calls fast-fail
+    without touching the wire), and after the reset window one probe
+    against the recovered server closes it again."""
+    pytest.importorskip("grpc")
+    from klogs_tpu.service.client import RemoteFilterClient
+    from klogs_tpu.service.server import FilterServer
+
+    registry = obs.Registry()
+    obs.register_all(registry)
+
+    async def scenario():
+        server = FilterServer(["ERROR"], backend="cpu", port=0)
+        port = await server.start()
+        breaker = CircuitBreaker("rpc", failure_threshold=2,
+                                 reset_timeout_s=0.05, registry=registry)
+        client = RemoteFilterClient(
+            f"127.0.0.1:{port}",
+            retry=RetryPolicy(max_attempts=1, base_s=0.001, max_s=0.001,
+                              jitter=0.0),
+            breaker=breaker, rpc_timeout_s=5.0, registry=registry)
+        try:
+            # Warm the handshake while healthy (match_framed probes
+            # Hello lazily; keep the outage window to Match RPCs).
+            await client.hello()
+            FAULTS.arm("rpc.match", times=None, exc=InjectedFault("down"))
+            for _ in range(2):
+                with pytest.raises(Unavailable):
+                    await client.match([b"x"])
+            assert breaker.state_name == "open"
+            with pytest.raises(BreakerOpen):
+                await client.match([b"x"])  # fast-fail, no attempt
+            FAULTS.clear()  # "filterd recovers"
+            await asyncio.sleep(0.06)  # reset window elapses
+            got = await client.match([b"an ERROR", b"fine"])
+            assert breaker.state_name == "closed"
+            return got
+        finally:
+            await client.aclose()
+            await server.stop()
+
+    assert run(asyncio.wait_for(scenario(), timeout=30)) == [True, False]
+    assert 'klogs_breaker_state{breaker="rpc"} 0' in obs.render(registry)
+
+
+# ---- Scenario 3: mid-stream disconnect, gap-covering reconnect ------
+
+
+def test_reconnect_since_bounds_no_drop_bounded_overlap(tmp_path,
+                                                        monkeypatch):
+    """A follow stream is cut mid-flight by an injected fault. The
+    reconnect must carry since_seconds covering EXACTLY the gap since
+    the last received line (+1s margin): nothing dropped, re-emission
+    bounded to the one overlap line the margin re-fetches."""
+
+    class Clock:
+        def __init__(self):
+            self.value = 1000.0
+
+        def monotonic(self):
+            return self.value
+
+    clock = Clock()
+    monkeypatch.setattr(fanout_mod, "time", clock)
+    opened = []
+
+    class CutStream:
+        """seq 0..4, one per simulated second, then a 5s dead-air gap
+        and an injected mid-stream fault."""
+
+        def __init__(self):
+            self.n = 0
+
+        def __aiter__(self):
+            return self
+
+        async def __anext__(self):
+            from klogs_tpu.cluster.backend import StreamError
+
+            if self.n < 5:
+                clock.value += 1.0
+                self.n += 1
+                return f"seq {self.n - 1}\n".encode()
+            clock.value += 5.0
+            raise StreamError("injected mid-stream cut")
+
+        async def close(self):
+            pass
+
+    class ResumeStream:
+        """What a correct server returns for the reconnect bound: the
+        overlap line (seq 4) plus the new lines 5..9, then clean EOF."""
+
+        def __init__(self):
+            self.lines = [f"seq {i}\n".encode() for i in range(4, 10)]
+
+        def __aiter__(self):
+            return self
+
+        async def __anext__(self):
+            if not self.lines:
+                raise StopAsyncIteration
+            return self.lines.pop(0)
+
+        async def close(self):
+            pass
+
+    class Backend:
+        def __init__(self):
+            self.calls = 0
+
+        async def open_log_stream(self, namespace, pod, opts):
+            from klogs_tpu.cluster.backend import StreamError
+
+            opened.append(opts)
+            self.calls += 1
+            if self.calls == 1:
+                return CutStream()
+            if self.calls == 2:
+                return ResumeStream()
+            raise StreamError("no more")  # exhaust the budget cleanly
+
+        async def close(self):
+            pass
+
+    runner = FanoutRunner(Backend(), "default", LogOptions(follow=True),
+                          max_reconnects=1)
+    job = StreamJob("p", "c0", False, str(tmp_path / "p__c0.log"))
+    run(asyncio.wait_for(runner.run([job], stop=asyncio.Event()),
+                         timeout=20))
+
+    assert len(opened) == 2
+    # Gap = 5s dead air since the last line (+1s overlap), NOT the 10s
+    # connection lifetime.
+    assert opened[1].since_seconds == 6, opened[1]
+    assert opened[1].tail_lines is None
+    seqs = [int(m) for m in re.findall(
+        rb"seq (\d+)", open(job.path, "rb").read())]
+    # No line dropped across the forced reconnect...
+    assert sorted(set(seqs)) == list(range(10))
+    # ...and re-emission is exactly the overlap line the margin covers.
+    assert len(seqs) == 11 and seqs.count(4) == 2
+
+
+def test_follow_integrity_through_fake_cluster_faults(tmp_path):
+    """End-to-end through FakeCluster: mid-stream errors force real
+    reconnects while lines keep generating; the file must hold a
+    gap-free seq range (nothing the server delivered was lost, and the
+    framer spliced every cut line)."""
+    fc = FakeCluster.synthetic(n_pods=1, n_containers=1,
+                               lines_per_container=10,
+                               follow_interval_s=0.001)
+    cont = fc.namespaces["default"]["pod-0000"].containers["c0"]
+    cont.faults = Faults(error_after_lines=15)
+    jobs = plan_jobs(run(fc.list_pods("default")), str(tmp_path), False)
+    runner = FanoutRunner(fc, "default", LogOptions(follow=True))
+
+    async def scenario():
+        stop = asyncio.Event()
+        task = asyncio.create_task(runner.run(jobs, stop=stop))
+        await asyncio.sleep(0.5)
+        stop.set()
+        return await task
+
+    run(asyncio.wait_for(scenario(), timeout=20))
+    seqs = [int(m) for m in re.findall(
+        rb"seq=(\d+)", open(jobs[0].path, "rb").read())]
+    assert seqs, "no lines survived the chaos"
+    assert sorted(set(seqs)) == list(range(max(seqs) + 1)), \
+        "reconnect dropped delivered lines"
+
+
+def test_open_faults_burn_reconnect_budget_not_the_run(tmp_path, capsys):
+    """kube.log_stream open faults (the KLOGS_FAULTS shape) against the
+    fake backend: two injected open failures are retried through the
+    shared policy, the stream then runs to completion."""
+    fc = FakeCluster.synthetic(n_pods=1, n_containers=1,
+                               lines_per_container=8,
+                               follow_interval_s=0.001)
+    FAULTS.load_spec("kube.log_stream:error*2")
+    jobs = plan_jobs(run(fc.list_pods("default")), str(tmp_path), False)
+    cont = fc.namespaces["default"]["pod-0000"].containers["c0"]
+    cont.faults = Faults(cut_after_lines=8)  # history then clean EOF
+    runner = FanoutRunner(fc, "default", LogOptions(follow=True),
+                          max_reconnects=4)
+    run(asyncio.wait_for(
+        runner.run(jobs, stop=asyncio.Event()), timeout=20))
+    out = capsys.readouterr().out
+    # Both injected open failures were absorbed by the shared policy...
+    assert out.count("reconnecting") >= 2
+    # ...and the stream then delivered its whole history: seq 0..7 all
+    # present despite the two failed opens (later reconnects may
+    # re-serve/extend per follow semantics; integrity, not exactness).
+    seqs = {int(m) for m in re.findall(
+        rb"seq=(\d+)", open(jobs[0].path, "rb").read())}
+    assert set(range(8)) <= seqs, seqs
+
+
+# ---- Scenario 4: sink ENOSPC ----------------------------------------
+
+
+def test_sink_enospc_ends_job_cleanly_with_one_error(tmp_path):
+    """Disk full mid-stream: the job ends with ONE clear error naming
+    the path, the fd is released, the stream is NOT reconnected (the
+    disk is the problem), and sibling streams are untouched."""
+    fc = FakeCluster.synthetic(n_pods=2, n_containers=1,
+                               lines_per_container=50)
+    registry = obs.Registry()
+    obs.register_all(registry)
+    FAULTS.bind_registry(registry)
+    jobs = plan_jobs(run(fc.list_pods("default")), str(tmp_path), False)
+    assert len(jobs) == 2
+    FAULTS.arm("sink.write", times=1,
+               exc=OSError(28, "No space left on device"))
+    sinks = []
+
+    def factory(job):
+        from klogs_tpu.runtime.sink import FileSink
+
+        s = FileSink(job.path)
+        sinks.append(s)
+        return s
+
+    runner = FanoutRunner(fc, "default", LogOptions(follow=True),
+                          sink_factory=factory, registry=registry)
+
+    async def scenario():
+        stop = asyncio.Event()
+        task = asyncio.create_task(runner.run(jobs, stop=stop))
+        await asyncio.sleep(0.3)
+        stop.set()
+        return await task
+
+    results = run(asyncio.wait_for(scenario(), timeout=20))
+    failed = [r for r in results if r.error]
+    healthy = [r for r in results if not r.error]
+    assert len(failed) == 1 and len(healthy) == 1
+    assert "No space left" in failed[0].error
+    assert failed[0].job.path in failed[0].error
+    assert all(s._f.closed for s in sinks)
+    assert healthy[0].bytes_written > 0, "sibling stream was harmed"
+    text = obs.render(registry)
+    assert 'klogs_faults_injected_total{point="sink.write"} 1' in text
+    assert "klogs_fanout_stream_errors_total 1" in text
+
+
+def test_cli_e2e_env_faults_and_stats_json(tmp_path, monkeypatch):
+    """The full CLI path under a KLOGS_FAULTS script: env spec loaded
+    loudly, faults fired through the fake backend, run survives, and
+    the --stats-json dump carries the fault/retry counters (the
+    scrapeless equivalent of the /metrics assertion)."""
+    from klogs_tpu import app
+    from klogs_tpu.cli import parse_args
+
+    out_dir = str(tmp_path / "logs")
+    stats_path = str(tmp_path / "m.json")
+    fc = FakeCluster.synthetic(n_pods=2, n_containers=1,
+                               lines_per_container=20)
+    monkeypatch.setenv("KLOGS_FAULTS", "kube.log_stream:error*1")
+    opts = parse_args(["-n", "default", "-a", "-p", out_dir,
+                       "--match", "ERROR", "--stats-json", stats_path])
+    rc = run(app.run_async(opts, backend=fc))
+    assert rc == 0
+    # Batch mode: the faulted open is a per-stream error (file exists,
+    # empty); the other container streamed and was filtered.
+    files = sorted(os.listdir(out_dir))
+    assert len(files) == 2
+    sizes = [os.path.getsize(os.path.join(out_dir, f)) for f in files]
+    assert sorted(sizes)[0] == 0 and sorted(sizes)[1] > 0
+    doc = open(stats_path).read()
+    assert "klogs_faults_injected_total" in doc
+    assert "kube.log_stream" in doc

@@ -764,3 +764,148 @@ def test_follow_reconnect_full_stack_over_real_http(tmp_path):
     assert b"alpha 1\n" in data and b"alpha 2\n" in data
     assert b"alpha 3 part-two\n" in data and b"alpha 4\n" in data
     assert data.count(b"alpha 2") == 1
+
+
+# ---------------------------------------------------------------------
+# Resilience (chaos scenario 2): transient apiserver weather on the
+# list/discovery path is retried under the shared RetryPolicy;
+# persistent failure surfaces as ONE friendly ClusterError naming the
+# attempt count. docs/RESILIENCE.md.
+# ---------------------------------------------------------------------
+
+
+def _fast_retry():
+    from klogs_tpu.resilience import RetryPolicy
+
+    return RetryPolicy(max_attempts=4, base_s=0.005, max_s=0.02,
+                       jitter=0.0)
+
+
+async def _with_flaky_backend(fn, fail_times, status=503, registry=None):
+    """Backend against an apiserver whose pod-list 5xxes ``fail_times``
+    times before recovering."""
+    from klogs_tpu.cluster.kube import KubeBackend
+    from klogs_tpu.cluster.kubeconfig import load_creds
+
+    state = {"fails": fail_times, "calls": 0}
+
+    async def flaky_pods(request):
+        state["calls"] += 1
+        if state["fails"] > 0:
+            state["fails"] -= 1
+            return web.Response(status=status, text="etcd leader changed")
+        items = [_pod_item(name, meta) for name, meta in PODS.items()]
+        return web.json_response({"items": items})
+
+    app = web.Application()  # only the (flaky) pods route, no auth
+    app.router.add_get("/api/v1/namespaces/{ns}/pods", flaky_pods)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        import pathlib
+
+        path = write_kubeconfig(pathlib.Path(td),
+                                f"http://127.0.0.1:{port}")
+        backend = KubeBackend(load_creds(path), retry=_fast_retry(),
+                              registry=registry)
+        try:
+            return await fn(backend, state)
+        finally:
+            await backend.close()
+            await runner.cleanup()
+
+
+def test_list_pods_retries_5xx_burst():
+    from klogs_tpu import obs
+
+    registry = obs.Registry()
+    obs.register_all(registry)
+
+    async def fn(b, state):
+        pods = await b.list_pods("default")
+        assert {p.name for p in pods} == set(PODS)
+        assert state["calls"] == 3  # 2 x 503 + the success
+        child = registry.family("klogs_retry_attempts_total").labels(
+            site="kube")
+        assert child.value == 2
+
+    asyncio.run(_with_flaky_backend(fn, fail_times=2, registry=registry))
+
+
+def test_list_pods_persistent_5xx_is_one_friendly_error():
+    from klogs_tpu.cluster.backend import ClusterError
+
+    async def fn(b, state):
+        with pytest.raises(ClusterError) as ei:
+            await b.list_pods("default")
+        msg = str(ei.value)
+        assert "HTTP 503" in msg and "after 4 attempts" in msg
+        assert state["calls"] == 4  # the full retry budget, then stop
+
+    asyncio.run(_with_flaky_backend(fn, fail_times=99))
+
+
+def test_401_is_not_retried_as_transient(tmp_path):
+    """Auth failures must stay immediate (no backoff burn): the static
+    -token 401 path still raises the friendly ClusterError after the
+    one-shot refresh logic, not after a retry storm."""
+    from klogs_tpu.cluster.backend import ClusterError
+
+    async def fn(b):
+        with pytest.raises(ClusterError, match="Unauthorized"):
+            await b.list_namespaces()
+
+    asyncio.run(with_backend(tmp_path, fn, token="wrong-token"))
+
+
+def test_list_retries_injected_faults_via_spec(tmp_path):
+    """KLOGS_FAULTS-shaped chaos drives the SAME retry path: two armed
+    kube.list_pods errors are absorbed by the policy."""
+    from klogs_tpu.resilience import FAULTS
+
+    FAULTS.load_spec("kube.list_pods:error*2")
+    try:
+        async def fn(b):
+            pods = await b.list_pods("default")
+            assert {p.name for p in pods} == set(PODS)
+
+        asyncio.run(with_backend(tmp_path, fn))
+    finally:
+        FAULTS.clear()
+
+
+def test_connect_timeout_on_list_is_cluster_error(tmp_path, monkeypatch):
+    from klogs_tpu.cluster.backend import ClusterError
+
+    async def fn(b):
+        def timeout_get(*a, **kw):
+            raise asyncio.TimeoutError()
+
+        monkeypatch.setattr(b._session, "get", timeout_get)
+        with pytest.raises(ClusterError, match="cannot reach apiserver"):
+            await b.list_pods("default")
+
+    asyncio.run(with_backend(tmp_path, fn))
+
+
+def test_connect_timeout_on_open_log_stream_is_stream_error(
+        tmp_path, monkeypatch):
+    """Satellite regression: open_log_stream caught only
+    aiohttp.ClientError — a connect timeout (asyncio.TimeoutError from
+    the sock_connect bound) escaped as a raw traceback instead of the
+    StreamError the fanout reconnect policy handles."""
+    async def fn(b):
+        def timeout_get(*a, **kw):
+            raise asyncio.TimeoutError()
+
+        monkeypatch.setattr(b._session, "get", timeout_get)
+        with pytest.raises(StreamError, match="connect timed out"):
+            await b.open_log_stream("default", "api-1",
+                                    LogOptions(container="srv"))
+
+    asyncio.run(with_backend(tmp_path, fn))

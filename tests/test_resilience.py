@@ -1,0 +1,652 @@
+"""Unit coverage for klogs_tpu.resilience: RetryPolicy backoff/jitter/
+stop-awareness, Deadline, CircuitBreaker state machine (fake clock),
+retry_call classification + metrics, FaultInjector scripting and the
+KLOGS_FAULTS grammar, FileSink failure semantics (fd release,
+idempotent close), and FilteredSink --on-filter-error degrade routing.
+"""
+
+import asyncio
+
+import pytest
+
+from klogs_tpu import obs
+from klogs_tpu.resilience import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    BreakerOpen,
+    CircuitBreaker,
+    Deadline,
+    FAULTS,
+    FaultSpecError,
+    InjectedFault,
+    RetryPolicy,
+    Unavailable,
+    retry_call,
+)
+from klogs_tpu.runtime.sink import FileSink, SinkError
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.clear()
+    FAULTS.bind_registry(None)
+    yield
+    FAULTS.clear()
+    FAULTS.bind_registry(None)
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---- RetryPolicy -----------------------------------------------------
+
+
+def test_retry_policy_exponential_growth_and_cap():
+    p = RetryPolicy(max_attempts=6, base_s=0.5, max_s=4.0, jitter=0.0)
+    assert [p.delay_s(i) for i in range(5)] == [0.5, 1.0, 2.0, 4.0, 4.0]
+
+
+def test_retry_policy_jitter_bounds():
+    p = RetryPolicy(base_s=1.0, max_s=1.0, jitter=0.25)
+    for _ in range(200):
+        assert 0.75 <= p.delay_s(0) <= 1.25
+
+
+def test_retry_policy_retries_left():
+    p = RetryPolicy(max_attempts=3)
+    assert p.retries_left(0) and p.retries_left(1)
+    assert not p.retries_left(2)
+
+
+def test_retry_policy_sleep_is_stop_aware():
+    p = RetryPolicy(base_s=30.0, max_s=30.0, jitter=0.0)
+
+    async def scenario():
+        stop = asyncio.Event()
+        stop.set()
+        # A pre-fired stop returns False immediately — no 30s nap.
+        return await p.sleep(0, stop)
+
+    assert run(asyncio.wait_for(scenario(), timeout=2)) is False
+
+
+def test_retry_policy_sleep_without_stop():
+    p = RetryPolicy(base_s=0.001, max_s=0.001, jitter=0.0)
+    assert run(p.sleep(0)) is True
+
+
+# ---- Deadline --------------------------------------------------------
+
+
+def test_deadline_remaining_and_expired():
+    clock = Clock()
+    d = Deadline(10.0, clock=clock)
+    assert d.remaining() == 10.0 and not d.expired
+    clock.t += 9.5
+    assert abs(d.remaining() - 0.5) < 1e-9
+    clock.t += 1.0
+    assert d.remaining() == 0.0 and d.expired
+
+
+# ---- CircuitBreaker --------------------------------------------------
+
+
+def test_breaker_trips_after_consecutive_failures():
+    b = CircuitBreaker("t", failure_threshold=3, reset_timeout_s=100,
+                       clock=Clock())
+    for _ in range(2):
+        b.record_failure()
+    assert b.state == BREAKER_CLOSED and b.allow()
+    # A success resets the consecutive count.
+    b.record_success()
+    for _ in range(2):
+        b.record_failure()
+    assert b.state == BREAKER_CLOSED
+    b.record_failure()
+    assert b.state == BREAKER_OPEN and not b.allow()
+
+
+def test_breaker_half_open_probe_success_closes():
+    clock = Clock()
+    b = CircuitBreaker("t", failure_threshold=1, reset_timeout_s=5.0,
+                       half_open_max=1, clock=clock)
+    b.record_failure()
+    assert not b.allow()
+    clock.t += 5.0
+    assert b.state == BREAKER_HALF_OPEN
+    assert b.allow()       # the single probe slot
+    assert not b.allow()   # concurrent second probe rejected
+    b.record_success()
+    assert b.state == BREAKER_CLOSED and b.allow()
+
+
+def test_breaker_half_open_probe_failure_reopens():
+    clock = Clock()
+    b = CircuitBreaker("t", failure_threshold=1, reset_timeout_s=5.0,
+                       clock=clock)
+    b.record_failure()
+    clock.t += 5.0
+    assert b.allow()
+    b.record_failure()
+    assert b.state == BREAKER_OPEN and not b.allow()
+    clock.t += 5.0
+    assert b.state == BREAKER_HALF_OPEN  # another window, another probe
+
+
+def test_breaker_state_gauge_exported():
+    registry = obs.Registry()
+    obs.register_all(registry)
+    clock = Clock()
+    b = CircuitBreaker("rpc", failure_threshold=1, reset_timeout_s=5.0,
+                       clock=clock, registry=registry)
+    child = registry.family("klogs_breaker_state").labels(breaker="rpc")
+    assert child.value == BREAKER_CLOSED
+    b.record_failure()
+    assert child.value == BREAKER_OPEN
+    clock.t += 5.0
+    assert b.state == BREAKER_HALF_OPEN
+    assert child.value == BREAKER_HALF_OPEN
+    assert "klogs_breaker_state" in obs.render(registry)
+
+
+# ---- retry_call ------------------------------------------------------
+
+
+def _fast() -> RetryPolicy:
+    return RetryPolicy(max_attempts=4, base_s=0.001, max_s=0.002,
+                       jitter=0.0)
+
+
+def test_retry_call_retries_then_succeeds_with_metrics():
+    registry = obs.Registry()
+    obs.register_all(registry)
+    calls = []
+
+    async def fn(deadline):
+        calls.append(deadline)
+        if len(calls) < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    got = run(retry_call(
+        fn, policy=_fast(), retryable=lambda e: isinstance(e, OSError),
+        site="rpc", deadline_s=7.5, registry=registry))
+    assert got == "ok" and len(calls) == 3
+    # Each attempt got a FRESH per-attempt deadline.
+    assert all(d is not None and d.timeout_s == 7.5 for d in calls)
+    child = registry.family("klogs_retry_attempts_total").labels(site="rpc")
+    assert child.value == 2
+
+
+def test_retry_call_nonretryable_propagates_untouched():
+    async def fn(deadline):
+        raise ValueError("caller bug")
+
+    b = CircuitBreaker("t", failure_threshold=1, clock=Clock())
+    with pytest.raises(ValueError):
+        run(retry_call(fn, policy=_fast(),
+                       retryable=lambda e: isinstance(e, OSError),
+                       breaker=b))
+    # Non-retryable failures must NOT trip the breaker.
+    assert b.state == BREAKER_CLOSED
+
+
+def test_retry_call_exhaustion_raises_unavailable_with_cause():
+    async def fn(deadline):
+        raise ConnectionError("still down")
+
+    with pytest.raises(Unavailable, match="after 4 attempts") as ei:
+        run(retry_call(fn, policy=_fast(),
+                       retryable=lambda e: isinstance(e, OSError),
+                       describe="filter service at x:1"))
+    assert isinstance(ei.value.__cause__, ConnectionError)
+    assert "filter service at x:1" in str(ei.value)
+
+
+def test_retry_call_breaker_open_fast_fails():
+    b = CircuitBreaker("t", failure_threshold=1, reset_timeout_s=100,
+                       clock=Clock())
+    b.record_failure()
+    calls = []
+
+    async def fn(deadline):
+        calls.append(1)
+
+    with pytest.raises(BreakerOpen):
+        run(retry_call(fn, policy=_fast(), retryable=lambda e: True,
+                       breaker=b))
+    assert calls == []  # never attempted, never slept
+
+
+def test_half_open_probe_slot_released_on_nonretryable():
+    """Review regression: a half-open probe that dies on a
+    NON-retryable error (neither success nor health failure) must give
+    its slot back — otherwise the breaker fast-fails forever even after
+    the service recovers."""
+    clock = Clock()
+    b = CircuitBreaker("t", failure_threshold=1, reset_timeout_s=5.0,
+                       half_open_max=1, clock=clock)
+    b.record_failure()
+    clock.t += 5.0
+
+    async def bad(deadline):
+        raise ValueError("caller bug, not service health")
+
+    with pytest.raises(ValueError):
+        run(retry_call(bad, policy=_fast(),
+                       retryable=lambda e: isinstance(e, OSError),
+                       breaker=b))
+    # The probe slot is free again: the next (healthy) call closes it.
+    assert b._probes_in_flight == 0
+
+    async def good(deadline):
+        return "ok"
+
+    assert run(retry_call(good, policy=_fast(),
+                          retryable=lambda e: False, breaker=b)) == "ok"
+    assert b.state == BREAKER_CLOSED
+
+
+def test_retry_call_stop_event_aborts_backoff():
+    stop = asyncio.Event()
+
+    async def fn(deadline):
+        stop.set()  # fires during the first attempt
+        raise ConnectionError("down")
+
+    with pytest.raises(Unavailable, match="stopped during retry"):
+        run(asyncio.wait_for(retry_call(
+            fn, policy=RetryPolicy(max_attempts=3, base_s=30.0,
+                                   max_s=30.0, jitter=0.0),
+            retryable=lambda e: True, stop=stop), timeout=2))
+
+
+def test_retry_call_injected_fault_is_always_retryable():
+    FAULTS.arm("rpc.match", times=2, exc=InjectedFault("chaos"))
+    calls = []
+
+    async def fn(deadline):
+        calls.append(1)
+        return "ok"
+
+    got = run(retry_call(fn, policy=_fast(), retryable=lambda e: False,
+                         fault_point="rpc.match"))
+    # Two fault firings consumed two attempts before fn ever ran.
+    assert got == "ok" and len(calls) == 1
+    assert FAULTS.counts["rpc.match"] == 2
+
+
+# ---- FaultInjector ---------------------------------------------------
+
+
+def test_faults_arm_times_and_clear():
+    FAULTS.arm("sink.write", times=2, exc=OSError(28, "ENOSPC"))
+    assert FAULTS.active
+
+    async def drive():
+        for _ in range(2):
+            with pytest.raises(OSError):
+                await FAULTS.fire("sink.write")
+        await FAULTS.fire("sink.write")  # exhausted: no-op
+
+    run(drive())
+    assert not FAULTS.active
+    assert FAULTS.counts == {"sink.write": 2}
+
+
+def test_faults_spec_grammar():
+    FAULTS.load_spec(
+        "rpc.match:error(boom)*2; kube.list_pods:error,"
+        "sink.write:delay(0.001)*")
+
+    async def drive():
+        with pytest.raises(InjectedFault, match="boom"):
+            await FAULTS.fire("rpc.match")
+        with pytest.raises(InjectedFault, match="boom"):
+            await FAULTS.fire("rpc.match")
+        await FAULTS.fire("rpc.match")  # *2 exhausted
+        with pytest.raises(InjectedFault, match="kube.list_pods"):
+            await FAULTS.fire("kube.list_pods")
+        for _ in range(3):
+            await FAULTS.fire("sink.write")  # forever, delay-only
+
+    run(drive())
+    assert FAULTS.counts["sink.write"] == 3
+
+
+def test_faults_spec_replaces_previous_script():
+    FAULTS.load_spec("rpc.match:error*5")
+    FAULTS.load_spec("sink.write:error")
+    assert "rpc.match" not in FAULTS._rules
+
+
+@pytest.mark.parametrize("bad", [
+    "rpc.match",                 # no action
+    "rpc.match:explode",         # unknown action
+    "nope.such.point:error",     # unknown point
+    "rpc.match:delay(abc)",      # non-numeric delay
+    "rpc.match:error*x",         # bad count
+])
+def test_faults_spec_rejects_malformed(bad):
+    with pytest.raises(FaultSpecError):
+        FAULTS.load_spec(bad)
+
+
+def test_faults_metric_counted_when_registry_bound():
+    registry = obs.Registry()
+    obs.register_all(registry)
+    FAULTS.bind_registry(registry)
+    FAULTS.arm("kube.log_stream", times=1, exc=InjectedFault("x"))
+
+    async def drive():
+        with pytest.raises(InjectedFault):
+            await FAULTS.fire("kube.log_stream")
+
+    run(drive())
+    child = registry.family("klogs_faults_injected_total").labels(
+        point="kube.log_stream")
+    assert child.value == 1
+    assert "klogs_faults_injected_total" in obs.render(registry)
+
+
+# ---- FileSink failure semantics -------------------------------------
+
+
+def test_file_sink_write_failure_is_one_clear_error(tmp_path):
+    path = str(tmp_path / "x.log")
+    sink = FileSink(path)
+    FAULTS.arm("sink.write", times=1, exc=OSError(28, "No space left"))
+
+    async def drive():
+        with pytest.raises(SinkError) as ei:
+            await sink.write(b"hello\n")
+        assert path in str(ei.value) and "No space left" in str(ei.value)
+        # fd released immediately; later writes repeat the SAME error
+        # without touching the OS again.
+        assert sink._f.closed
+        with pytest.raises(SinkError) as ei2:
+            await sink.write(b"more\n")
+        assert str(ei2.value) == str(ei.value)
+        await sink.close()  # idempotent no-op after failure
+        await sink.close()
+
+    run(drive())
+
+
+def test_file_sink_close_releases_fd_when_flush_raises(tmp_path):
+    """Satellite regression: disk-full at close used to skip close()
+    entirely, leaking the fd."""
+    sink = FileSink(str(tmp_path / "y.log"))
+
+    async def drive():
+        await sink.write(b"data\n")
+        raw = sink._f
+
+        def boom():
+            raise OSError(28, "No space left on device")
+
+        sink._f.flush = boom  # type: ignore[method-assign]
+        with pytest.raises(SinkError, match="No space left"):
+            await sink.close()
+        assert raw.closed, "fd must be released even when flush fails"
+        await sink.close()  # second close: silent no-op
+        await sink.flush()  # flush after close: silent no-op
+
+    run(drive())
+
+
+def test_file_sink_normal_close_still_idempotent(tmp_path):
+    path = str(tmp_path / "z.log")
+    sink = FileSink(path)
+
+    async def drive():
+        await sink.write(b"abc\n")
+        await sink.close()
+        await sink.close()
+
+    run(drive())
+    assert open(path, "rb").read() == b"abc\n"
+    assert sink.bytes_written == 4
+
+
+# ---- FilteredSink degrade routing (--on-filter-error) ---------------
+
+
+class FlakyService:
+    """Match service that is Unavailable for the first N calls."""
+
+    def __init__(self, fail_calls: int):
+        self.fail_calls = fail_calls
+        self.calls = 0
+
+    async def match(self, lines):
+        self.calls += 1
+        if self.calls <= self.fail_calls:
+            raise Unavailable("filter service at test:0: down")
+        return [b"ERROR" in ln for ln in lines]
+
+
+def _mk_sink(tmp_path, action, svc):
+    from klogs_tpu.filters.base import FilterStats
+    from klogs_tpu.filters.sink import FilteredSink
+
+    stats = FilterStats()
+    inner = FileSink(str(tmp_path / "out.log"))
+    sink = FilteredSink(inner, None, stats, batch_lines=2,
+                        deadline_s=60.0, service=svc,
+                        on_filter_error=action)
+    return sink, stats
+
+
+BATCH1 = [b"one ERROR a\n", b"two ok b\n"]
+BATCH2 = [b"three ERROR c\n", b"four ok d\n"]
+
+
+def _degraded(stats, action):
+    reg = stats.registry
+    return (reg.family("klogs_filter_degraded_batches_total")
+            .labels(action=action).value,
+            reg.family("klogs_filter_degraded_lines_total")
+            .labels(action=action).value)
+
+
+def test_degrade_pass_writes_unfiltered_then_recovers(tmp_path, capsys):
+    svc = FlakyService(fail_calls=1)
+    sink, stats = _mk_sink(tmp_path, "pass", svc)
+
+    async def drive():
+        await sink.write(b"".join(BATCH1))  # batch_lines=2 -> flush, degraded
+        await sink.write(b"".join(BATCH2))  # service back -> filtered
+        await sink.close()
+
+    run(drive())
+    data = open(str(tmp_path / "out.log"), "rb").read()
+    # Degraded batch passed through UNFILTERED; recovered batch gated.
+    assert b"two ok b" in data and b"one ERROR a" in data
+    assert b"three ERROR c" in data and b"four ok d" not in data
+    assert _degraded(stats, "pass") == (1, 2)
+    out = capsys.readouterr().out
+    assert "UNFILTERED" in out and "recovered" in out
+
+
+def test_degrade_drop_discards_batch(tmp_path):
+    svc = FlakyService(fail_calls=1)
+    sink, stats = _mk_sink(tmp_path, "drop", svc)
+
+    async def drive():
+        await sink.write(b"".join(BATCH1))
+        await sink.write(b"".join(BATCH2))
+        await sink.close()
+
+    run(drive())
+    data = open(str(tmp_path / "out.log"), "rb").read()
+    assert b"one ERROR a" not in data  # dropped while degraded
+    assert b"three ERROR c" in data   # filtered after recovery
+    assert _degraded(stats, "drop") == (1, 2)
+
+
+def test_degrade_abort_propagates_and_releases_file(tmp_path):
+    svc = FlakyService(fail_calls=10)
+    sink, _ = _mk_sink(tmp_path, "abort", svc)
+
+    async def drive():
+        with pytest.raises(Unavailable):
+            await sink.write(b"".join(BATCH1))
+        # close() must still release the inner file even though the
+        # service is dead (final flush is empty here).
+        await sink.close()
+
+    run(drive())
+    assert sink._inner._f.closed
+
+
+def test_degrade_framed_path_pass(tmp_path):
+    """The zero-per-line framed path degrades identically."""
+    pytest.importorskip("numpy")
+    from klogs_tpu.filters.framer import FramedBatcher
+
+    try:
+        FramedBatcher()
+    except RuntimeError:
+        pytest.skip("native hostops module unavailable")
+
+    class FramedFlaky(FlakyService):
+        async def match_framed(self, payload, offsets):
+            import numpy as np
+
+            self.calls += 1
+            if self.calls <= self.fail_calls:
+                raise Unavailable("down")
+            from klogs_tpu.filters.base import split_frame
+
+            return np.asarray(
+                [b"ERROR" in ln for ln in split_frame(payload, offsets)],
+                dtype=bool)
+
+    svc = FramedFlaky(fail_calls=1)
+    sink, stats = _mk_sink(tmp_path, "pass", svc)
+
+    async def drive():
+        await sink.write(b"".join(BATCH1))
+        await sink.write(b"".join(BATCH2))
+        await sink.close()
+
+    run(drive())
+    data = open(str(tmp_path / "out.log"), "rb").read()
+    assert b"two ok b" in data and b"four ok d" not in data
+    assert _degraded(stats, "pass") == (1, 2)
+
+
+def test_flusher_escalates_abort_and_sets_stop(tmp_path):
+    """Review regression: with --on-filter-error=abort, an Unavailable
+    from the DEADLINE flusher (idle stream, pending lines) must stop
+    the run and surface — not be swallowed as a per-sweep warning."""
+    from klogs_tpu.filters.base import FilterStats
+    from klogs_tpu.filters.sink import FilterPipeline
+
+    svc = FlakyService(fail_calls=99)
+    stats = FilterStats()
+    pipeline = FilterPipeline(log_filter=None, stats=stats,
+                              batch_lines=1000, deadline_s=0.01,
+                              service=svc, on_filter_error="abort")
+    sink = pipeline.sink_factory(
+        __import__("klogs_tpu.runtime.fanout", fromlist=["StreamJob"])
+        .StreamJob("p", "c", False, str(tmp_path / "p__c.log")))
+
+    async def scenario():
+        stop = asyncio.Event()
+        flusher = asyncio.create_task(pipeline.run_deadline_flusher(stop))
+        await sink.write(b"pending line\n")  # below batch_lines: stays
+        await asyncio.wait_for(stop.wait(), timeout=5)
+        with pytest.raises(Unavailable):
+            await flusher
+
+    run(scenario())
+
+
+def test_exhausted_rpc_unavailable_is_one_friendly_line(tmp_path):
+    """Review regression: the terminal Unavailable for a dead filterd
+    must carry the one-line CODE: details form, not AioRpcError's
+    multi-line debug repr."""
+    pytest.importorskip("grpc")
+    from klogs_tpu.resilience import RetryPolicy
+    from klogs_tpu.service.client import RemoteFilterClient
+    from klogs_tpu.service.server import FilterServer
+
+    async def scenario():
+        server = FilterServer(["ERROR"], backend="cpu", port=0)
+        port = await server.start()
+        await server.stop()  # the port is now dead
+        client = RemoteFilterClient(
+            f"127.0.0.1:{port}",
+            retry=RetryPolicy(max_attempts=2, base_s=0.001, max_s=0.002,
+                              jitter=0.0),
+            rpc_timeout_s=5.0)
+        try:
+            with pytest.raises(Unavailable) as ei:
+                await client.match([b"x"])
+            msg = str(ei.value)
+            assert "UNAVAILABLE" in msg and f"127.0.0.1:{port}" in msg
+            assert "\n" not in msg and "debug_error_string" not in msg
+        finally:
+            await client.aclose()
+
+    run(asyncio.wait_for(scenario(), timeout=30))
+
+
+def test_remote_timeout_env_rejects_nonpositive(monkeypatch):
+    from klogs_tpu.filters.sink import make_pipeline
+    from klogs_tpu.service.client import ServiceConfigError
+
+    pytest.importorskip("grpc")
+    for bad in ("0", "-5", "abc"):
+        monkeypatch.setenv("KLOGS_REMOTE_TIMEOUT_S", bad)
+        with pytest.raises(ServiceConfigError, match="KLOGS_REMOTE_TIMEOUT_S"):
+            make_pipeline(["x"], "cpu", remote="127.0.0.1:1")
+
+
+def test_on_filter_error_flag_parses():
+    from klogs_tpu.cli import parse_args
+
+    assert parse_args([]).on_filter_error == "abort"
+    assert parse_args(["--on-filter-error", "pass"]).on_filter_error == "pass"
+
+
+def test_kube_backend_in_scope_of_retry_discipline():
+    """The shared-policy convergence is load-bearing: kube, fanout and
+    the rpc client must all reference the resilience package (no local
+    backoff forks)."""
+    import klogs_tpu.cluster.kube as kube
+    import klogs_tpu.runtime.fanout as fanout
+    import klogs_tpu.service.client as client
+
+    for mod in (kube, fanout, client):
+        src = open(mod.__file__, encoding="utf-8").read()
+        assert "resilience" in src, mod.__name__
+
+
+def test_env_spec_loaded_by_app(tmp_path, monkeypatch, capsys):
+    """KLOGS_FAULTS is parsed at run start (loudly) and a bad spec is a
+    friendly fatal, not a traceback."""
+    from klogs_tpu import app
+    from klogs_tpu.cli import parse_args
+    from klogs_tpu.cluster.fake import FakeCluster
+    from klogs_tpu.ui import term
+
+    monkeypatch.setenv("KLOGS_FAULTS", "rpc.match:explode")
+    fc = FakeCluster.synthetic(n_pods=1, lines_per_container=5)
+    opts = parse_args(["-n", "default", "-a",
+                       "-p", str(tmp_path / "logs")])
+    with pytest.raises(term.FatalError):
+        run(app.run_async(opts, backend=fc))
+    assert "invalid KLOGS_FAULTS" in capsys.readouterr().out

@@ -12,6 +12,7 @@ def all_passes():
     from tools.analysis.passes.int32_guard import Int32GuardPass
     from tools.analysis.passes.lock_discipline import LockDisciplinePass
     from tools.analysis.passes.metrics_docs import MetricsDocsPass
+    from tools.analysis.passes.retry_discipline import RetryDisciplinePass
     from tools.analysis.passes.traced_purity import TracedPurityPass
 
     return [
@@ -20,6 +21,7 @@ def all_passes():
         TracedPurityPass(),
         DispatchParityPass(),
         Int32GuardPass(),
+        RetryDisciplinePass(),
         MetricsDocsPass(),
         CliDocsPass(),
     ]

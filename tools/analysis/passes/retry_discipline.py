@@ -1,0 +1,113 @@
+"""retry-discipline: no hand-rolled retry backoff in the stream
+plumbing.
+
+The resilience subsystem exists so that RPC, kube, and fanout all share
+ONE backoff implementation (``klogs_tpu.resilience.RetryPolicy``):
+jittered, stop-event-aware, breaker-compatible, metered through
+``klogs_retry_attempts_total``. The bug class this pass pins down is
+the pre-resilience shape — a loop that catches a failure and sleeps a
+raw ``asyncio.sleep``/``time.sleep`` between attempts. Such a loop
+ignores Ctrl-C/stop for the whole backoff, herds a fleet onto one
+retry schedule (no jitter), and is invisible to the retry metrics.
+
+Rule, over the stream-plumbing scope (cluster/, runtime/, service/,
+resilience/, filters/sink.py, filters/async_service.py):
+
+- inside any ``for``/``while`` loop whose body contains an ``except``
+  handler (the retry shape: fail, wait, go again), a call to
+  ``asyncio.sleep`` or ``time.sleep`` is a finding — retry waits must
+  go through the policy (``policy.sleep(attempt, stop)`` /
+  ``policy.wait(delay, stop)``) or an explicitly stop-aware
+  ``asyncio.wait_for(stop.wait(), timeout=...)``;
+- ``time.sleep`` inside ANY loop in scope is a finding regardless of
+  except handlers: sync code cannot be stop-aware at all, and in this
+  scope it also blocks the shared event loop (async-blocking covers
+  the async bodies; this covers sync helpers' loops).
+
+Periodic loops that sleep WITHOUT an except handler (the deadline
+flusher, pollers built on ``wait_for(stop.wait(), ...)``) are not
+retry loops and stay untouched. Nested ``def``s inside a loop are the
+loop's implementation detail only when they execute there — they are
+skipped, as in the async-blocking pass.
+"""
+
+import ast
+
+from tools.analysis.core import Finding, Pass, Project, SourceFile
+
+SCOPE = (
+    "klogs_tpu/cluster",
+    "klogs_tpu/runtime",
+    "klogs_tpu/service",
+    "klogs_tpu/resilience",
+    "klogs_tpu/filters/sink.py",
+    "klogs_tpu/filters/async_service.py",
+)
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _own_nodes(loop: ast.AST) -> list[ast.AST]:
+    """Loop-body nodes excluding nested function/class defs (their
+    bodies run elsewhere) — nested loops' contents stay included (the
+    sleep of a retry loop often hides one level down)."""
+    out: list[ast.AST] = []
+    stack: list[ast.AST] = list(ast.iter_child_nodes(loop))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+class RetryDisciplinePass(Pass):
+    rule = "retry-discipline"
+    doc = ("loops that sleep between attempts must use the shared "
+           "resilience RetryPolicy (stop-aware, jittered, metered)")
+
+    def run(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for sf in project.files(*SCOPE):
+            findings.extend(self._check_file(sf))
+        return findings
+
+    def _check_file(self, sf: SourceFile) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            own = _own_nodes(node)
+            has_except = any(isinstance(n, ast.ExceptHandler) for n in own)
+            for n in own:
+                if not isinstance(n, ast.Call):
+                    continue
+                dotted = _dotted(n.func)
+                if dotted == "time.sleep":
+                    findings.append(self.finding(
+                        sf.relpath, n.lineno,
+                        "time.sleep inside a loop: a sync backoff can "
+                        "never be stop-aware (and blocks the shared "
+                        "event loop) — use the resilience RetryPolicy "
+                        "from async code, or restructure"))
+                elif dotted == "asyncio.sleep" and has_except:
+                    findings.append(self.finding(
+                        sf.relpath, n.lineno,
+                        "hand-rolled retry backoff: asyncio.sleep in a "
+                        "loop that catches exceptions — use klogs_tpu."
+                        "resilience.RetryPolicy.sleep/wait (stop-aware, "
+                        "jittered, counted in "
+                        "klogs_retry_attempts_total) or an explicit "
+                        "asyncio.wait_for(stop.wait(), timeout=...)"))
+        return findings
