@@ -278,7 +278,8 @@ def make_pipeline_for(opts: Options, registry=None):
         return make_pipeline(opts.match, opts.backend, remote=opts.remote,
                              ignore_case=opts.ignore_case,
                              exclude=opts.exclude, registry=registry,
-                             on_filter_error=opts.on_filter_error)
+                             on_filter_error=opts.on_filter_error,
+                             shard_mode=opts.shard_mode)
     except _re.error as e:
         term.fatal("invalid --match/--exclude pattern %r: %s", e.pattern, e)
     except RegexSyntaxError as e:

@@ -39,7 +39,13 @@ New (north-star) flags, absent from the reference:
                     (kubectl parity; PodLogOptions.SinceTime;
                     mutually exclusive with -s/--since)
   --backend         filter engine: cpu (host regex) | tpu (batch NFA)
-  --remote          gate writes via a klogs-filterd service (gRPC)
+  --remote          gate writes via klogs-filterd service(s) (gRPC);
+                    a comma-separated list shards batches across the
+                    fleet with per-endpoint breakers, hedged dispatch,
+                    and /readyz-driven drain (docs/RESILIENCE.md)
+  --shard-mode      multi-endpoint --remote routing: round-robin
+                    (rotate per batch) | hash (pin by pattern-set
+                    fingerprint on a consistent-hash ring)
   --on-filter-error what to do when the filter service is unavailable
                     after retries: pass | drop | abort (default abort;
                     see docs/RESILIENCE.md)
@@ -78,6 +84,7 @@ class Options:
     ignore_case: bool = False
     backend: str = "cpu"
     remote: str | None = None
+    shard_mode: str = "round-robin"
     on_filter_error: str = "abort"
     stats: bool = False
     metrics_port: int | None = None
@@ -184,9 +191,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--remote",
         default=None,
-        metavar="HOST:PORT",
-        help="Filter via a remote klogs-filterd service "
-        "(python -m klogs_tpu.service) instead of an in-process engine",
+        metavar="HOST:PORT[,HOST:PORT...]",
+        help="Filter via remote klogs-filterd service(s) "
+        "(python -m klogs_tpu.service) instead of an in-process engine. "
+        "A comma-separated list shards batches across the fleet "
+        "(--shard-mode) with per-endpoint circuit breakers, hedged "
+        "dispatch, and /readyz-driven drain — one dead or draining "
+        "server is routed around, not an outage",
+    )
+    p.add_argument(
+        "--shard-mode",
+        choices=["round-robin", "hash"],
+        default="round-robin",
+        dest="shard_mode",
+        help="With a multi-endpoint --remote list: rotate batches "
+        "across the fleet (round-robin) or pin this collector's "
+        "pattern-set fingerprint to one owner on a consistent-hash "
+        "ring (hash; maximizes the owner's coalescer/compile-cache "
+        "locality, keys move minimally when an endpoint dies)",
     )
     p.add_argument(
         "--on-filter-error",
@@ -324,6 +346,7 @@ def parse_args(argv: list[str] | None = None) -> Options:
         ignore_case=ns.ignore_case,
         backend=ns.backend,
         remote=ns.remote,
+        shard_mode=ns.shard_mode,
         on_filter_error=ns.on_filter_error,
         stats=ns.stats,
         metrics_port=ns.metrics_port,
@@ -378,6 +401,13 @@ def main(argv: list[str] | None = None) -> int:
                        "timezone, e.g. 2026-07-31T06:00:00Z)",
                        opts.since_time)
             return 1
+    if opts.shard_mode != "round-robin" and (
+            opts.remote is None or "," not in opts.remote):
+        # One endpoint is below the routing layer entirely (the plain
+        # client is used) — say so rather than silently dropping the
+        # flag a user sized their fleet around.
+        term.warning("--shard-mode only applies with a multi-endpoint "
+                     "--remote list; ignoring")
     for flag, pat in (("-c/--container", opts.container),
                       ("-E/--exclude-container", opts.exclude_container)):
         if pat:

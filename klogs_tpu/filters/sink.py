@@ -211,7 +211,11 @@ class FilteredSink(Sink):
         --on-filter-error: pass = write unfiltered, drop = discard,
         abort = propagate (the run ends with one friendly line). The
         choice is counted per action so a scrape shows exactly how many
-        lines rode each degrade path."""
+        lines rode each degrade path. Against a sharded --remote fleet
+        the service only raises Unavailable after every endpoint has
+        failed (partial-fleet failure is rerouted upstream, never
+        degraded), so this path still means 'filtering is truly
+        gone'."""
         if self._on_filter_error == "abort":
             raise e
         if not self._degrade_warned:
@@ -431,6 +435,31 @@ def _build_filter(patterns: list[str], backend: str, stats,
                            engine=engine, stats=stats)
 
 
+def _env_positive_float(name: str, default: float) -> float:
+    """Env-tunable positive float; zero/negative/garbage is rejected
+    naming the variable (a bad knob must not surface as a mystery
+    timeout/latency downstream)."""
+    import math
+    import os
+
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        value = float(raw)
+        # nan compares False against everything (it would flow into a
+        # timeout unchecked) and inf is no deadline at all — both are
+        # garbage for a knob documented as a positive number of seconds.
+        if not math.isfinite(value) or value <= 0:
+            raise ValueError("must be positive and finite")
+    except ValueError as e:
+        from klogs_tpu.service.client import ServiceConfigError
+
+        raise ServiceConfigError(
+            f"{name} must be a positive number, got {raw!r}") from e
+    return value
+
+
 def make_pipeline(patterns: list[str], backend: str,
                   batch_lines: int | None = None,
                   deadline_s: float = 0.05,
@@ -438,7 +467,8 @@ def make_pipeline(patterns: list[str], backend: str,
                   ignore_case: bool = False,
                   exclude: list[str] | None = None,
                   registry=None,
-                  on_filter_error: str = "abort") -> FilterPipeline:
+                  on_filter_error: str = "abort",
+                  shard_mode: str = "round-robin") -> FilterPipeline:
     # ``registry`` (an obs.Registry) shares the stats backing store
     # with a /metrics sidecar or --stats-json dump; None keeps the
     # pipeline's numbers private (default, and what tests rely on).
@@ -449,6 +479,13 @@ def make_pipeline(patterns: list[str], backend: str,
         import os
 
         from klogs_tpu.service.client import RemoteFilterClient
+        from klogs_tpu.service.shard import (
+            DEFAULT_HEDGE_S,
+            DEFAULT_PROBE_INTERVAL_S,
+            ShardedFilterClient,
+            parse_endpoints,
+            pattern_fingerprint,
+        )
 
         # Transport security for the cross-node collector->filterd hop,
         # via env (a --remote deployment is configured by manifest, not
@@ -460,28 +497,47 @@ def make_pipeline(patterns: list[str], backend: str,
         # line — no SystemExit from library code.
         # Per-RPC deadline: KLOGS_REMOTE_TIMEOUT_S bounds each attempt
         # (retry/backoff/breaker defaults live in the client; see
-        # docs/RESILIENCE.md).
-        raw_timeout = os.environ.get("KLOGS_REMOTE_TIMEOUT_S", "30")
-        try:
-            rpc_timeout_s = float(raw_timeout)
-            if rpc_timeout_s <= 0:
-                raise ValueError("must be positive")
-        except ValueError as e:
-            from klogs_tpu.service.client import ServiceConfigError
+        # docs/RESILIENCE.md). Zero/negative would DEADLINE_EXCEED
+        # every attempt with an error that never names the env var.
+        rpc_timeout_s = _env_positive_float("KLOGS_REMOTE_TIMEOUT_S", 30.0)
+        targets = parse_endpoints(remote)
+        from klogs_tpu.resilience import FAULTS
 
-            # Zero/negative would DEADLINE_EXCEED every attempt with an
-            # error that never names this env var — reject it here.
-            raise ServiceConfigError(
-                f"KLOGS_REMOTE_TIMEOUT_S must be a positive number, got "
-                f"{raw_timeout!r}") from e
-        service = RemoteFilterClient(
-            remote,
+        stray = FAULTS.armed_targets() - set(targets)
+        if stray:
+            # A targeted chaos clause naming an endpoint outside the
+            # fleet can never fire — one typoed digit and the chaos run
+            # green-lights behavior it never exercised. Loud, like
+            # every other bad-fault-spec path.
+            term.warning(
+                "KLOGS_FAULTS targets %s not in the --remote list %s — "
+                "those clauses will never fire",
+                ", ".join(sorted(stray)), ",".join(targets))
+        common = dict(
             tls_ca=os.environ.get("KLOGS_REMOTE_TLS_CA"),
             tls_cert=os.environ.get("KLOGS_REMOTE_TLS_CERT"),
             tls_key=os.environ.get("KLOGS_REMOTE_TLS_KEY"),
             auth_token_file=os.environ.get("KLOGS_REMOTE_TOKEN_FILE"),
             rpc_timeout_s=rpc_timeout_s,
             registry=registry)
+        if len(targets) == 1:
+            # Single endpoint: the plain client, byte-identical to the
+            # pre-shard behavior (no hedge tasks, no prober).
+            service = RemoteFilterClient(targets[0], **common)
+        else:
+            # A fleet: the sharded tier (docs/RESILIENCE.md, "Sharded
+            # tier"). A batch raises Unavailable — and hence degrades
+            # per --on-filter-error — only when EVERY endpoint is down.
+            service = ShardedFilterClient(
+                targets,
+                shard_mode=shard_mode,
+                fingerprint=pattern_fingerprint(patterns, exclude,
+                                                ignore_case),
+                hedge_s=_env_positive_float("KLOGS_HEDGE_S",
+                                            DEFAULT_HEDGE_S),
+                probe_interval_s=_env_positive_float(
+                    "KLOGS_READYZ_INTERVAL_S", DEFAULT_PROBE_INTERVAL_S),
+                **common)
         return FilterPipeline(
             log_filter=None,
             stats=stats,

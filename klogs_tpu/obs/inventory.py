@@ -137,7 +137,9 @@ SPECS: dict[str, dict] = {
     # -- resilience layer (retry/breaker/faults/degrade) --------------
     "klogs_retry_attempts_total": _m(
         "counter", "Retries performed by the shared resilience policy, "
-        "by call site (rpc, kube, fanout).", labels=("site",)),
+        "by call site (kube, fanout, rpc@endpoint — RPC sites carry "
+        "the endpoint so a sharded fleet's servers stay "
+        "distinguishable).", labels=("site",)),
     "klogs_breaker_state": _m(
         "gauge", "Circuit-breaker state: 0=closed, 1=open, 2=half-open.",
         labels=("breaker",)),
@@ -152,6 +154,26 @@ SPECS: dict[str, dict] = {
         "counter", "Lines written unfiltered (action=pass) or dropped "
         "(action=drop) while the filter service was unavailable.",
         labels=("action",)),
+
+    # -- shard tier (ShardedFilterClient over N filterds) -------------
+    # Endpoint labels are the --remote fleet: deployment shape (a
+    # handful of servers), never traffic content.
+    "klogs_shard_batches_total": _m(
+        "counter", "Batches resolved by each filterd endpoint (the "
+        "winning attempt only — hedge losers are cancelled, never "
+        "counted).", labels=("endpoint",)),
+    "klogs_shard_hedges_total": _m(
+        "counter", "Hedged duplicate dispatches launched against a "
+        "sibling after the primary exceeded the hedge deadline, by "
+        "sibling endpoint.", labels=("endpoint",)),
+    "klogs_shard_reroutes_total": _m(
+        "counter", "Batches routed away from an endpoint: skipped as "
+        "primary (breaker open / not ready) or failed over after a "
+        "terminal attempt error.", labels=("endpoint", "reason")),
+    "klogs_shard_endpoint_ready": _m(
+        "gauge", "Endpoint readiness as last observed by the /readyz "
+        "prober (1 ready, 0 draining or unreachable).",
+        labels=("endpoint",)),
 
     # -- RPC layer (filterd gRPC server) ------------------------------
     "klogs_rpc_requests_total": _m(

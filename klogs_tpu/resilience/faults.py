@@ -18,6 +18,13 @@ the grammar), whose ``error`` faults raise ``InjectedFault`` — every
 guarded site classifies InjectedFault as a transient failure, so an
 env-armed script always drives the retry path.
 
+Endpoint targeting: a point may carry an ``@target`` qualifier
+(``rpc.match@127.0.0.1:50051:error*``) so a chaos script against a
+sharded filterd fleet can kill EXACTLY one server while its siblings
+stay healthy. Call sites that know their endpoint pass it to
+``fire(point, target)``; a targeted rule fires only for its endpoint,
+an untargeted rule fires for every endpoint (the pre-shard behavior).
+
 Zero-overhead when idle: sites guard with ``if FAULTS.active`` so a
 production run never pays an awaitable hop per chunk. Each firing
 counts into ``klogs_faults_injected_total{point=...}`` when a registry
@@ -50,13 +57,27 @@ class _Rule:
     times: "int | None"  # remaining firings; None = forever
     exc: "Callable[[], BaseException] | None"
     delay_s: float = 0.0
+    target: "str | None" = None  # endpoint qualifier; None = any
 
 
-# One clause: point:action[*times]; action = error | error(msg) |
-# delay(seconds). *N = N firings, bare * = every firing, absent = once.
+# One clause: point[@target]:action[*times]; action = error |
+# error(msg) | delay(seconds). *N = N firings, bare * = every firing,
+# absent = once. The target (an endpoint like host:port) may itself
+# contain ':' — the non-greedy match plus the literal action
+# alternatives keep the parse unambiguous.
 _CLAUSE = re.compile(
-    r"^(?P<point>[a-z_.]+):(?P<action>error|delay)"
+    r"^(?P<point>[a-z_.]+)(?:@(?P<target>.+?))?:(?P<action>error|delay)"
     r"(?:\((?P<arg>[^)]*)\))?(?P<star>\*(?P<times>\d+)?)?$")
+
+
+def _valid_target(target: str) -> bool:
+    """Endpoint shape a target must take to ever match a fire() site:
+    HOST:PORT or a unix socket path — the same rule service/shard.py's
+    parse_endpoints enforces on --remote entries."""
+    if target.startswith("unix:"):
+        return len(target) > len("unix:")
+    host, sep, port = target.rpartition(":")
+    return bool(sep and host and port.isdigit() and 0 < int(port) < 65536)
 
 
 class FaultInjector:
@@ -76,20 +97,30 @@ class FaultInjector:
 
     def arm(self, point: str, *, times: "int | None" = 1,
             exc: "BaseException | Callable[[], BaseException] | None" = None,
-            delay_s: float = 0.0) -> None:
+            delay_s: float = 0.0, target: "str | None" = None) -> None:
         """Script ``point`` to misbehave on its next ``times`` firings
         (None = every firing). ``exc`` may be an exception instance
         (re-raised as that instance each firing) or a zero-arg factory;
-        None with a delay = latency-only fault."""
+        None with a delay = latency-only fault. ``target`` restricts
+        the rule to one endpoint (only sites that pass their endpoint
+        to ``fire`` can match a targeted rule)."""
         factory = None
         if exc is not None:
             factory = exc if callable(exc) else (lambda e=exc: e)
         self._rules.setdefault(point, []).append(
-            _Rule(times=times, exc=factory, delay_s=delay_s))
+            _Rule(times=times, exc=factory, delay_s=delay_s, target=target))
 
     def clear(self) -> None:
         self._rules.clear()
         self.counts.clear()
+
+    def armed_targets(self) -> "set[str]":
+        """Endpoint qualifiers of currently-armed targeted rules — the
+        sharded pipeline cross-checks them against the real --remote
+        list so a well-formed but absent endpoint (one typoed digit)
+        warns instead of silently scripting nothing."""
+        return {r.target for rules in self._rules.values()
+                for r in rules if r.target is not None}
 
     def load_spec(self, spec: str) -> None:
         """Parse a ``KLOGS_FAULTS`` spec and REPLACE the current script
@@ -99,6 +130,9 @@ class FaultInjector:
             point:error            raise InjectedFault once
             point:error(msg)*3     raise InjectedFault(msg), 3 firings
             point:delay(0.5)*      sleep 0.5s before EVERY firing
+            point@host:port:error* ... only at ONE endpoint (sharded
+                                   --remote fleets; sites that know
+                                   their endpoint pass it to fire)
 
         Unknown points are rejected — a typoed point would otherwise be
         a chaos script that silently tests nothing.
@@ -112,12 +146,24 @@ class FaultInjector:
             if m is None:
                 raise FaultSpecError(
                     f"bad fault clause {clause!r} (want "
-                    "point:error[(msg)][*N] or point:delay(seconds)[*N])")
+                    "point[@endpoint]:error[(msg)][*N] or "
+                    "point[@endpoint]:delay(seconds)[*N])")
             point = m.group("point")
             if point not in KNOWN_POINTS:
                 raise FaultSpecError(
                     f"unknown fault point {point!r} (known: "
                     f"{', '.join(sorted(KNOWN_POINTS))})")
+            target = m.group("target")
+            if target is not None and not _valid_target(target):
+                # Same rationale as unknown points: a malformed target
+                # can never equal any endpoint passed to fire(), so the
+                # clause would be a chaos script that silently tests
+                # nothing. (A well-formed but absent endpoint is warned
+                # about against the real --remote list at pipeline
+                # build.)
+                raise FaultSpecError(
+                    f"bad fault target {target!r} in {clause!r} (want "
+                    "HOST:PORT or unix:/path.sock)")
             if m.group("star") is None:
                 times: "int | None" = 1
             elif m.group("times") is not None:
@@ -132,31 +178,44 @@ class FaultInjector:
                     raise FaultSpecError(
                         f"bad delay seconds in {clause!r}") from e
                 rules.setdefault(point, []).append(
-                    _Rule(times=times, exc=None, delay_s=delay))
+                    _Rule(times=times, exc=None, delay_s=delay,
+                          target=target))
             else:
                 msg = arg or f"injected fault at {point}"
                 rules.setdefault(point, []).append(_Rule(
-                    times=times, exc=(lambda m=msg: InjectedFault(m))))
+                    times=times, exc=(lambda m=msg: InjectedFault(m)),
+                    target=target))
         self._rules = rules
         self.counts.clear()
 
-    async def fire(self, point: str) -> None:
+    async def fire(self, point: str, target: "str | None" = None) -> None:
         """Apply the next armed rule for ``point`` (no-op when none):
-        count it, apply the delay, raise the scripted exception."""
+        count it, apply the delay, raise the scripted exception.
+        ``target`` is the firing site's endpoint identity (when it has
+        one): targeted rules fire only when it matches; untargeted
+        rules fire regardless."""
         rules = self._rules.get(point)
         if not rules:
             return
-        rule = rules[0]
+        for i, rule in enumerate(rules):
+            if rule.target is None or rule.target == target:
+                break
+        else:
+            return  # only rules scripted for OTHER endpoints remain
         if rule.times is not None:
             rule.times -= 1
             if rule.times <= 0:
-                rules.pop(0)
+                rules.pop(i)
                 if not rules:
                     del self._rules[point]
-        self.counts[point] = self.counts.get(point, 0) + 1
+        # Targeted firings count under their qualified name so a chaos
+        # scrape shows exactly which endpoint took the hit (endpoints
+        # are deployment shape — cardinality-safe).
+        key = point if rule.target is None else f"{point}@{rule.target}"
+        self.counts[key] = self.counts.get(key, 0) + 1
         if self._registry is not None:
             self._registry.family("klogs_faults_injected_total").labels(
-                point=point).inc()
+                point=key).inc()
         if rule.delay_s:
             await asyncio.sleep(rule.delay_s)
         if rule.exc is not None:

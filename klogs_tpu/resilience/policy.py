@@ -209,6 +209,7 @@ async def retry_call(
     deadline_s: "float | None" = None,
     stop: "asyncio.Event | None" = None,
     fault_point: "str | None" = None,
+    fault_target: "str | None" = None,
     registry=None,
 ) -> object:
     """Run ``await fn(deadline)`` under the unified policy.
@@ -222,9 +223,12 @@ async def retry_call(
     exceptions propagate untouched and do NOT trip the breaker (an
     INVALID_ARGUMENT is the caller's bug, not the callee's health).
 
-    ``site`` labels ``klogs_retry_attempts_total`` (keep it
-    low-cardinality: rpc/kube/fanout); ``describe`` (default: site) is
-    the human prefix on Unavailable messages and may name the target.
+    ``site`` labels ``klogs_retry_attempts_total`` (keep it bounded by
+    deployment shape: kube/fanout, rpc@endpoint); ``describe``
+    (default: site) is the human prefix on Unavailable messages and may
+    name the target. ``fault_target`` is the endpoint identity handed
+    to ``FAULTS.fire`` so ``point@endpoint`` chaos rules can hit
+    exactly this call site's server.
     """
     from klogs_tpu.resilience.faults import FAULTS, InjectedFault
 
@@ -241,7 +245,7 @@ async def retry_call(
                 f"(retry after ~{breaker.reset_timeout_s:.0f}s)")
         try:
             if fault_point is not None and FAULTS.active:
-                await FAULTS.fire(fault_point)
+                await FAULTS.fire(fault_point, fault_target)
             result = await fn(
                 Deadline(deadline_s) if deadline_s is not None else None)
         except Exception as e:  # noqa: BLE001 - classified below

@@ -42,6 +42,14 @@ def main() -> None:
                     "(liveness) and /readyz (readiness; unready until "
                     "the warmup batch clears the cold-start compile) on "
                     "this HTTP port (0 = ephemeral; binds 127.0.0.1)")
+    ap.add_argument("--metrics-host", default="127.0.0.1",
+                    metavar="HOST",
+                    help="metrics/health bind address. Cross-node "
+                    "sharded collectors drain this server via its "
+                    "advertised /readyz, which they can only reach when "
+                    "this binds a routable address (e.g. 0.0.0.0); the "
+                    "loopback default keeps the sidecar private and "
+                    "collectors then rely on breakers alone")
     ns = ap.parse_args()
     if ns.auth_token_file:
         # Fail fast on a bad path/empty file; the server re-reads the
@@ -59,7 +67,8 @@ def main() -> None:
                           tls_client_ca=ns.tls_client_ca,
                           auth_token_file=ns.auth_token_file,
                           exclude=ns.exclude,
-                          metrics_port=ns.metrics_port))
+                          metrics_port=ns.metrics_port,
+                          metrics_host=ns.metrics_host))
     except KeyboardInterrupt:
         pass
     except RegexSyntaxError as e:  # subclasses ValueError: catch first

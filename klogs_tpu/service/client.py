@@ -54,6 +54,31 @@ def _read(path: str, what: str) -> bytes:
         raise ServiceConfigError(f"cannot read {what} {path}: {e}") from e
 
 
+def check_server_config(target: str, info: dict, patterns: list[str],
+                        ignore_case: bool,
+                        exclude: "list[str] | None") -> None:
+    """Compare a Hello response against the collector's invocation and
+    raise PatternMismatch naming ``target`` on any drift. Shared by the
+    single-endpoint client and the sharded tier (which verifies every
+    endpoint from ONE Hello each instead of re-dialing per check)."""
+    if list(info.get("patterns", [])) != list(patterns):
+        raise PatternMismatch(
+            f"filter service at {target} serves patterns "
+            f"{info.get('patterns')!r}, collector wants {patterns!r}"
+        )
+    if list(info.get("exclude", [])) != list(exclude or []):
+        raise PatternMismatch(
+            f"filter service at {target} has exclude patterns "
+            f"{info.get('exclude')!r}, collector wants {exclude or []!r}"
+        )
+    if bool(info.get("ignore_case", False)) != bool(ignore_case):
+        raise PatternMismatch(
+            f"filter service at {target} has ignore_case="
+            f"{info.get('ignore_case', False)!r}, collector wants "
+            f"{bool(ignore_case)!r}"
+        )
+
+
 class RemoteFilterClient:
     """``tls_ca`` switches the channel to TLS (server verified against
     that bundle); ``tls_cert``/``tls_key`` add a client certificate
@@ -121,13 +146,27 @@ class RemoteFilterClient:
         # behind one breaker per client — consecutive failures trip it
         # and subsequent calls fast-fail (Unavailable), which the
         # FilteredSink routes per --on-filter-error instead of letting
-        # a dead filterd wedge every sink flush.
+        # a dead filterd wedge every sink flush. Breaker name and retry
+        # site both carry the endpoint identity: against a sharded
+        # --remote fleet, anonymous "rpc" series would merge every
+        # server into one undebuggable line.
         self._retry = retry if retry is not None else DEFAULT_RETRY
+        self._site = f"rpc@{target}"
         self._breaker = breaker if breaker is not None else CircuitBreaker(
-            name="rpc", failure_threshold=DEFAULT_BREAKER_THRESHOLD,
+            name=self._site, failure_threshold=DEFAULT_BREAKER_THRESHOLD,
             reset_timeout_s=DEFAULT_BREAKER_RESET_S, registry=registry)
         self._rpc_timeout_s = rpc_timeout_s
         self._registry = registry
+
+    @property
+    def target(self) -> str:
+        return self._target
+
+    @property
+    def breaker(self) -> CircuitBreaker:
+        """This client's breaker — the sharded tier reads its state to
+        route batches around an endpoint that is fast-failing."""
+        return self._breaker
 
     def _metadata(self):
         token = self._auth_token
@@ -164,10 +203,11 @@ class RemoteFilterClient:
         try:
             return await retry_call(
                 attempt, policy=self._retry, retryable=_retryable,
-                site="rpc",
+                site=self._site,
                 describe=f"filter service at {self._target}",
                 breaker=self._breaker, deadline_s=self._rpc_timeout_s,
-                fault_point=fault_point, registry=self._registry)
+                fault_point=fault_point, fault_target=self._target,
+                registry=self._registry)
         except Unavailable as e:
             cause = e.__cause__
             if isinstance(cause, grpc.aio.AioRpcError):
@@ -195,22 +235,8 @@ class RemoteFilterClient:
         (case mode or exclude set) than this collector was invoked
         with."""
         info = await self.hello()
-        if list(info.get("patterns", [])) != list(patterns):
-            raise PatternMismatch(
-                f"filter service at {self._target} serves patterns "
-                f"{info.get('patterns')!r}, collector wants {patterns!r}"
-            )
-        if list(info.get("exclude", [])) != list(exclude or []):
-            raise PatternMismatch(
-                f"filter service at {self._target} has exclude patterns "
-                f"{info.get('exclude')!r}, collector wants {exclude or []!r}"
-            )
-        if bool(info.get("ignore_case", False)) != bool(ignore_case):
-            raise PatternMismatch(
-                f"filter service at {self._target} has ignore_case="
-                f"{info.get('ignore_case', False)!r}, collector wants "
-                f"{bool(ignore_case)!r}"
-            )
+        check_server_config(self._target, info, patterns, ignore_case,
+                            exclude)
 
     async def match(self, lines: list[bytes]) -> list[bool]:
         resp = await self._call(

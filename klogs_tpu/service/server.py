@@ -248,6 +248,17 @@ class FilterServer:
             "backend": self.backend,
             "version": BUILD_VERSION,
             "framed": True,
+            # Readiness discovery for the sharded client tier: where
+            # /readyz lives (bound sidecar host+port), so a collector
+            # can drain this server on rolling restarts without extra
+            # configuration. port=None when the sidecar is off — the
+            # client then relies on breakers alone. The host matters:
+            # a loopback-bound sidecar is unreachable from a remote
+            # collector, and the client must know NOT to probe it (a
+            # refused probe would wrongly demote a healthy server).
+            # Old clients ignore both keys.
+            "metrics_port": self.metrics_port,
+            "metrics_host": self.metrics_host,
         })
 
     async def _match(self, request: bytes, context) -> bytes:

@@ -1,0 +1,640 @@
+"""Sharded filterd tier: one collector, N filter servers.
+
+``ShardedFilterClient`` wraps one ``RemoteFilterClient`` per endpoint
+behind the exact client API the sink layer already speaks (``hello`` /
+``verify_patterns`` / ``match`` / ``match_framed`` / ``aclose``), so a
+fleet drops in wherever a single ``--remote`` server did. What it adds
+is the part the paper's single-endpoint pipeline could not have: a
+*dead or draining* server becomes a routing event instead of an outage.
+
+Mechanisms, in the order a batch meets them:
+
+- **Routing** (``--shard-mode``): ``round-robin`` rotates the fleet per
+  batch; ``hash`` pins the pattern-set fingerprint to an owner on a
+  consistent-hash ring (virtual nodes), so identical collectors
+  converge on the same server — maximizing that server's coalescer and
+  compile-cache locality — and an endpoint loss moves only the keys it
+  owned.
+- **Per-endpoint breakers**: each inner client carries its own
+  ``CircuitBreaker`` (``rpc@host:port``). An open breaker demotes the
+  endpoint to last-resort; its fast-fail (no wire traffic) is what
+  keeps a dead server from costing every flush a retry tower.
+- **Readiness drain**: endpoints that advertise a metrics port in
+  their Hello get their ``/readyz`` polled in the background. A
+  draining/restarting server (readiness 503, or nothing listening) is
+  routed around BEFORE its RPCs start failing, and rejoins the
+  rotation the moment ``/readyz`` answers 200 again.
+- **Hedged dispatch**: if the primary attempt has not resolved within
+  ``hedge_s``, the same batch is raced against the next sibling (and
+  another each further ``hedge_s``). First success wins; losers are
+  cancelled promptly and never double-count anywhere — the sink
+  records exactly one result per batch.
+- **Failover**: an endpoint whose attempt terminates ``Unavailable``
+  (retries exhausted / breaker open) is skipped and the next candidate
+  tried. Only when EVERY endpoint has failed does the dispatch raise
+  ``Unavailable`` — the type ``--on-filter-error`` degrade routing
+  catches — so partial-fleet failure never degrades a single batch.
+"""
+
+import asyncio
+import bisect
+import hashlib
+from typing import Any, Awaitable, Callable, Iterable, Sequence
+
+from klogs_tpu.resilience import (
+    BREAKER_OPEN,
+    BreakerOpen,
+    CircuitBreaker,
+    Unavailable,
+)
+from klogs_tpu.service.client import (
+    PatternMismatch,
+    RemoteFilterClient,
+    ServiceConfigError,
+    check_server_config,
+)
+from klogs_tpu.ui import term
+
+SHARD_MODES = ("round-robin", "hash")
+
+# Hedge a batch against a sibling when the primary has not resolved in
+# this long (KLOGS_HEDGE_S overrides via make_pipeline). Batches run in
+# milliseconds against a healthy filterd: a second of silence means the
+# server is compiling, draining, or gone — all cases where racing a
+# sibling beats waiting out the primary's full retry tower.
+DEFAULT_HEDGE_S = 1.0
+DEFAULT_PROBE_INTERVAL_S = 1.0
+DEFAULT_PROBE_TIMEOUT_S = 1.0
+
+# Virtual nodes per endpoint on the consistent-hash ring: enough that
+# removing one of a handful of endpoints re-homes its keys roughly
+# evenly across the survivors.
+_RING_VNODES = 64
+
+
+def parse_endpoints(spec: str) -> list[str]:
+    """Split a comma-separated ``--remote`` list and validate every
+    entry up front: a malformed target must fail naming itself at
+    startup, not as a late gRPC error mid-stream."""
+    targets: list[str] = []
+    seen: set[str] = set()
+    for raw in spec.split(","):
+        target = raw.strip()
+        if not target:
+            raise ServiceConfigError(
+                f"--remote list {spec!r} contains an empty entry")
+        _validate_target(target)
+        if target in seen:
+            raise ServiceConfigError(
+                f"--remote lists endpoint {target!r} more than once")
+        seen.add(target)
+        targets.append(target)
+    return targets
+
+
+def _validate_target(target: str) -> None:
+    if target.startswith("unix:"):
+        if len(target) == len("unix:"):
+            raise ServiceConfigError(
+                f"malformed --remote endpoint {target!r}: empty unix "
+                "socket path")
+        return
+    host, sep, port = target.rpartition(":")
+    if not sep or not host:
+        raise ServiceConfigError(
+            f"malformed --remote endpoint {target!r} (want HOST:PORT "
+            "or unix:/path.sock)")
+    if not port.isdigit() or not 0 < int(port) < 65536:
+        raise ServiceConfigError(
+            f"malformed --remote endpoint {target!r}: bad port {port!r}")
+
+
+def pattern_fingerprint(patterns: Sequence[str],
+                        exclude: "Sequence[str] | None" = None,
+                        ignore_case: bool = False) -> str:
+    """Content fingerprint of a compiled pattern set — the hash-mode
+    routing key. Two collectors invoked with the same --match/--exclude
+    set (order-sensitive, like the Hello handshake) land on the same
+    shard owner."""
+    h = hashlib.sha256()
+    for p in patterns:
+        h.update(b"m\x00" + p.encode() + b"\x00")
+    for p in exclude or ():
+        h.update(b"x\x00" + p.encode() + b"\x00")
+    h.update(b"i" if ignore_case else b"c")
+    return h.hexdigest()[:16]
+
+
+class _Endpoint:
+    """One fleet member: the wrapped client plus the router's view of
+    its health (prober-observed readiness; the breaker lives on the
+    client)."""
+
+    __slots__ = ("target", "client", "ready", "readyz", "verified",
+                 "quarantined")
+
+    def __init__(self, target: str, client: Any) -> None:
+        self.target = target
+        self.client = client
+        # Unknown = routable: a fleet with no metrics ports configured
+        # must still route everywhere (breakers alone protect it).
+        self.ready = True
+        self.readyz: "tuple[str, int] | None" = None
+        # verified False = the endpoint was unreachable during the
+        # startup handshake: it must not receive traffic until a later
+        # Hello proves its pattern set matches (the prober re-tries).
+        # quarantined = it came back with a DRIFTED set: permanently
+        # excluded — mis-filtered output is worse than less capacity.
+        self.verified = True
+        self.quarantined = False
+
+    @property
+    def breaker(self) -> CircuitBreaker:
+        return self.client.breaker
+
+
+class ShardedFilterClient:
+    """N ``RemoteFilterClient``s behind the one-client API.
+
+    ``client_factory`` (tests) builds the per-endpoint client; the
+    default builds a ``RemoteFilterClient`` with ``client_kwargs``
+    (TLS/auth/timeout config shared across the fleet) and a
+    per-endpoint breaker named ``rpc@<target>``.
+    """
+
+    def __init__(self, targets: Iterable[str], *,
+                 shard_mode: str = "round-robin",
+                 fingerprint: str = "",
+                 hedge_s: "float | None" = DEFAULT_HEDGE_S,
+                 probe_interval_s: float = DEFAULT_PROBE_INTERVAL_S,
+                 probe_timeout_s: float = DEFAULT_PROBE_TIMEOUT_S,
+                 registry: Any = None,
+                 client_factory: "Callable[[str], Any] | None" = None,
+                 **client_kwargs: Any) -> None:
+        if shard_mode not in SHARD_MODES:
+            raise ServiceConfigError(
+                f"unknown --shard-mode {shard_mode!r} "
+                f"(want {' | '.join(SHARD_MODES)})")
+        target_list = list(targets)
+        if not target_list:
+            raise ServiceConfigError("--remote endpoint list is empty")
+        seen: set[str] = set()
+        for t in target_list:
+            # Same wording as parse_endpoints (which guards the CLI
+            # path); re-checked here for direct library construction.
+            if t in seen:
+                raise ServiceConfigError(
+                    f"--remote lists endpoint {t!r} more than once")
+            seen.add(t)
+        if client_factory is None:
+            def client_factory(target: str) -> Any:
+                return RemoteFilterClient(target, registry=registry,
+                                          **client_kwargs)
+        self._mode = shard_mode
+        self._fingerprint = fingerprint
+        # The collector's pattern-set invocation, remembered by
+        # verify_patterns so an endpoint that was down at startup can
+        # be verified when it comes back (see _late_verify).
+        self._expected: "tuple[list[str], bool, list[str]] | None" = None
+        self._hedge_s = hedge_s
+        self._probe_interval_s = probe_interval_s
+        self._probe_timeout_s = probe_timeout_s
+        self._registry = registry
+        self._endpoints = [_Endpoint(t, client_factory(t))
+                           for t in target_list]
+        self._rr = 0  # round-robin cursor (per-batch rotation)
+        # Hash mode: endpoints and fingerprint are fixed for the life
+        # of the client, so the ring walk is a constant — computed once
+        # here, not per batch (demotion/exclusion happens later, in
+        # _route_order, against live health state).
+        self._hash_order: "list[int]" = (
+            self._ring_walk() if shard_mode == "hash" else [])
+        self._probe_task: "asyncio.Task | None" = None
+        # Created lazily inside the running loop (_ensure_prober): an
+        # Event constructed here would bind/require the thread's event
+        # loop on older Pythons, and this constructor legitimately runs
+        # before any loop exists (make_pipeline at CLI startup, tests).
+        self._probe_stop: "asyncio.Event | None" = None
+        self._m_hedges: Any = None
+        self._m_reroutes: Any = None
+        self._m_batches: Any = None
+        self._m_ready: Any = None
+        if registry is not None:
+            self._m_hedges = registry.family("klogs_shard_hedges_total")
+            self._m_reroutes = registry.family("klogs_shard_reroutes_total")
+            self._m_batches = registry.family("klogs_shard_batches_total")
+            self._m_ready = registry.family("klogs_shard_endpoint_ready")
+            for ep in self._endpoints:
+                self._m_ready.labels(endpoint=ep.target).set(1)
+
+    # -- routing ------------------------------------------------------
+
+    def _ring_walk(self) -> "list[int]":
+        """Endpoint indices in consistent-hash order for this client's
+        fingerprint: the ring (vnodes per endpoint) walked clockwise
+        from the fingerprint's position, first occurrence of each
+        endpoint kept."""
+        ring: "list[tuple[int, int]]" = []
+        for i, ep in enumerate(self._endpoints):
+            for v in range(_RING_VNODES):
+                digest = hashlib.sha256(
+                    f"{ep.target}#{v}".encode()).digest()
+                ring.append((int.from_bytes(digest[:8], "big"), i))
+        ring.sort()
+        key = int.from_bytes(hashlib.sha256(
+            self._fingerprint.encode()).digest()[:8], "big")
+        start = bisect.bisect_left(ring, (key, -1))
+        order: "list[int]" = []
+        seen: set[int] = set()
+        for j in range(len(ring)):
+            _, i = ring[(start + j) % len(ring)]
+            if i not in seen:
+                seen.add(i)
+                order.append(i)
+                if len(order) == len(self._endpoints):
+                    break
+        return order
+
+    def _natural_order(self) -> "list[_Endpoint]":
+        """Health-blind candidate order: the pure routing policy."""
+        if self._mode == "hash":
+            return [self._endpoints[i] for i in self._hash_order]
+        i = self._rr % len(self._endpoints)
+        self._rr += 1
+        return self._endpoints[i:] + self._endpoints[:i]
+
+    def _route_order(self) -> "list[_Endpoint]":
+        """Candidate order for one batch: available endpoints first (in
+        policy order), the unready/broken ones demoted to last resort —
+        tried only after every healthy sibling failed, which is what
+        makes --on-filter-error degrade fire only when the WHOLE fleet
+        is down. Skipping the natural owner is counted per endpoint and
+        reason. Unverified/quarantined endpoints are EXCLUDED, not
+        demoted: a server whose pattern set was never (or wrongly)
+        verified would silently mis-filter — worse than losing its
+        capacity."""
+        natural = [ep for ep in self._natural_order()
+                   if ep.verified and not ep.quarantined]
+        if not natural:
+            return []
+        # One health snapshot per routing decision (breaker.state can
+        # flip open->half-open on the clock mid-iteration) — the
+        # reroute reason derives from the SAME snapshot, or the label
+        # could misattribute a breaker trip to readiness drain.
+        state = {ep.target: ep.breaker.state for ep in natural}
+        avail = {ep.target: (ep.ready
+                             and state[ep.target] != BREAKER_OPEN)
+                 for ep in natural}
+        healthy = [ep for ep in natural if avail[ep.target]]
+        if not healthy:
+            return natural
+        for ep in natural:
+            if ep is healthy[0]:
+                break
+            reason = ("breaker" if state[ep.target] == BREAKER_OPEN
+                      else "unready")
+            if self._m_reroutes is not None:
+                self._m_reroutes.labels(endpoint=ep.target,
+                                        reason=reason).inc()
+        return healthy + [ep for ep in natural if not avail[ep.target]]
+
+    def _note_endpoint_down(self, ep: _Endpoint) -> None:
+        """A dispatch just failed terminally at ``ep``. If its breaker
+        has opened, the server is down — and downtime is the redeploy
+        window: whatever comes back on that address may serve a
+        DIFFERENT pattern set. Demote it to unverified so the prober
+        must re-run the handshake before it gets another batch (only
+        meaningful when verify_patterns armed the expected config)."""
+        if (self._expected is not None and ep.verified
+                and ep.breaker.state == BREAKER_OPEN):
+            ep.verified = False
+            if self._m_ready is not None:
+                self._m_ready.labels(endpoint=ep.target).set(0)
+            self._ensure_prober()
+
+    # -- dispatch -----------------------------------------------------
+
+    async def _dispatch(self,
+                        op: "Callable[[Any], Awaitable[Any]]",
+                        what: str) -> Any:
+        """Run one batch against the fleet: primary attempt, a hedge
+        against the next sibling every ``hedge_s`` of silence, failover
+        past terminal failures, first success wins. Losers are
+        cancelled and awaited before returning — no orphan tasks, no
+        double-counted result."""
+        queue = list(self._route_order())
+        tasks: "dict[asyncio.Task, _Endpoint]" = {}
+        errors: "list[str]" = []
+        pending: "set[asyncio.Task]" = set()
+        try:
+            while queue or pending:
+                if not pending:
+                    ep = queue.pop(0)
+                    t = asyncio.ensure_future(op(ep.client))
+                    tasks[t] = ep
+                    pending = {t}
+                timeout = (self._hedge_s
+                           if queue and self._hedge_s is not None else None)
+                done, pending = await asyncio.wait(
+                    pending, timeout=timeout,
+                    return_when=asyncio.FIRST_COMPLETED)
+                if not done:
+                    # Hedge deadline passed with the attempt(s) still in
+                    # flight: race one more sibling.
+                    ep = queue.pop(0)
+                    if self._m_hedges is not None:
+                        self._m_hedges.labels(endpoint=ep.target).inc()
+                    t = asyncio.ensure_future(op(ep.client))
+                    tasks[t] = ep
+                    pending.add(t)
+                    continue
+                winner: "asyncio.Task | None" = None
+                fatal: "BaseException | None" = None
+                for t in done:
+                    exc = t.exception() if not t.cancelled() else None
+                    if t.cancelled():
+                        continue
+                    if exc is None:
+                        winner = winner or t
+                    elif isinstance(exc, Unavailable):
+                        ep = tasks[t]
+                        errors.append(f"{ep.target}: {exc}")
+                        if self._m_reroutes is not None:
+                            reason = ("breaker"
+                                      if isinstance(exc, BreakerOpen)
+                                      else "error")
+                            self._m_reroutes.labels(
+                                endpoint=ep.target, reason=reason).inc()
+                        self._note_endpoint_down(ep)
+                    else:
+                        # Non-transient (pattern mismatch, bad request,
+                        # auth): the same bug on every endpoint —
+                        # propagate, do not failover.
+                        fatal = fatal or exc
+                if winner is not None:
+                    # A valid verdict beats a loser's error — even a
+                    # non-transient one (a hedge sibling's pattern
+                    # mismatch / auth failure is per-endpoint in a
+                    # heterogeneous fleet; the next dispatch routed to
+                    # it will surface it on its own).
+                    if self._m_batches is not None:
+                        self._m_batches.labels(
+                            endpoint=tasks[winner].target).inc()
+                    return await winner  # done: resolves immediately
+                if fatal is not None:
+                    raise fatal
+            raise Unavailable(
+                f"all {len(self._endpoints)} filterd endpoint(s) "
+                f"unavailable for {what}: "
+                + ("; ".join(errors)
+                   or "no routable endpoint (unverified or quarantined "
+                      "pattern sets)"))
+        finally:
+            live = [t for t in tasks if not t.done()]
+            for t in live:
+                t.cancel()
+            for t in live:
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass  # loser teardown; its outcome is irrelevant
+
+    # -- client API ---------------------------------------------------
+
+    async def hello(self) -> dict:
+        return await self._dispatch(lambda c: c.hello(), "hello")
+
+    async def match(self, lines: "list[bytes]") -> "list[bool]":
+        result = await self._dispatch(lambda c: c.match(lines), "match")
+        return result
+
+    async def match_framed(self, payload: bytes, offsets: Any) -> Any:
+        return await self._dispatch(
+            lambda c: c.match_framed(payload, offsets), "match_framed")
+
+    async def verify_patterns(self, patterns: "list[str]",
+                              ignore_case: bool = False,
+                              exclude: "list[str] | None" = None) -> None:
+        """Startup handshake against EVERY endpoint: any reachable
+        server with a drifted pattern set fails the run (a mismatched
+        shard would silently mis-filter every batch routed to it); an
+        unreachable server is warned about, excluded from routing, and
+        re-verified by the background prober when it comes back — a
+        partial fleet must not block startup, surviving one is the
+        point of this tier. All-down is a hard error. Hello responses
+        also teach the prober where each endpoint's /readyz lives."""
+        self._expected = (list(patterns), bool(ignore_case),
+                          list(exclude or []))
+        # Concurrent: each hello still gets its client's full retry
+        # budget (a startup blip deserves patience), but the fleet pays
+        # the MAX of the towers, not the sum — one black-holing node
+        # costs what a single-endpoint setup would, never minutes per
+        # dead endpoint.
+        infos = await asyncio.gather(
+            *[ep.client.hello() for ep in self._endpoints],
+            return_exceptions=True)
+        down: "list[str]" = []
+        reachable = 0
+        for ep, info in zip(self._endpoints, infos):
+            if isinstance(info, Unavailable):
+                down.append(f"{ep.target}: {info}")
+                ep.verified = False
+                if self._m_ready is not None:
+                    # The gauge promises "0 = draining or unreachable";
+                    # an endpoint excluded from routing must not scrape
+                    # as ready.
+                    self._m_ready.labels(endpoint=ep.target).set(0)
+                term.warning(
+                    "filterd %s unavailable at startup (%s); continuing "
+                    "with the rest of the fleet (it rejoins once its "
+                    "pattern set verifies)", ep.target, info)
+                continue
+            if isinstance(info, BaseException):
+                # Non-transient (config/auth bug): the run cannot
+                # sensibly start — propagate the first one.
+                raise info
+            reachable += 1
+            check_server_config(ep.target, info, patterns, ignore_case,
+                                exclude)
+            self._learn_readyz(ep, info)
+        if not reachable:
+            raise Unavailable(
+                "no filterd endpoint reachable at startup: "
+                + "; ".join(down))
+        self._ensure_prober()
+
+    async def aclose(self) -> None:
+        if self._probe_stop is not None:
+            self._probe_stop.set()
+        if self._probe_task is not None:
+            self._probe_task.cancel()
+            try:
+                await self._probe_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                # A prober that died on its own error must not abort
+                # teardown — the per-endpoint channels still need
+                # closing below.
+                pass
+            self._probe_task = None
+        await asyncio.gather(
+            *[ep.client.aclose() for ep in self._endpoints],
+            return_exceptions=True)
+
+    def close(self) -> None:
+        if self._probe_stop is not None:
+            self._probe_stop.set()
+        if self._probe_task is not None:
+            self._probe_task.cancel()
+            self._probe_task = None
+        for ep in self._endpoints:
+            ep.client.close()
+
+    # -- readiness drain ----------------------------------------------
+
+    _LOOPBACK = frozenset({"127.0.0.1", "localhost", "::1"})
+
+    def _learn_readyz(self, ep: _Endpoint, info: dict) -> None:
+        port = info.get("metrics_port")
+        if not port or ep.target.startswith("unix:"):
+            return  # no sidecar advertised: breakers alone guard it
+        grpc_host = ep.target.rpartition(":")[0]
+        if grpc_host.startswith("[") and grpc_host.endswith("]"):
+            grpc_host = grpc_host[1:-1]
+        # Where is the advertised sidecar actually reachable? Older
+        # servers omit metrics_host; assume the conservative loopback
+        # default they ship with.
+        mhost = str(info.get("metrics_host") or "127.0.0.1")
+        if mhost in ("0.0.0.0", "::"):
+            host = grpc_host  # wildcard bind: same host as the RPCs
+        elif mhost in self._LOOPBACK:
+            if grpc_host not in self._LOOPBACK:
+                # Loopback-bound sidecar on a REMOTE node: probing
+                # grpc_host:port would hit nothing (or a stranger) and
+                # a refused probe would wrongly demote a healthy
+                # server. Skip — breakers alone guard this endpoint.
+                return
+            host = grpc_host
+        else:
+            host = mhost  # explicit routable bind address
+        ep.readyz = (host, int(port))
+
+    def _ensure_prober(self) -> None:
+        if (self._probe_task is None
+                and (any(ep.readyz for ep in self._endpoints)
+                     or any(not ep.verified for ep in self._endpoints))):
+            if self._probe_stop is None:
+                self._probe_stop = asyncio.Event()
+            self._probe_task = asyncio.get_running_loop().create_task(
+                self._probe_loop())
+
+    def _set_ready(self, ep: _Endpoint, ready: bool) -> None:
+        if ready != ep.ready:
+            if ready:
+                term.info("filterd %s is ready again; rejoining the "
+                          "rotation", ep.target)
+            else:
+                term.warning("filterd %s is draining (/readyz not ok); "
+                             "routing around it", ep.target)
+        ep.ready = ready
+        if self._m_ready is not None:
+            self._m_ready.labels(endpoint=ep.target).set(1 if ready else 0)
+
+    async def _probe_loop(self) -> None:
+        """Poll each endpoint's /readyz on a fixed cadence, and retry
+        the startup handshake for endpoints that were down when
+        verify_patterns ran. Not a retry loop in the policy sense:
+        outcomes only flip routing state, and the wait is the
+        stop-aware poller idiom (wait_for on the stop event), so a
+        teardown mid-interval returns immediately."""
+        stop = self._probe_stop
+        assert stop is not None  # created by _ensure_prober
+        while not stop.is_set():
+            for ep in self._endpoints:
+                if stop.is_set() or ep.quarantined:
+                    continue
+                try:
+                    if not ep.verified:
+                        await self._late_verify(ep)
+                    elif ep.readyz is not None:
+                        self._set_ready(ep, await self._probe_ready(ep))
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001
+                    # A surprise here (e.g. a deleted token file turning
+                    # hello into ServiceConfigError) must not kill the
+                    # prober silently — drain and late-verify for the
+                    # whole fleet would stop for the rest of the run.
+                    term.warning("filterd %s health probe failed: %s",
+                                 ep.target, e)
+            try:
+                await asyncio.wait_for(stop.wait(),
+                                       timeout=self._probe_interval_s)
+            except asyncio.TimeoutError:
+                pass
+
+    async def _late_verify(self, ep: _Endpoint) -> None:
+        """An endpoint that was down during verify_patterns came (or
+        may have come) back: verify its pattern set before it gets a
+        single batch. Matching set -> it joins the rotation (and its
+        /readyz is learned); a DRIFTED set -> permanent quarantine with
+        one loud error — a redeployed shard serving different patterns
+        must never silently mis-filter its share of the stream."""
+        assert self._expected is not None  # set by verify_patterns
+        patterns, ignore_case, exclude = self._expected
+        try:
+            # Bounded, no patience: the inner client's full retry tower
+            # (minutes against a black-holing node) would stall this
+            # sequential probe loop — and with it /readyz drain for
+            # every HEALTHY sibling. A handshake that cannot answer
+            # within the probe budget is simply still down.
+            info = await asyncio.wait_for(ep.client.hello(),
+                                          timeout=self._probe_timeout_s)
+        except (Unavailable, asyncio.TimeoutError):
+            return  # still down; try again next probe cycle
+        try:
+            check_server_config(ep.target, info, patterns, ignore_case,
+                                exclude)
+        except PatternMismatch as e:
+            ep.quarantined = True
+            if self._m_ready is not None:
+                self._m_ready.labels(endpoint=ep.target).set(0)
+            term.error(
+                "filterd %s came back with a DRIFTED pattern set; "
+                "quarantining it for the rest of the run (%s)",
+                ep.target, e)
+            return
+        ep.verified = True
+        if self._m_ready is not None:
+            self._m_ready.labels(endpoint=ep.target).set(1 if ep.ready
+                                                         else 0)
+        self._learn_readyz(ep, info)
+        term.info("filterd %s verified; joining the rotation", ep.target)
+
+    async def _probe_ready(self, ep: _Endpoint) -> bool:
+        """One GET /readyz. 200 = ready; a 503 (draining/cold), refused
+        connection, or timeout all mean 'do not route here' — exactly
+        the kubelet's readiness semantics."""
+        assert ep.readyz is not None
+        host, port = ep.readyz
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(host, port),
+                self._probe_timeout_s)
+        except (OSError, asyncio.TimeoutError):
+            return False
+        try:
+            writer.write(b"GET /readyz HTTP/1.1\r\nHost: " +
+                         host.encode() + b"\r\nConnection: close\r\n\r\n")
+            await writer.drain()
+            status = await asyncio.wait_for(reader.readline(),
+                                            self._probe_timeout_s)
+            parts = status.split()
+            return len(parts) >= 2 and parts[1] == b"200"
+        except (OSError, asyncio.TimeoutError):
+            return False
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (OSError, asyncio.TimeoutError):
+                pass

@@ -80,15 +80,18 @@ def test_rpc_flake_retried_transparently_with_metrics():
                                     registry=registry)
         try:
             FAULTS.arm("rpc.match", times=2, exc=InjectedFault("flake"))
-            return await client.match(lines)
+            return await client.match(lines), port
         finally:
             await client.aclose()
             await server.stop()
 
-    got = run(asyncio.wait_for(scenario(), timeout=30))
+    got, port = run(asyncio.wait_for(scenario(), timeout=30))
     assert got == [True, False, True]
     text = obs.render(registry)
-    assert 'klogs_retry_attempts_total{site="rpc"} 2' in text, text
+    # The retry site carries the endpoint identity — one series per
+    # server of a sharded fleet (docs/OBSERVABILITY.md).
+    assert (f'klogs_retry_attempts_total{{site="rpc@127.0.0.1:{port}"}} 2'
+            in text), text
     assert 'klogs_faults_injected_total{point="rpc.match"} 2' in text
 
 
