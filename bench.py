@@ -77,6 +77,135 @@ def make_lines(n: int) -> list[bytes]:
     return out
 
 
+# -- thousand-pattern K-axis (BENCH_K.json) ---------------------------
+#
+# Production alerting sets run thousands of patterns (ROADMAP item 2);
+# `python bench.py --k-axis` measures K as a first-class axis: the
+# factor-index engine (filters/indexed.py) vs the scan-all-K
+# configuration of the SAME compiled groups — same tables, same
+# engines, only the candidate narrowing differs — on the needle-finding
+# corpus. Per-K rows report lines/s, lines/s*pattern (work units:
+# pattern verdicts per second), and the candidate-narrowing ratio.
+
+BENCH_K_DEFAULT = (32, 256, 1024, 4096)
+
+
+def make_patterns(k: int) -> "list[str]":
+    """K needle-finding patterns: the 32 north-star patterns plus
+    minted alerting-rule families (distinct literals, realistic
+    shapes — service/tenant/job ids nothing in the corpus matches).
+    Deterministic; make_patterns(32) == PATTERNS."""
+    out = list(PATTERNS)
+    fam = [
+        lambda i: f"svc-{i:04d} unreachable",
+        lambda i: rf"errcode={i:05d}\b",
+        lambda i: f"tenant-{i:04d}.*quota exceeded",
+        lambda i: rf"CRIT{i:05d}",
+        lambda i: rf"trace=[0-9a-f]+ span={i:06d}",
+        lambda i: f"deploy/rel-{i:04d} failed",
+        lambda i: rf"(?:FATAL|PANIC) job-{i:05d}",
+        lambda i: rf"user=u{i:06d} denied",
+    ]
+    i = 0
+    while len(out) < k:
+        out.append(fam[i % len(fam)](i))
+        i += 1
+    return out[:k]
+
+
+def bench_k_axis(ks=None, n_lines: "int | None" = None,
+                 repeats: "int | None" = None) -> dict:
+    """One row per K (module comment above). Returns the BENCH_K
+    payload; env knobs KLOGS_BENCH_K (comma-separated Ks),
+    KLOGS_BENCH_K_LINES, KLOGS_BENCH_REPEATS shrink smoke runs."""
+    import numpy as np
+
+    from klogs_tpu.filters.base import frame_lines
+    from klogs_tpu.filters.cpu import best_host_filter
+    from klogs_tpu.filters.indexed import IndexedFilter
+
+    if ks is None:
+        env = os.environ.get("KLOGS_BENCH_K", "")
+        ks = tuple(int(x) for x in env.split(",") if x) or BENCH_K_DEFAULT
+    n_lines = n_lines or int(os.environ.get("KLOGS_BENCH_K_LINES", "100000"))
+    repeats = repeats or int(os.environ.get("KLOGS_BENCH_REPEATS", "3"))
+    lines = [ln.rstrip(b"\n") for ln in make_lines(n_lines)]
+    payload, offsets, _ = frame_lines(lines)
+    offsets = np.asarray(offsets, dtype=np.int32)
+
+    def rate(filt) -> "tuple[float, int]":
+        best, matched = 0.0, 0
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            v = np.asarray(filt.fetch_framed(
+                filt.dispatch_framed(payload, offsets)))
+            best = max(best, len(lines) / (time.perf_counter() - t0))
+            matched = int(v.sum())
+        return best, matched
+
+    rows = []
+    for k in ks:
+        pats = make_patterns(k)
+        t0 = time.perf_counter()
+        filt = IndexedFilter(pats)
+        build_s = time.perf_counter() - t0
+        idx_lps, idx_matched = rate(filt)
+        ratio = filt.narrowing_ratio
+        # Scan-all comparator: SAME groups/tables, narrowing off.
+        filt.narrow = False
+        all_lps, all_matched = rate(filt)
+        filt.narrow = True
+        assert idx_matched == all_matched, (
+            f"K={k}: indexed verdicts diverged "
+            f"({idx_matched} vs {all_matched})")
+        # The production auto path (best_host_filter): below
+        # INDEX_MIN_K this is the unchanged single-DFA engine — the
+        # K=32 row IS the no-regression check against the current
+        # bench path. When auto provably resolves to the indexed
+        # engine (no ambient overrides, K past the threshold), reuse
+        # the measurement above instead of rebuilding an identical
+        # IndexedFilter — at K=4096 that second build alone costs
+        # ~60s.
+        from klogs_tpu.filters.cpu import INDEX_MIN_K
+
+        auto_is_indexed = (
+            os.environ.get("KLOGS_CPU_ENGINE", "auto") == "auto"
+            and "KLOGS_INDEX_MIN_K" not in os.environ
+            and k >= INDEX_MIN_K)
+        if auto_is_indexed:
+            auto_kind, auto_lps = "indexed", idx_lps
+        else:
+            auto, auto_kind = best_host_filter(pats)
+            auto_lps, _ = rate(auto)
+        rows.append({
+            "k": k,
+            "n_lines": len(lines),
+            "indexed_lps": round(idx_lps, 1),
+            "scan_all_lps": round(all_lps, 1),
+            "speedup_vs_scan_all": round(idx_lps / all_lps, 2),
+            "lps_pattern": round(idx_lps * k, 1),
+            "narrowing_ratio": round(ratio, 5),
+            "auto_engine": auto_kind,
+            "auto_lps": round(auto_lps, 1),
+            "n_groups": len(filt.groups),
+            "engine_kinds": filt.engine_kinds,
+            "n_factors": filt.index.n_factors,
+            "build_s": round(build_s, 2),
+            "matched": idx_matched,
+        })
+        print(f"bench: K={k} indexed={idx_lps:,.0f} l/s "
+              f"scan-all={all_lps:,.0f} l/s "
+              f"({idx_lps / all_lps:.1f}x) narrowing={ratio:.4f} "
+              f"auto={auto_kind}@{auto_lps:,.0f}", file=sys.stderr)
+    return {
+        "metric": "K-axis: lines/sec filtered vs pattern-set size "
+                  "(factor-index engine vs scan-all-K, same groups)",
+        "unit": "lines/sec",
+        "corpus": "needle-finding synthetic pod logs, ~128B lines",
+        "rows": rows,
+    }
+
+
 def cpu_lps(lines, repeats: int) -> float:
     filt = RegexFilter(PATTERNS)
     best = 0.0
@@ -323,6 +452,15 @@ def _device_subprocess(timeout_s: float):
 
 
 def main() -> None:
+    if "--k-axis" in sys.argv[1:]:
+        payload = bench_k_axis()
+        out_path = os.environ.get("KLOGS_BENCH_K_OUT") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_K.json")
+        with open(out_path, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+        print(json.dumps(payload))
+        return
     n_lines = int(os.environ.get("KLOGS_BENCH_LINES", "300000"))
     n_cpu = int(os.environ.get("KLOGS_BENCH_CPU_LINES", "30000"))
     repeats = int(os.environ.get("KLOGS_BENCH_REPEATS", "3"))

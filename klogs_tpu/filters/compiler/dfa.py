@@ -24,6 +24,7 @@ TPU multiple is measured against (BASELINE.md row 3).
 
 from collections import deque
 from dataclasses import dataclass
+from typing import Callable
 
 import numpy as np
 
@@ -175,29 +176,113 @@ def _cache_path(patterns: "list[str]", ignore_case: bool,
     return os.path.join(cache_dir(), f"dfa-{key}.npz")
 
 
+# On-disk table-cache size cap (MiB): a 4k-pattern grouped set writes
+# ~100 tables, and long-lived hosts cycling many tenant pattern sets
+# would otherwise grow ~/.cache without bound. Exceeding the cap
+# evicts least-recently-USED tables (mtime, refreshed on every cache
+# hit), so the hot sets of a multi-set host stay resident.
+DEFAULT_CACHE_MB = 512
+
+
+def _cache_cap_bytes() -> int:
+    import math
+    import os
+
+    try:
+        mb = float(os.environ.get("KLOGS_DFA_CACHE_MB",
+                                  str(DEFAULT_CACHE_MB)))
+    except ValueError:
+        return DEFAULT_CACHE_MB * 1048576
+    if not math.isfinite(mb) or mb <= 0:
+        # A negative/zero/nan cap would evict EVERY table on every
+        # write (warm starts silently recompile the world); treat it
+        # as the misconfiguration it is, like _env_positive_float.
+        return DEFAULT_CACHE_MB * 1048576
+    return int(mb * 1048576)
+
+
+def _evict_lru(keep: str, cap_bytes: "int | None" = None) -> int:
+    """Shrink the DFA table cache below the size cap, oldest-touched
+    first; ``keep`` (the just-written table) is never evicted. Returns
+    the number of files removed. All failures are silent — the cache
+    is an optimization, never a correctness dependency."""
+    import os
+
+    from klogs_tpu.utils.cache import cache_dir
+
+    cap = _cache_cap_bytes() if cap_bytes is None else cap_bytes
+    removed = 0
+    try:
+        d = cache_dir()
+        entries = []
+        total = 0
+        for name in os.listdir(d):
+            if not (name.startswith("dfa-") and name.endswith(".npz")):
+                continue
+            p = os.path.join(d, name)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            entries.append((st.st_mtime, st.st_size, p))
+            total += st.st_size
+        entries.sort()
+        for _, size, p in entries:
+            if total <= cap:
+                break
+            if os.path.abspath(p) == os.path.abspath(keep):
+                continue
+            try:
+                os.remove(p)
+                total -= size
+                removed += 1
+            except OSError:
+                pass
+    except OSError:
+        pass
+    return removed
+
+
 def build_dfa_cached(patterns: list[str], ignore_case: bool = False,
-                     max_states: int = DEFAULT_MAX_STATES
+                     max_states: int = DEFAULT_MAX_STATES,
+                     on_event: "Callable[[str], None] | None" = None
                      ) -> "DFATables | None":
-    """build_dfa with a disk cache (~/.cache/klogs-tpu) keyed by the
-    pattern set: the 32-pattern north-star set determinizes in ~1.6s,
-    which would otherwise be paid at every CLI start. Cache failures
-    (no home, corrupt file, race) silently rebuild."""
+    """build_dfa with an LRU disk cache (~/.cache/klogs-tpu) keyed by
+    the pattern set: the 32-pattern north-star set determinizes in
+    ~1.6s, which would otherwise be paid at every CLI start — and a
+    grouped 4k-pattern set pays it ~100x, so warm starts matter even
+    more there. Cache failures (no home, corrupt file, race) silently
+    rebuild. A hit refreshes the file's mtime (the LRU clock); writes
+    that push the cache past KLOGS_DFA_CACHE_MB evict least-recently-
+    used tables. ``on_event`` (observability hook) receives "hit",
+    "miss", and one "evict" per removed file."""
     import os
 
     import numpy as _np
 
     from klogs_tpu.filters.compiler.glushkov import compile_patterns
 
+    def event(kind: str) -> None:
+        if on_event is not None:
+            on_event(kind)
+
     path = _cache_path(patterns, ignore_case, max_states)
     try:
         with _np.load(path) as z:
-            return DFATables(
+            t = DFATables(
                 table=z["table"], accept=z["accept"],
                 byte_class=z["byte_class"], n_classes=int(z["n_classes"]),
                 start=int(z["start"]), end_class=int(z["end_class"]),
                 match_all=bool(z["match_all"]))
+        try:
+            os.utime(path)  # LRU touch
+        except OSError:
+            pass
+        event("hit")
+        return t
     except Exception:
         pass
+    event("miss")
     prog = compile_patterns(patterns, ignore_case=ignore_case)
     t = build_dfa(prog, max_states)
     if t is None:
@@ -211,6 +296,8 @@ def build_dfa_cached(patterns: list[str], ignore_case: bool = False,
                       start=t.start, end_class=t.end_class,
                       match_all=t.match_all)
         os.replace(tmp, path)
+        for _ in range(_evict_lru(keep=path)):
+            event("evict")
     except Exception:
         pass
     return t

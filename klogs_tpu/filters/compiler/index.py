@@ -1,0 +1,365 @@
+"""The shared multi-literal index: one sweep narrows every line to its
+candidate pattern groups.
+
+This is the host half of thousand-pattern mode ("Regular Expression
+Indexing for Log Analysis", PAPERS.md): every guarded pattern
+contributes an OR-set of mandatory literals (factors.guard_factors);
+the index dedupes them across the set and sweeps a framed batch ONCE,
+memmem-style but vectorized. A line is a candidate for group g iff
+some member pattern's guard literal occurs inside it (or g is an
+always-candidate group). False positives cost a redundant group scan;
+false negatives are impossible — every guard is a NECESSARY condition,
+so the downstream DFA/NFA engines see every line they could ever
+match.
+
+Sweep design. The hot loop must cost a FIXED small number of
+vectorized passes over the payload, independent of K — everything
+per-factor happens only at surviving positions, which a needle corpus
+keeps rare. Three ideas carry that:
+
+- **One rolling code array.** A single big-endian 4-byte code per
+  payload position (built zero-copy from four ``frombuffer`` views —
+  no per-position Python, ~2 passes of memory traffic). Wider probes
+  derive from it instead of paying uint64 sweeps: a factor >= 8 bytes
+  probes as a CONJUNCTION of two 4-byte half-window codes at distance
+  4 — ``bloom_a[f[q]] & bloom_b[f[q+4]]`` reuses the same fold array
+  at two offsets.
+- **Rarity-anchored windows.** Each factor's probe window sits at its
+  RAREST position (digit/punctuation-heavy, by a static log-text
+  prior), not position 0 — ``latency=49`` probes on ``y=49``, not on
+  the every-line prefix ``latenc…`` — so survivors track true
+  occurrences, and minted rule families (``job-00001``, ``job-00002``,
+  ...) spread across distinct codes instead of funneling through one
+  shared-prefix bucket with a per-hit verify fan-out of hundreds.
+- **Staged bloom gates + sorted-run extraction.** Stage 1 is ONE
+  gather into a 64 KiB union bloom (all probe codes of every tier) +
+  ONE nonzero — the only per-position work besides building the codes.
+  Survivors (rare) re-probe per-tier blooms, pay a searchsorted into
+  the exact code tables, and group by code via ONE argsort, sliced as
+  runs — Python iteration touches only DISTINCT PRESENT codes, never
+  rescans the hit array per code. The bloom index is the high uint16
+  half of a Fibonacci-multiply fold, read as a zero-copy view of the
+  product array.
+
+Factors of 3 bytes (the minimum factors.MIN_FACTOR_LEN) have no 4-byte
+window; they enter the short tier as all 256 one-byte extensions, so
+the same code path covers them (the 4th byte is beyond the factor and
+is verified as don't-care; it may even cross a line boundary — only
+the factor's own bytes must sit inside the line). Padding the payload
+with zeros similarly only ADDS candidate positions; every survivor is
+verified exactly (full factor bytes + line bounds), so the sweep
+over-approximates but never misses.
+
+Cost shape: the sweep is O(payload) with small constants regardless of
+K; group scans are O(candidate lines x candidate groups). On a
+needle-finding corpus (the log-filter regime) almost every line has
+zero candidate groups, so throughput approaches the sweep rate while
+a scan-all-K configuration pays K/32 automata per line — the bench.py
+K-axis (BENCH_K.json) quantifies exactly this gap.
+"""
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from klogs_tpu.filters.compiler.groups import GroupPlan, PatternInfo
+
+# Minimum factor width the sweep can probe: matches
+# factors.MIN_FACTOR_LEN (every guard literal is at least 3 bytes).
+GRAM = 3
+# Probe window widths: the wide tier (two chained 4-byte codes) for
+# factors that fit one, the narrow tier (one code) for the rest.
+WIDE = 8
+NARROW = 4
+# Bloom fold width: 2^16 bytes = 64 KiB per table, cache-resident,
+# ~1.5% load even at K=4096 (~one anchored code per factor) — and the
+# fold is the HIGH uint16 half of a Fibonacci multiply, readable as a
+# zero-copy strided view of the product array (no shift pass).
+_BLOOM_BITS = 16
+_FIB = 2654435761
+_FIB32 = np.uint32(_FIB)
+
+# Static rarity prior on log-like text for window anchoring, derived
+# from the ONE scoring table (factors._byte_rarity: smaller = rarer).
+# _anchor argmaxes a window sum, so negate — the argmax of -w is the
+# window with the smallest (rarest) factors-score. One source of
+# truth: a tweak to the factor prior re-anchors the sweep with it.
+from klogs_tpu.filters.compiler.factors import _byte_rarity
+
+_BYTE_RARITY = np.asarray([-_byte_rarity(b) for b in range(256)],
+                          dtype=np.float64)
+
+
+def _anchor(f: bytes, width: int) -> int:
+    """Offset of the rarest ``width``-byte window of ``f`` (the probe
+    code position — see module docstring)."""
+    w = _BYTE_RARITY[np.frombuffer(f, dtype=np.uint8)]
+    if len(f) <= width:
+        return 0
+    sums = np.convolve(w, np.ones(width), mode="valid")
+    return int(np.argmax(sums))
+
+
+def _fold1(code: int) -> int:
+    """Bloom-table index of one 4-byte code (build-time scalar twin of
+    the sweep's vectorized multiply-fold)."""
+    return ((code * _FIB) & 0xFFFFFFFF) >> (32 - _BLOOM_BITS)
+
+
+def _fold(codes: np.ndarray) -> np.ndarray:
+    """Bloom index per code: high half of the wrapping Fibonacci
+    product, read as a zero-copy strided view on little-endian hosts
+    (no shift pass)."""
+    prod = codes * _FIB32
+    if _LITTLE:
+        return prod.view(np.uint16)[1::2]
+    return (prod >> np.uint32(16)).astype(np.uint16)
+
+
+_LITTLE = np.little_endian
+
+
+def _code_at(f: bytes, at: int) -> int:
+    """The sweep's 4-byte code of factor ``f`` at offset ``at`` —
+    NATIVE byte order, matching the zero-copy payload views."""
+    return int.from_bytes(f[at:at + 4].ljust(4, b"\0"),
+                          "little" if _LITTLE else "big")
+
+
+@dataclass
+class SweepStats:
+    """Narrowing outcome of one swept batch (observability)."""
+
+    lines: int = 0
+    groups: int = 0
+    candidate_cells: int = 0  # candidate (line, group) scan units
+    candidate_lines: int = 0  # lines with at least one candidate group
+
+    @property
+    def narrowing_ratio(self) -> float:
+        """Fraction of (line, group) scans the index could NOT rule
+        out: 1.0 = no narrowing (scan everything), 0.0 = nothing to
+        scan. Lower is better."""
+        total = self.lines * self.groups
+        return (self.candidate_cells / total) if total else 1.0
+
+
+class _Tier:
+    """Exact-code probe tables for one tier: entries are (code, fid,
+    anchor) sorted by code, bucketed so entries sharing a code form
+    one contiguous run."""
+
+    def __init__(self, entries: "list[tuple[int, int, int]]") -> None:
+        entries.sort()
+        codes = np.asarray([e[0] for e in entries], dtype=np.uint64)
+        self.fid = np.asarray([e[1] for e in entries], dtype=np.int64)
+        self.anchor = np.asarray([e[2] for e in entries], dtype=np.int64)
+        self.codes, starts = np.unique(codes, return_index=True)
+        self.bucket_start = np.append(starts, len(entries))
+
+
+class FactorIndex:
+    """Compiled sweep tables for one analyzed, grouped pattern set."""
+
+    def __init__(self, infos: "list[PatternInfo]", plan: GroupPlan) -> None:
+        self.n_patterns = len(infos)
+        self.n_groups = plan.n_groups
+        self.always_groups = np.asarray(plan.always_groups, dtype=np.int64)
+        # Dedupe guard literals across the set; remember, per literal,
+        # which patterns it guards (for the per-pattern matrix) and
+        # which groups those patterns live in (for the group sweep).
+        by_factor: "dict[bytes, list[int]]" = {}
+        for info in infos:
+            for f in info.guard or ():
+                by_factor.setdefault(f, []).append(info.index)
+        self.factors: "list[bytes]" = sorted(by_factor)
+        self.pattern_ids: "list[np.ndarray]" = [
+            np.asarray(by_factor[f], dtype=np.int64) for f in self.factors]
+        self.group_ids: "list[np.ndarray]" = [
+            np.unique(plan.group_of[pids]).astype(np.int64)
+            for pids in self.pattern_ids]
+        self._factor_arrs = [
+            np.frombuffer(f, dtype=np.uint8) for f in self.factors]
+
+        # Stage-1 union bloom (one gather gates everything) + per-tier
+        # discrimination blooms consulted only at surviving positions.
+        self._bloom_u = np.zeros(1 << _BLOOM_BITS, dtype=np.uint8)
+        self._bloom_a = np.zeros(1 << _BLOOM_BITS, dtype=np.uint8)
+        self._bloom_b = np.zeros(1 << _BLOOM_BITS, dtype=np.uint8)
+        self._bloom_n = np.zeros(1 << _BLOOM_BITS, dtype=np.uint8)
+        wide_entries: "list[tuple[int, int, int]]" = []
+        narrow_entries: "list[tuple[int, int, int]]" = []
+        for fi, f in enumerate(self.factors):
+            if len(f) >= WIDE:
+                at = _anchor(f, WIDE)
+                hi, lo = _code_at(f, at), _code_at(f, at + 4)
+                self._bloom_u[_fold1(hi)] = 1
+                self._bloom_a[_fold1(hi)] = 1
+                self._bloom_b[_fold1(lo)] = 1
+                wide_entries.append(((hi << 32) | lo, fi, at))
+            elif len(f) >= NARROW:
+                at = _anchor(f, NARROW)
+                code = _code_at(f, at)
+                self._bloom_u[_fold1(code)] = 1
+                self._bloom_n[_fold1(code)] = 1
+                narrow_entries.append((code, fi, at))
+            else:
+                # 3-byte factor: all 256 one-byte extensions (module
+                # docstring) — the 4th byte is don't-care at verify.
+                for ext in range(256):
+                    code = _code_at(f + bytes([ext]), 0)
+                    self._bloom_u[_fold1(code)] = 1
+                    self._bloom_n[_fold1(code)] = 1
+                    narrow_entries.append((code, fi, 0))
+        self._wide = _Tier(wide_entries) if wide_entries else None
+        self._narrow = _Tier(narrow_entries) if narrow_entries else None
+        self.last_stats = SweepStats()
+
+    @property
+    def n_factors(self) -> int:
+        return len(self.factors)
+
+    # -- the sweep ----------------------------------------------------
+
+    def _stage1(self, buf: bytes, n: int) -> np.ndarray:
+        """Surviving positions of the stage-1 union-bloom gate, lane
+        by lane: each byte offset k in 0..3 yields a zero-copy 4-byte
+        view of the padded payload, whose fold gathers straight into
+        the interleaved hit mask — the full per-position code array is
+        never materialized (survivors recompute their exact codes from
+        the raw bytes, O(survivors))."""
+        g = np.empty(n, dtype=np.uint8)
+        for k in range(4):
+            lane = g[k::4]
+            v = np.frombuffer(buf, dtype="<u4" if _LITTLE else ">u4",
+                              offset=k, count=(len(buf) - k) // 4)
+            lane[:] = self._bloom_u[_fold(v)[:len(lane)]]
+        return np.nonzero(g)[0]
+
+    @staticmethod
+    def _codes_at(buf_arr: np.ndarray, s: np.ndarray) -> np.ndarray:
+        """Native-endian 4-byte codes of positions ``s`` (vectorized
+        over survivors; ``buf_arr`` is the padded payload bytes)."""
+        b0 = buf_arr[s].astype(np.uint32)
+        b1 = buf_arr[s + 1].astype(np.uint32)
+        b2 = buf_arr[s + 2].astype(np.uint32)
+        b3 = buf_arr[s + 3].astype(np.uint32)
+        if _LITTLE:
+            return (b0 | (b1 << np.uint32(8)) | (b2 << np.uint32(16))
+                    | (b3 << np.uint32(24)))
+        return ((b0 << np.uint32(24)) | (b1 << np.uint32(16))
+                | (b2 << np.uint32(8)) | b3)
+
+    def _hits(self, payload: bytes,
+              offsets: np.ndarray) -> "list[tuple[int, np.ndarray]]":
+        """(factor_id, line_ids) for every factor occurring inside a
+        line of the framed batch. A fixed number of vectorized passes
+        over the payload; Python iteration only over DISTINCT PRESENT
+        codes (rare on a needle corpus) and their bucket factors."""
+        arr = np.frombuffer(payload, dtype=np.uint8)
+        n = len(arr)
+        out: "list[tuple[int, np.ndarray]]" = []
+        if n < GRAM or (self._wide is None and self._narrow is None):
+            return out
+        buf = payload + bytes(8)
+        buf_arr = np.frombuffer(buf, dtype=np.uint8)
+        # Stage 1: one union-bloom gather + one nonzero over the whole
+        # payload; everything tier-specific runs on survivors only.
+        s = self._stage1(buf, n)
+        if not len(s):
+            return out
+        cs = self._codes_at(buf_arr, s)
+        fs = _fold(cs)
+        if self._wide is not None:
+            wm = self._bloom_a[fs].astype(bool)
+            ws = s[wm]
+            if len(ws):
+                lo = self._codes_at(buf_arr, ws + NARROW)
+                bm = self._bloom_b[_fold(lo)].astype(bool)
+                ws, lo = ws[bm], lo[bm]
+                if len(ws):
+                    exact = ((cs[wm][bm].astype(np.uint64)
+                              << np.uint64(32)) | lo)
+                    self._emit(self._wide, ws, exact, arr, n, offsets,
+                               out)
+        if self._narrow is not None:
+            nm = self._bloom_n[fs].astype(bool)
+            ns = s[nm]
+            if len(ns):
+                self._emit(self._narrow, ns, cs[nm].astype(np.uint64),
+                           arr, n, offsets, out)
+        return out
+
+    def _emit(self, tier: _Tier, s: np.ndarray, exact: np.ndarray,
+              arr: np.ndarray, n: int, offsets: np.ndarray,
+              out: "list[tuple[int, np.ndarray]]") -> None:
+        """Resolve bloom survivors ``s`` (exact codes ``exact``)
+        against one tier's tables and append verified (fid, lines)."""
+        slot = np.searchsorted(tier.codes, exact)
+        slot_c = np.minimum(slot, len(tier.codes) - 1)
+        ok = tier.codes[slot_c] == exact
+        pos, kid = s[ok], slot_c[ok]
+        if not len(pos):
+            return
+        # Sorted-run extraction: one stable argsort groups hit
+        # positions by code; runs slice out per distinct code
+        # (positions stay ascending within a run).
+        order = np.argsort(kid, kind="stable")
+        pos, kid = pos[order], kid[order]
+        run_at = np.flatnonzero(np.diff(kid)) + 1
+        bounds = np.concatenate(([0], run_at, [len(kid)]))
+        for r in range(len(bounds) - 1):
+            k = int(kid[bounds[r]])
+            at = pos[bounds[r]:bounds[r + 1]]
+            for bi in range(int(tier.bucket_start[k]),
+                            int(tier.bucket_start[k + 1])):
+                fi = int(tier.fid[bi])
+                fa = self._factor_arrs[fi]
+                L = len(fa)
+                # Window position -> factor start; verify the FULL
+                # factor bytes (window included: survivors may be
+                # bloom false positives) and the line bounds.
+                q = at - int(tier.anchor[bi])
+                q = q[(q >= 0) & (q + L <= n)]
+                if len(q):
+                    body = arr[q[:, None] + np.arange(L)[None, :]]
+                    q = q[(body == fa[None, :]).all(axis=1)]
+                if not len(q):
+                    continue
+                line = np.searchsorted(offsets, q, side="right") - 1
+                inside = (line >= 0) & (q + L <= offsets[line + 1])
+                if inside.any():
+                    out.append((fi, np.unique(line[inside])))
+
+    def group_candidates(self, payload: bytes,
+                         offsets: np.ndarray) -> np.ndarray:
+        """[B, G] bool: True where the line might match a pattern of
+        group g (necessary condition). Always-candidate groups are True
+        everywhere. Updates ``last_stats`` with the narrowing outcome."""
+        B = len(offsets) - 1
+        gm = np.zeros((B, self.n_groups), dtype=bool)
+        if len(self.always_groups):
+            gm[:, self.always_groups] = True
+        for fi, lines in self._hits(payload, offsets):
+            gm[np.ix_(lines, self.group_ids[fi])] = True
+        self.last_stats = SweepStats(
+            lines=B, groups=self.n_groups,
+            candidate_cells=int(gm.sum()),
+            candidate_lines=int(gm.any(axis=1).sum()))
+        return gm
+
+    def pattern_candidates(self, payload: bytes,
+                           offsets: np.ndarray) -> np.ndarray:
+        """[B, P] bool per-pattern candidate matrix (unguarded patterns
+        all-True). The fine-grained form — tests assert its
+        necessary-safety; the production scan path uses the coarser
+        group matrix."""
+        B = len(offsets) - 1
+        pm = np.zeros((B, self.n_patterns), dtype=bool)
+        guarded = np.zeros(self.n_patterns, dtype=bool)
+        for pids in self.pattern_ids:
+            guarded[pids] = True
+        pm[:, ~guarded] = True
+        for fi, lines in self._hits(payload, offsets):
+            pm[np.ix_(lines, self.pattern_ids[fi])] = True
+        return pm

@@ -183,11 +183,17 @@ def _summarize(node: object) -> _Summary:
     raise TypeError(node)
 
 
+def clauses_from_ast(node: object) -> "list[Clause]":
+    """Mandatory pair-CNF of one PARSED pattern, most selective clause
+    first — callers that already hold the AST (the regex index builds
+    factors and clauses from one parse) skip the re-parse."""
+    return sorted(_summarize(node).cnf, key=_clause_weight)
+
+
 def mandatory_clauses(pattern: str, ignore_case: bool = False
                       ) -> list[Clause]:
     """Mandatory pair-CNF of one pattern, most selective clause first."""
-    summary = _summarize(parse(pattern, ignore_case=ignore_case))
-    return sorted(summary.cnf, key=_clause_weight)
+    return clauses_from_ast(parse(pattern, ignore_case=ignore_case))
 
 
 @dataclass
@@ -218,23 +224,34 @@ class PrefilterProgram:
 
 def compile_prefilter(patterns: list[str],
                       ignore_case: bool = False) -> PrefilterProgram:
-    """Select up to MAX_PAIR_SLOTS clause slots across patterns
-    (deduplicated, most selective first per pattern) and pack the LUTs."""
+    """Select up to MAX_PAIR_SLOTS clause slots across patterns and pack
+    the LUTs.
+
+    Slots are allocated GLOBALLY, not first-pattern-wins: clauses are
+    deduplicated across the set and ranked by (best per-pattern rank,
+    selectivity) — every pattern's rarest clause competes for a slot
+    before ANY pattern's second-rarest. A pattern late in a large set
+    whose best clause is shared (or rare) still gets req bits; under the
+    old sequential scheme pattern #33+ of a diverse 512-clause set got
+    nothing and silently disabled gating for everyone."""
     per_pattern = [mandatory_clauses(p, ignore_case) for p in patterns]
-    slot_of: dict[Clause, int] = {}
+    # clause -> (best rank across patterns, weight): rank-0 clauses are
+    # some pattern's most selective clause and allocate first.
+    demand: dict[Clause, tuple[int, float]] = {}
+    for clauses in per_pattern:
+        for rank, clause in enumerate(clauses[:MAX_CLAUSES_PER_PATTERN]):
+            key = (rank, _clause_weight(clause))
+            prev = demand.get(clause)
+            if prev is None or key < prev:
+                demand[clause] = key
+    order = sorted(demand, key=lambda c: demand[c])  # stable: dict order
+    slot_of: dict[Clause, int] = {
+        clause: i for i, clause in enumerate(order[:MAX_PAIR_SLOTS])}
     chosen: list[list[int]] = []
     usable = True
     for clauses in per_pattern:
-        slots: list[int] = []
-        for clause in clauses:
-            if len(slots) >= MAX_CLAUSES_PER_PATTERN:
-                break
-            slot = slot_of.get(clause)
-            if slot is None:
-                if len(slot_of) >= MAX_PAIR_SLOTS:
-                    continue  # no slot left; weaker req for this pattern
-                slot = slot_of[clause] = len(slot_of)
-            slots.append(slot)
+        slots = [slot_of[c] for c in clauses
+                 if c in slot_of][:MAX_CLAUSES_PER_PATTERN]
         if not slots:
             usable = False  # this pattern always passes -> no gating
         chosen.append(slots)
@@ -256,17 +273,27 @@ def compile_prefilter(patterns: list[str],
                             clause_counts=[len(c) for c in per_pattern])
 
 
-def candidates_host(pf: PrefilterProgram, lines: list[bytes]) -> list[bool]:
-    """Reference (numpy, host) candidate test — the oracle for the
-    device implementation and a quick selectivity probe."""
-    out = []
-    for line in lines:
+def candidate_matrix_host(pf: PrefilterProgram,
+                          lines: list[bytes]) -> np.ndarray:
+    """Reference (numpy, host) PER-PATTERN candidate matrix: [B, P]
+    bool, True where the line satisfies pattern p's full clause
+    requirement. The oracle for the device candidate matrix
+    (ops.prefilter.candidate_matrix*) and the per-pattern narrowing
+    primitive: column p False proves pattern p cannot match that line
+    (necessary condition), so engines may skip it."""
+    out = np.zeros((len(lines), pf.req.shape[0]), dtype=bool)
+    for i, line in enumerate(lines):
         arr = np.frombuffer(line, dtype=np.uint8)
         if len(arr) < 2:
             present = np.zeros(pf.n_words, dtype=np.uint32)
         else:
             present = np.bitwise_or.reduce(
                 pf.lut1[arr[:-1]] & pf.lut2[arr[1:]], axis=0)
-        out.append(bool(
-            ((present[None, :] & pf.req) == pf.req).all(axis=1).any()))
+        out[i] = ((present[None, :] & pf.req) == pf.req).all(axis=1)
     return out
+
+
+def candidates_host(pf: PrefilterProgram, lines: list[bytes]) -> list[bool]:
+    """Reference (numpy, host) any-pattern candidate test — the oracle
+    for the device implementation and a quick selectivity probe."""
+    return candidate_matrix_host(pf, lines).any(axis=1).tolist()

@@ -73,7 +73,8 @@ class DFAFilter(LogFilter):
     ``max_states`` — callers fall back to CombinedRegexFilter."""
 
     def __init__(self, patterns: list[str], ignore_case: bool = False,
-                 max_states: int | None = None, cache: bool = True):
+                 max_states: int | None = None, cache: bool = True,
+                 cache_events=None):
         from klogs_tpu.filters.compiler.dfa import (
             DEFAULT_MAX_STATES,
             build_dfa,
@@ -84,7 +85,8 @@ class DFAFilter(LogFilter):
             raise ValueError("DFAFilter needs at least one pattern")
         if cache:
             t = build_dfa_cached(patterns, ignore_case=ignore_case,
-                                 max_states=max_states or DEFAULT_MAX_STATES)
+                                 max_states=max_states or DEFAULT_MAX_STATES,
+                                 on_event=cache_events)
         else:
             # cache=False: throwaway table sets (fuzz sweeps build one
             # per trial — writing each to disk would be pure waste).
@@ -137,15 +139,29 @@ class DFAFilter(LogFilter):
                           dtype=bool)
 
 
-def best_host_filter(patterns: list[str], ignore_case: bool = False):
-    """Strongest CPU engine this pattern set admits: DFA when the
-    compiler subset + determinization allow it; else one combined
-    alternation; else K-sequential `re` (an alternation of valid `re`
-    patterns is usually valid `re`, but mid-pattern global flags like
-    "(?i)x" poison a combined expression). Returns (filter, kind).
+# Pattern-set size from which the factor-index engine takes over in
+# auto mode: one union DFA stops determinizing well past the north-star
+# scale, and scan-all-K cost grows linearly while the indexed engine's
+# tracks candidates (docs/PATTERNS.md). Below it, the single-DFA path
+# is both faster and simpler — K=32 behavior is unchanged.
+INDEX_MIN_K = 64
 
-    KLOGS_CPU_ENGINE={auto,dfa,combined,re} forces a specific engine
-    (re = the reference-parity K-sequential baseline)."""
+
+def best_host_filter(patterns: list[str], ignore_case: bool = False,
+                     registry=None):
+    """Strongest CPU engine this pattern set admits: the factor-index
+    engine (filters/indexed.py) for thousand-pattern sets; a single
+    union DFA when the compiler subset + determinization allow it; else
+    one combined alternation; else K-sequential `re` (an alternation of
+    valid `re` patterns is usually valid `re`, but mid-pattern global
+    flags like "(?i)x" poison a combined expression). Returns
+    (filter, kind). ``registry`` (an obs.Registry) receives the
+    indexed engine's klogs_prefilter_* families when given, so a
+    --metrics-port sidecar scrapes them.
+
+    KLOGS_CPU_ENGINE={auto,indexed,dfa,combined,re} forces a specific
+    engine (re = the reference-parity K-sequential baseline);
+    KLOGS_INDEX_MIN_K moves the auto-mode indexed threshold."""
     import os
 
     choice = os.environ.get("KLOGS_CPU_ENGINE", "auto")
@@ -154,6 +170,30 @@ def best_host_filter(patterns: list[str], ignore_case: bool = False):
     if choice == "combined":
         return (CombinedRegexFilter(patterns, ignore_case=ignore_case),
                 "combined-re")
+    try:
+        min_k = int(os.environ.get("KLOGS_INDEX_MIN_K", str(INDEX_MIN_K)))
+    except ValueError:
+        min_k = INDEX_MIN_K
+    if choice == "indexed" or (choice == "auto" and len(patterns) >= min_k):
+        from klogs_tpu.filters.indexed import IndexedFilter
+
+        try:
+            return (IndexedFilter(patterns, ignore_case=ignore_case,
+                                  registry=registry),
+                    "indexed")
+        except Exception as e:
+            if choice == "indexed":
+                raise
+            # Auto-mode fallthrough must be LOUD: at this K the ladder
+            # below degrades badly (a union DFA rarely determinizes,
+            # combined-re scans all K), and a silent ~15x throughput
+            # drop with the index never attempted is undebuggable.
+            from klogs_tpu.ui import term
+
+            term.warning(
+                "indexed engine failed for this %d-pattern set (%s: %s); "
+                "falling back to the DFA/combined-re ladder",
+                len(patterns), type(e).__name__, e)
     try:
         return DFAFilter(patterns, ignore_case=ignore_case), "dfa"
     except Exception:

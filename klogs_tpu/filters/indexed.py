@@ -1,0 +1,240 @@
+"""IndexedFilter — the thousand-pattern host engine.
+
+Two phases per batch, per "Regular Expression Indexing for Log
+Analysis" (PAPERS.md): a single shared factor-index sweep
+(filters/compiler/index.py) narrows each line to its candidate pattern
+GROUPS, then only those groups' compiled engines scan the line. The
+pattern set is partitioned by filters/compiler/groups.py — bounded,
+factor-clustered groups, each compiled to the strongest engine it
+admits (native DFA scan via the LRU table cache; combined-`re`; else
+K-sequential `re`) — so compile cost and DFA subset construction stay
+bounded at K=4096 while scan cost tracks CANDIDATES, not K.
+
+Verdict semantics are identical to RegexFilter (any-match over the
+whole set): the index is a necessary condition, so a skipped
+(line, group) pair can never hide a match.
+
+The scan-all-K comparator (``narrow=False``) runs the same group
+engines over every line — bench.py's K-axis uses it to quantify the
+index's win honestly (same tables, same engines, only the narrowing
+differs).
+"""
+
+from typing import Any
+
+import numpy as np
+
+from klogs_tpu.filters.base import LogFilter, frame_lines
+from klogs_tpu.filters.compiler.groups import (
+    MAX_GROUP_PATTERNS,
+    MAX_GROUP_POSITIONS,
+    GroupPlan,
+    PatternInfo,
+    analyze,
+    plan_groups,
+)
+from klogs_tpu.filters.compiler.index import FactorIndex
+
+# Per-group DFA state budget: small enough that ~128 groups of tables
+# stay cache-friendly and subset construction per group is sub-second;
+# a group that overflows degrades to combined-`re` for just that group.
+GROUP_MAX_STATES = 8192
+# Lines per sweep slab: bounds the sweep's transient numpy arrays
+# (~16 bytes per payload byte) regardless of caller batch size.
+SLAB_LINES = 65536
+
+
+class _Group:
+    """One compiled pattern group: members + the strongest engine the
+    group admits."""
+
+    def __init__(self, members: "list[int]", patterns: "list[str]",
+                 ignore_case: bool, cache: bool,
+                 on_cache_event: Any) -> None:
+        import re as _re
+
+        from klogs_tpu.filters.cpu import (
+            _GROUP_REF_RE,
+            CombinedRegexFilter,
+            DFAFilter,
+            RegexFilter,
+        )
+
+        self.members = members
+        self.patterns = patterns
+        try:
+            self.filt: LogFilter = DFAFilter(
+                patterns, ignore_case=ignore_case,
+                max_states=GROUP_MAX_STATES, cache=cache,
+                cache_events=on_cache_event)
+            self.kind = "dfa"
+            return
+        except Exception:
+            pass
+        if any(_GROUP_REF_RE.search(p) for p in patterns):
+            # Renumbering-sensitive groups stay K-sequential (same rule
+            # as best_host_filter; see filters/cpu.py).
+            self.filt = RegexFilter(patterns, ignore_case=ignore_case)
+            self.kind = "re"
+            return
+        try:
+            self.filt = CombinedRegexFilter(patterns,
+                                            ignore_case=ignore_case)
+            self.kind = "combined-re"
+        except _re.error:
+            self.filt = RegexFilter(patterns, ignore_case=ignore_case)
+            self.kind = "re"
+
+
+class IndexedFilter(LogFilter):
+    """Factor-index narrowing + per-group scan (module docstring)."""
+
+    def __init__(self, patterns: "list[str]", ignore_case: bool = False,
+                 *, narrow: bool = True, cache: bool = True,
+                 max_group_patterns: int = MAX_GROUP_PATTERNS,
+                 max_group_positions: int = MAX_GROUP_POSITIONS,
+                 registry: Any = None) -> None:
+        if not patterns:
+            raise ValueError("IndexedFilter needs at least one pattern")
+        from klogs_tpu.obs.metrics import Registry
+
+        self.registry = registry if registry is not None else Registry()
+        r = self.registry
+        self._m_clauses = r.family("klogs_prefilter_pattern_clauses")
+        self._m_factors = r.family("klogs_prefilter_pattern_factors")
+        self._m_ratio = r.family("klogs_prefilter_narrowing_ratio")
+        self._m_groups = r.family("klogs_prefilter_groups")
+        cache_events = r.family("klogs_prefilter_table_cache_events_total")
+        self._m_cache = {kind: cache_events.labels(event=kind)
+                         for kind in ("hit", "miss", "evict")}
+
+        self.narrow = narrow
+        self.infos: "list[PatternInfo]" = analyze(
+            patterns, ignore_case=ignore_case)
+        self.plan: GroupPlan = plan_groups(
+            self.infos, max_group_patterns=max_group_patterns,
+            max_group_positions=max_group_positions)
+        self.index = FactorIndex(self.infos, self.plan)
+        for info in self.infos:
+            self._m_clauses.observe(info.clauses)
+            self._m_factors.observe(info.factors)
+        self.groups = [
+            _Group(members, [patterns[i] for i in members], ignore_case,
+                   cache, self._on_cache_event)
+            for members in self.plan.groups
+        ]
+        self._m_groups.set(len(self.groups))
+        # Cumulative narrowing tallies (bench/introspection).
+        self.swept_lines = 0
+        self.swept_cells = 0
+        self.candidate_cells = 0
+        self.candidate_lines = 0
+
+    def _on_cache_event(self, kind: str) -> None:
+        c = self._m_cache.get(kind)
+        if c is not None:
+            c.inc()
+
+    @property
+    def narrowing_ratio(self) -> float:
+        """Cumulative fraction of (line, group) scans the index let
+        through (1.0 = no narrowing; lower is better)."""
+        return (self.candidate_cells / self.swept_cells
+                if self.swept_cells else 1.0)
+
+    @property
+    def engine_kinds(self) -> "dict[str, int]":
+        out: "dict[str, int]" = {}
+        for g in self.groups:
+            out[g.kind] = out.get(g.kind, 0) + 1
+        return out
+
+    # -- matching -----------------------------------------------------
+
+    def match_lines(self, lines: "list[bytes]") -> "list[bool]":
+        payload, offsets, _ = frame_lines(lines)
+        return self._match_frame(payload, np.asarray(offsets)).tolist()
+
+    def dispatch_framed(self, payload: bytes, offsets: Any) -> Any:
+        return self._match_frame(
+            payload, np.ascontiguousarray(offsets, dtype=np.int32))
+
+    def fetch_framed(self, handle: Any) -> np.ndarray:
+        return np.asarray(handle, dtype=bool)
+
+    def _match_frame(self, payload: bytes,
+                     offsets: np.ndarray) -> np.ndarray:
+        n = len(offsets) - 1
+        out = np.zeros(n, dtype=bool)
+        for lo in range(0, n, SLAB_LINES):
+            hi = min(n, lo + SLAB_LINES)
+            base = int(offsets[lo])
+            sub_off = (offsets[lo:hi + 1] - base).astype(np.int32)
+            sub_pay = payload[base:int(offsets[hi])]
+            out[lo:hi] = self._match_slab(sub_pay, sub_off)
+        return out
+
+    def _match_slab(self, payload: bytes,
+                    offsets: np.ndarray) -> np.ndarray:
+        B = len(offsets) - 1
+        out = np.zeros(B, dtype=bool)
+        if self.narrow:
+            gm = self.index.group_candidates(payload, offsets)
+            st = self.index.last_stats
+            self.swept_lines += st.lines
+            self.swept_cells += st.lines * st.groups
+            self.candidate_cells += st.candidate_cells
+            self.candidate_lines += st.candidate_lines
+            self._m_ratio.observe(st.narrowing_ratio)
+        else:
+            gm = np.ones((B, len(self.groups)), dtype=bool)
+            self.swept_lines += B
+            self.swept_cells += B * len(self.groups)
+            self.candidate_cells += B * len(self.groups)
+            self.candidate_lines += B
+        arr = np.frombuffer(payload, dtype=np.uint8)
+        lens = np.diff(offsets)
+        for g, grp in enumerate(self.groups):
+            col = gm[:, g]
+            if not col.any():
+                continue
+            if col.all():
+                # Whole slab is candidate (always-candidate groups, the
+                # scan-all comparator): the engine's framed fast path.
+                verd = np.asarray(grp.filt.fetch_framed(
+                    grp.filt.dispatch_framed(payload, offsets)))
+                out |= verd[:B]
+                continue
+            rows = np.nonzero(col & ~out)[0]  # already-kept rows skip
+            if not len(rows):
+                continue
+            # Candidate rows ride the framed path too: a vectorized
+            # ragged gather builds the sub-frame (no per-line PyBytes —
+            # the whole narrow path stays at C speed).
+            sub_pay, sub_off = _gather_frame(arr, offsets, lens, rows)
+            verd = np.asarray(grp.filt.fetch_framed(
+                grp.filt.dispatch_framed(sub_pay, sub_off)))
+            out[rows[verd[:len(rows)]]] = True
+        return out
+
+
+def _gather_frame(arr: np.ndarray, offsets: np.ndarray, lens: np.ndarray,
+                  rows: np.ndarray) -> "tuple[bytes, np.ndarray]":
+    """Sub-frame of ``rows`` out of a framed batch, fully vectorized:
+    (payload bytes, int32 offsets). ``arr`` is the uint8 view of the
+    parent payload."""
+    sub_lens = lens[rows].astype(np.int64)
+    # Safe outside frame_lines: the sub-frame is a subset of a parent
+    # payload whose offsets already passed the int32 guard, so the
+    # int64 cumsum can never exceed the parent's int32 total.
+    ends = np.cumsum(sub_lens)  # klogs: ignore[int32-guard]
+    total = int(ends[-1]) if len(ends) else 0
+    sub_off = np.zeros(len(rows) + 1, dtype=np.int32)
+    sub_off[1:] = ends.astype(np.int32)
+    if not total:
+        return b"", sub_off
+    # Standard ragged-range trick: absolute source index for every byte.
+    starts = offsets[rows].astype(np.int64)
+    firsts = np.repeat(starts - np.concatenate(([0], ends[:-1])), sub_lens)
+    pos = firsts + np.arange(total, dtype=np.int64)
+    return arr[pos].tobytes(), sub_off

@@ -416,7 +416,9 @@ def _build_filter(patterns: list[str], backend: str, stats,
         # combined-re -> K-sequential re); KLOGS_CPU_ENGINE overrides.
         from klogs_tpu.filters.cpu import best_host_filter
 
-        return best_host_filter(patterns, ignore_case=ignore_case)[0]
+        return best_host_filter(
+            patterns, ignore_case=ignore_case,
+            registry=stats.registry if stats is not None else None)[0]
     import jax
 
     from klogs_tpu.filters.tpu import NFAEngineFilter

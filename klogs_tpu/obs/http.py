@@ -39,6 +39,7 @@ class Health:
 
     def __init__(self) -> None:
         self._ready = False
+        self._drained = False
         self.live_checks: dict[str, Callable[[], bool]] = {}
         self.ready_checks: dict[str, Callable[[], bool]] = {}
 
@@ -50,6 +51,17 @@ class Health:
 
     def set_ready(self, ready: bool = True) -> None:
         self._ready = ready
+        self._drained = not ready
+
+    def mark_warm(self) -> None:
+        """Cold-start gate: flip readiness on — UNLESS an explicit
+        ``set_ready(False)`` drain arrived while the warmup was still
+        in flight. A rolling restart can start draining a server the
+        moment it comes up; the warmup batch landing a beat later must
+        not silently un-drain it (set_ready(True) still does, that one
+        is an operator decision)."""
+        if not self._drained:
+            self._ready = True
 
     @staticmethod
     def _run(checks: dict[str, Callable[[], bool]]) -> tuple[bool, dict]:

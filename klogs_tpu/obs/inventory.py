@@ -27,6 +27,10 @@ WIDTH_BUCKETS = (128, 256, 512, 1024, 2048, 4096, 8192,
 GROUP_MEMBER_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
 GROUP_LINE_BUCKETS = (64, 256, 1024, 4096, 8192, 16384,
                       65536, 262144, 1048576)
+# Index-build extraction counts (clauses/factors per pattern) and the
+# candidate-narrowing ratio ladder (fractions of lines x groups).
+PATTERN_EXTRACT_BUCKETS = (0, 1, 2, 4, 8, 16, 32)
+RATIO_BUCKETS = (0.001, 0.01, 0.05, 0.1, 0.25, 0.5, 0.75, 1.0)
 
 
 def _m(mtype: str, help: str, labels: tuple = (),
@@ -118,6 +122,29 @@ SPECS: dict[str, dict] = {
     "klogs_engine_tune_best_lines_per_second": _m(
         "gauge", "Winning configuration's measured throughput from the "
         "last autotune sweep."),
+
+    # -- regex index (IndexedFilter / compiler grouping) --------------
+    "klogs_prefilter_pattern_clauses": _m(
+        "histogram", "Mandatory pair-CNF clauses extracted per pattern "
+        "at index build (0 = pattern contributes no clause gating).",
+        buckets=PATTERN_EXTRACT_BUCKETS),
+    "klogs_prefilter_pattern_factors": _m(
+        "histogram", "Mandatory literal factors extracted per pattern "
+        "at index build (0 = pattern rides the always-candidate path).",
+        buckets=PATTERN_EXTRACT_BUCKETS),
+    "klogs_prefilter_narrowing_ratio": _m(
+        "histogram", "Per-batch candidate-narrowing ratio: candidate "
+        "(line, group) scan units over lines x groups — 1.0 means the "
+        "index ruled nothing out, lower is better.",
+        buckets=RATIO_BUCKETS),
+    "klogs_prefilter_groups": _m(
+        "gauge", "Pattern groups compiled by the thousand-pattern "
+        "index (grouping bounds per-group DFA construction)."),
+    "klogs_prefilter_table_cache_events_total": _m(
+        "counter", "On-disk DFA table cache outcomes during index "
+        "compiles: hit (table loaded), miss (determinized fresh), "
+        "evict (LRU removal past KLOGS_DFA_CACHE_MB).",
+        labels=("event",)),
 
     # -- fanout layer (FanoutRunner) ----------------------------------
     "klogs_fanout_active_streams": _m(

@@ -62,12 +62,18 @@ class DeviceProgram:
     n_classes: int  # padded C
     n_states: int  # padded S
     match_all: bool
+    # Grouped programs only: pattern index (input order) -> group id,
+    # as a hashable tuple (static aux, not a leaf). Empty for
+    # single-automaton programs. Lets the two-phase kernel path gate
+    # per (tile, group), not just per tile (ops/pallas_nfa.py).
+    pattern_group: tuple = ()
 
     def tree_flatten(self):
         leaves = (self.char_mask, self.follow, self.inject, self.accept,
                   self.byte_class)
         aux = (self.begin_class, self.end_class, self.pad_class,
-               self.n_classes, self.n_states, self.match_all)
+               self.n_classes, self.n_states, self.match_all,
+               self.pattern_group)
         return leaves, aux
 
     @classmethod
@@ -334,19 +340,26 @@ def compile_grouped(patterns: list[str], ignore_case: bool = False,
 
     if not patterns:
         raise ValueError("compile_grouped needs at least one pattern")
-    # Greedy first-fit-decreasing bin packing by position count.
-    sized = [(compile_patterns([p], ignore_case=ignore_case).n_states, p)
-             for p in patterns]
-    sized.sort(key=lambda t: -t[0])
-    bins: list[tuple[int, list[str]]] = []
-    for n, p in sized:
-        for i, (load, ps) in enumerate(bins):
+    # Greedy first-fit-decreasing bin packing by position count
+    # (tracking ORIGINAL pattern indices, so the program can report
+    # which group each input pattern landed in — duplicates included).
+    sized = [(compile_patterns([p], ignore_case=ignore_case).n_states, i)
+             for i, p in enumerate(patterns)]
+    sized.sort(key=lambda t: (-t[0], t[1]))
+    bins: list[tuple[int, list[int]]] = []
+    for n, pi in sized:
+        for i, (load, ids) in enumerate(bins):
             if load + n <= max_positions:
-                bins[i] = (load + n, ps + [p])
+                bins[i] = (load + n, ids + [pi])
                 break
         else:
-            bins.append((n, [p]))
-    progs = [compile_patterns(ps, ignore_case=ignore_case) for _, ps in bins]
+            bins.append((n, [pi]))
+    pattern_group = [0] * len(patterns)
+    for g, (_, ids) in enumerate(bins):
+        for pi in ids:
+            pattern_group[pi] = g
+    progs = [compile_patterns([patterns[i] for i in ids],
+                              ignore_case=ignore_case) for _, ids in bins]
     G = max(len(progs), n_groups or 0)
 
     # Shared byte classifier: bytes equivalent in EVERY group collapse.
@@ -393,6 +406,7 @@ def compile_grouped(patterns: list[str], ignore_case: bool = False,
         n_classes=C,
         n_states=S,
         match_all=any(p.match_all for p in progs),
+        pattern_group=tuple(pattern_group),
     )
     return dp, live, acc
 

@@ -367,19 +367,24 @@ def _grouped_kernel_fused(cls_ref, char_mask_all_ref, follow_t_ref, out_ref,
 
 def _grouped_kernel_gated(flags_ref, cls_ref, char_mask_t_ref, follow_t_ref,
                           out_ref, **kw):
-    """Tile-skipping wrapper: flags_ref (scalar-prefetched, [n_tiles])
-    marks tiles holding at least one prefilter candidate. Dead tiles
-    write zeros once and never run the scan loop — the two-phase
-    filter's payoff (compute scales with candidate tiles, not batch)."""
+    """(Tile, group)-skipping wrapper: flags_ref (scalar-prefetched,
+    [n_tiles, G]) marks grid cells where the tile holds at least one
+    candidate line FOR THAT GROUP's patterns. Dead cells never run the
+    scan loop — the two-phase filter's payoff (compute scales with
+    candidate work, not batch x groups). The out block is initialized
+    at g == 0 either by the body's overwrite (live cell) or by an
+    explicit zero write (dead cell), and live g > 0 cells OR into it,
+    so any live/dead interleaving across the group axis accumulates
+    correctly."""
     i = pl.program_id(0)
     g = pl.program_id(1)
-    live_tile = flags_ref[i] > 0
+    live_cell = flags_ref[i, g] > 0
 
-    @pl.when(jnp.logical_not(live_tile) & (g == 0))
+    @pl.when(jnp.logical_not(live_cell) & (g == 0))
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    @pl.when(live_tile)
+    @pl.when(live_cell)
     def _():
         _grouped_kernel_body(g, cls_ref, char_mask_t_ref, follow_t_ref,
                              out_ref, **kw)
@@ -577,20 +582,35 @@ def _launch_grouped(dp, live, acc, cls, B, TILE_B,
         return (matched, None) if return_stats else matched
 
     from klogs_tpu.ops.prefilter import (
-        candidate_mask,
-        candidate_mask_from_cls,
+        candidate_matrix,
+        candidate_matrix_from_cls,
         cluster_candidates,
+        group_candidates,
+        pattern_group_onehot,
     )
 
     if len(prefilter_tables) == 4:  # class-domain tables (fast form)
-        cand = candidate_mask_from_cls(prefilter_tables, cls)  # [Bp]
+        pm = candidate_matrix_from_cls(prefilter_tables, cls)  # [Bp, Pp]
     else:
-        cand = candidate_mask(prefilter_tables, *cand_input)  # [Bp]
+        pm = candidate_matrix(prefilter_tables, *cand_input)  # [Bp, Pp]
+    cand = pm.any(axis=1)
     order, inv, tile_live = cluster_candidates(cand, TILE_B)
+    n_tiles = Bp // TILE_B
+    if dp.pattern_group:
+        # Thousand-pattern narrowing: gate per (tile, GROUP) — a tile
+        # whose candidates all come from other groups' patterns skips
+        # this group's scan loop entirely.
+        onehot = pattern_group_onehot(dp.pattern_group, G)
+        gm = group_candidates(pm, onehot, len(dp.pattern_group))
+        flags = (gm[order].reshape(n_tiles, TILE_B, G).any(axis=1)
+                 .astype(jnp.int32))
+    else:
+        flags = jnp.broadcast_to(tile_live[:, None],
+                                 (n_tiles, G)).astype(jnp.int32)
     cls = cls[order]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(Bp // TILE_B, G),
+        grid=(n_tiles, G),
         in_specs=[
             pl.BlockSpec((T, TILE_B), lambda i, g, flags: (0, i)),
             pl.BlockSpec((1, S, C), lambda i, g, flags: (g, 0, 0)),
@@ -603,7 +623,7 @@ def _launch_grouped(dp, live, acc, cls, B, TILE_B,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((1, Bp), jnp.int8),
         interpret=interpret,
-    )(tile_live, cls.T, char_mask_t, follow_t)
+    )(flags, cls.T, char_mask_t, follow_t)
     matched = (out[0] > 0)[inv][:B]
     matched = matched | jnp.asarray(dp.match_all)
     if return_stats:

@@ -1,9 +1,18 @@
-"""Device-side candidate mask for the two-phase filter.
+"""Device-side candidate masks for the two-phase filter.
 
 Evaluates the compiled pair-CNF (filters/compiler/prefilter.py) on a
-batch, producing the [B] bool mask that drives tile skipping in the
-Pallas kernel (candidates are clustered to the front by a stable
-partition and dead tiles never run the scan loop).
+batch. Two granularities:
+
+- ``candidate_matrix`` / ``candidate_matrix_from_cls`` — the [B, P]
+  PER-PATTERN form (thousand-pattern mode): cell (b, p) False proves
+  pattern p cannot match line b. ``group_candidates`` reduces it to
+  per-(line, kernel-group) flags via the grouped program's
+  pattern_group map, so the gated kernel skips (tile, group) cells,
+  not just whole tiles.
+- ``candidate_mask`` / ``candidate_mask_from_cls`` — the [B] any-
+  pattern reduction that drives plain tile skipping (candidates are
+  clustered to the front by a stable partition and dead tiles never
+  run the scan loop).
 
 Two formulations:
 
@@ -43,10 +52,14 @@ def device_tables(pf: PrefilterProgram):
 
 
 @jax.jit
-def candidate_mask(tables, batch: jax.Array, lengths: jax.Array) -> jax.Array:
-    """[B, L] u8 + [B] lengths -> [B] bool: True when the line satisfies
-    some pattern's full clause requirement (necessary condition for any
-    match; False rows can never match and may be skipped).
+def candidate_matrix(tables, batch: jax.Array,
+                     lengths: jax.Array) -> jax.Array:
+    """[B, L] u8 + [B] lengths -> [B, P] bool PER-PATTERN candidate
+    matrix: True where the line satisfies pattern p's full clause
+    requirement (necessary condition for a match of p; a False cell
+    proves pattern p cannot match that line, so engines may skip that
+    (line, pattern) scan). Device twin of the host oracle
+    ``filters.compiler.prefilter.candidate_matrix_host``.
 
     The OR over pair positions folds in PAIR_BLOCK-sized chunks via
     lax.scan, so peak memory is [B, PAIR_BLOCK, W] regardless of L (a
@@ -55,8 +68,10 @@ def candidate_mask(tables, batch: jax.Array, lengths: jax.Array) -> jax.Array:
     lut1, lut2, req = tables
     B, L = batch.shape
     W = req.shape[1]
+    P = req.shape[0]
     if L < 2:
-        return jnp.zeros((B,), dtype=bool) | _req_trivial(req)
+        return jnp.broadcast_to(jnp.all(req == 0, axis=-1)[None, :],
+                                (B, P))
     x = batch.astype(jnp.int32)
     a, b = x[:, :-1], x[:, 1:]
     pos = jnp.arange(L - 1, dtype=jnp.int32)
@@ -83,12 +98,16 @@ def candidate_mask(tables, batch: jax.Array, lengths: jax.Array) -> jax.Array:
     present0 = jnp.zeros((B, W), dtype=jnp.uint32)
     present, _ = jax.lax.scan(step, present0, (a3, b3, v3))
     ok = (present[:, None, :] & req[None]) == req[None]  # [B, P, W]
-    return jnp.all(ok, axis=-1).any(axis=-1)
+    return jnp.all(ok, axis=-1)
 
 
-def _req_trivial(req) -> jax.Array:
-    # A pattern with an all-zero requirement row is always satisfied.
-    return jnp.any(jnp.all(req == 0, axis=-1))
+@jax.jit
+def candidate_mask(tables, batch: jax.Array, lengths: jax.Array) -> jax.Array:
+    """[B, L] u8 + [B] lengths -> [B] bool: True when the line satisfies
+    SOME pattern's full clause requirement (the any-pattern reduction
+    of ``candidate_matrix`` — necessary condition for any match; False
+    rows can never match and may be skipped)."""
+    return candidate_matrix(tables, batch, lengths).any(axis=-1)
 
 
 # ---------------------------------------------------------------------
@@ -158,9 +177,13 @@ def class_tables(pf: PrefilterProgram, byte_class, n_classes: int,
 
 
 @jax.jit
-def candidate_mask_from_cls(tables, cls: jax.Array) -> jax.Array:
+def candidate_matrix_from_cls(tables, cls: jax.Array) -> jax.Array:
     """[B, T] class ids (classify_chunk output, sentinels included) ->
-    [B] bool candidate mask, via MXU one-hot matmuls per position block.
+    [B, Pp] bool PER-PATTERN candidate matrix via MXU one-hot matmuls
+    per position block (Pp = possibly padded pattern count; padded
+    columns — req_count 0 — are always False, callers slice to the
+    real pattern count). Device twin of
+    ``filters.compiler.prefilter.candidate_matrix_host``.
 
     Pairs touching BEGIN/END/PAD columns self-suppress (all-zero member
     rows), so the full cls array — exactly what the kernel wrapper
@@ -169,7 +192,9 @@ def candidate_mask_from_cls(tables, cls: jax.Array) -> jax.Array:
     B, T = cls.shape
     C, S = m1t.shape
     if T < 2:
-        return jnp.any(req_count == 0) & jnp.ones((B,), dtype=bool)
+        # No adjacent pair can fire; every real pattern (>= 1 clause,
+        # guaranteed by the class_tables usable gate) is ruled out.
+        return jnp.zeros((B, req_count.shape[0]), dtype=bool)
     c1, c2 = cls[:, :-1], cls[:, 1:]
     n_pairs = T - 1
     pad = -n_pairs % PAIR_BLOCK
@@ -206,8 +231,37 @@ def candidate_mask_from_cls(tables, cls: jax.Array) -> jax.Array:
     # Padded pattern columns have req_count 0 and would trivially pass;
     # they are masked out (a real pattern always has >= 1 slot when the
     # prefilter is usable).
-    ok = (got >= req_count[None, :]) & (req_count[None, :] > 0)
-    return jnp.any(ok, axis=1)
+    return (got >= req_count[None, :]) & (req_count[None, :] > 0)
+
+
+@jax.jit
+def candidate_mask_from_cls(tables, cls: jax.Array) -> jax.Array:
+    """[B, T] class ids -> [B] bool: the any-pattern reduction of
+    ``candidate_matrix_from_cls`` (padded columns never contribute)."""
+    return candidate_matrix_from_cls(tables, cls).any(axis=1)
+
+
+def pattern_group_onehot(pattern_group: "tuple[int, ...]",
+                         n_groups: int) -> jax.Array:
+    """[K, G] i8 one-hot of the grouped program's pattern -> group map
+    (DeviceProgram.pattern_group) — the reduction table taking a
+    per-pattern candidate matrix to per-(line, group) flags with one
+    small matmul."""
+    pg = np.asarray(pattern_group, dtype=np.int32)
+    return jnp.asarray(
+        (pg[:, None] == np.arange(n_groups)[None, :]).astype(np.int8))
+
+
+@partial(jax.jit, static_argnames=("n_patterns",))
+def group_candidates(matrix: jax.Array, onehot: jax.Array,
+                     n_patterns: int) -> jax.Array:
+    """[B, Pp] per-pattern candidate matrix + [K, G] group one-hot ->
+    [B, G] bool: True where the line is a candidate for SOME pattern
+    compiled into group g. ``n_patterns`` slices padded columns off
+    before the reduction."""
+    pm = matrix[:, :n_patterns].astype(jnp.int8)
+    return jnp.einsum("bp,pg->bg", pm, onehot,
+                      preferred_element_type=jnp.int32) > 0
 
 
 @partial(jax.jit, static_argnames=("tile_b",))

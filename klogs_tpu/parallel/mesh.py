@@ -226,8 +226,12 @@ class MeshEngine:
                 # match_all is pytree AUX and may differ across shards;
                 # stacking requires identical aux, so force the any()
                 # verdict uniformly — the OR across shards is what the
-                # engine computes anyway.
+                # engine computes anyway. pattern_group (also aux)
+                # differs per shard too and only feeds the single-chip
+                # per-(tile, group) gate; the mesh path gates per tile,
+                # so clear it uniformly.
                 match_all=any(x.match_all for x in dps),
+                pattern_group=(),
             ))
         stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *redps)
         if self._multiprocess:

@@ -48,7 +48,12 @@ def _make_filter(patterns: list[str], backend: str,
         if backend == "cpu":
             from klogs_tpu.filters.cpu import best_host_filter
 
-            f = best_host_filter(pats, ignore_case=ignore_case)[0]
+            # Index metrics ride the first-built side's registry, same
+            # rule as the stats wiring below.
+            f = best_host_filter(
+                pats, ignore_case=ignore_case,
+                registry=stats.registry
+                if stats is not None and not made else None)[0]
         else:
             from klogs_tpu.filters.tpu import NFAEngineFilter
 
@@ -234,7 +239,9 @@ class FilterServer:
         try:
             payload, offsets, _ = frame_lines([b"klogs-warmup probe"])
             await self._service.match_framed(payload, offsets)
-            self.health.set_ready()
+            # mark_warm, not set_ready: a drain that raced the warmup
+            # (rolling restart right after start) must stick.
+            self.health.mark_warm()
         except Exception as e:
             print(f"klogs filterd: warmup batch failed ({e}); "
                   "/readyz stays unready", flush=True)

@@ -625,7 +625,7 @@ class ShardedFilterClient:
         try:
             writer.write(b"GET /readyz HTTP/1.1\r\nHost: " +
                          host.encode() + b"\r\nConnection: close\r\n\r\n")
-            await writer.drain()
+            await asyncio.wait_for(writer.drain(), self._probe_timeout_s)
             status = await asyncio.wait_for(reader.readline(),
                                             self._probe_timeout_s)
             parts = status.split()
@@ -635,6 +635,12 @@ class ShardedFilterClient:
         finally:
             writer.close()
             try:
-                await writer.wait_closed()
+                # Bounded: an unanswered close handshake would wedge
+                # the prober coroutine forever mid-probe, freezing
+                # drain detection for the WHOLE fleet (observed as a
+                # rare suite-order hang; kubelet probes are bounded
+                # end to end for the same reason).
+                await asyncio.wait_for(writer.wait_closed(),
+                                       self._probe_timeout_s)
             except (OSError, asyncio.TimeoutError):
                 pass

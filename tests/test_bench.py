@@ -54,6 +54,45 @@ def test_bench_json_contract():
         assert detail["jnp_smoke_lps"] > 0
 
 
+def test_bench_k_axis_contract(tmp_path):
+    """`bench.py --k-axis` writes the BENCH_K payload (row schema the
+    driver and docs/PATTERNS.md promise) — smoke-sized Ks here; the
+    real K ∈ {32..4096} sweep is the committed BENCH_K.json."""
+    out = tmp_path / "BENCH_K.json"
+    env = dict(os.environ)
+    # Ambient engine overrides (README-documented knobs) would flip
+    # the auto_engine row and fail the assertion below spuriously.
+    env.pop("KLOGS_CPU_ENGINE", None)
+    env.pop("KLOGS_INDEX_MIN_K", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "KLOGS_BENCH_K": "8,64",
+        "KLOGS_BENCH_K_LINES": "6000",
+        "KLOGS_BENCH_REPEATS": "1",
+        "KLOGS_BENCH_K_OUT": str(out),
+    })
+    res = subprocess.run(
+        [sys.executable, "bench.py", "--k-axis"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=420,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    assert rec["unit"] == "lines/sec"
+    ks = [r["k"] for r in rec["rows"]]
+    assert ks == [8, 64]
+    for row in rec["rows"]:
+        for key in ("indexed_lps", "scan_all_lps", "lps_pattern",
+                    "narrowing_ratio", "auto_engine", "n_groups",
+                    "speedup_vs_scan_all"):
+            assert key in row, key
+        assert 0.0 <= row["narrowing_ratio"] <= 1.0
+        assert row["indexed_lps"] > 0 and row["scan_all_lps"] > 0
+    # Same verdicts from both configurations is asserted inside the
+    # sweep itself; above the auto threshold the indexed engine is
+    # the production path.
+    assert rec["rows"][1]["auto_engine"] == "indexed"
+
+
 def test_graft_entry_contract():
     """__graft_entry__ is the second driver contract: entry() must give
     a jittable forward step + example args (compile-checked single-chip)

@@ -1,0 +1,621 @@
+"""Thousand-pattern mode: factor extraction necessity, grouping
+bounds, the shared factor-index sweep, the IndexedFilter engine,
+global prefilter slot allocation (starvation regression), the LRU DFA
+table cache, and host-vs-device candidate-MATRIX parity.
+
+The load-bearing invariant everywhere: the index is a NECESSARY
+condition. A False candidate cell must PROVE the pattern (or group)
+cannot match that line; a skipped scan can never hide a match."""
+
+import random
+import re
+import time
+
+import numpy as np
+import pytest
+
+from klogs_tpu.filters.compiler.factors import (
+    factors_from_ast,
+    guard_factors,
+    mandatory_factors,
+)
+from klogs_tpu.filters.compiler.groups import analyze, plan_groups
+from klogs_tpu.filters.compiler.index import FactorIndex
+from klogs_tpu.filters.compiler.parser import parse
+from klogs_tpu.filters.compiler.prefilter import (
+    candidate_matrix_host,
+    candidates_host,
+    compile_prefilter,
+)
+from klogs_tpu.filters.cpu import RegexFilter, best_host_filter
+from klogs_tpu.filters.indexed import IndexedFilter
+from tests.test_compiler import _rand_line, _rand_pattern, oracle
+
+
+def _frame(lines):
+    from klogs_tpu.filters.base import frame_lines
+
+    payload, offsets, _ = frame_lines(lines)
+    return payload, np.asarray(offsets, dtype=np.int32)
+
+
+# -- factor extraction ------------------------------------------------
+
+
+def test_factors_of_plain_literal():
+    fs = mandatory_factors("panic: out of memory")
+    assert fs and fs[0] == b"panic: out of memory"
+
+
+def test_factors_cat_and_star():
+    # The star contributes nothing; both fixed literals survive.
+    fs = mandatory_factors("ERROR.*path=/api/v2/admin")
+    assert any(b"path=/api/v2/admin" in f or f in b"path=/api/v2/admin"
+               for f in fs)
+    assert any(b"ERROR" in f or f in b"ERROR" for f in fs)
+
+
+def test_factors_alternation_common_substring():
+    # "code=" is mandatory in both branches.
+    fs = mandatory_factors("code=503|code=504")
+    assert any(b"code=50" in f or f in b"code=50" for f in fs)
+
+
+def test_guard_or_set_for_alternation():
+    g = guard_factors(parse("FATAL|CRITICAL"))
+    assert g is not None
+    assert any(b"FATAL" in f for f in g)
+    assert any(b"CRIT" in f for f in g)
+
+
+def test_no_guard_for_nullable():
+    assert guard_factors(parse("a*")) is None
+    assert mandatory_factors("x?") == []
+
+
+def test_factor_necessity_property():
+    """Every extracted factor occurs in every matching line; when a
+    guard OR-set exists, every matching line contains >= 1 member."""
+    rng = random.Random(20260803)
+    checked = 0
+    for _ in range(250):
+        pat = _rand_pattern(rng)
+        try:
+            ast = parse(pat)
+            creg = re.compile(pat.encode())
+        except Exception:
+            continue
+        fs = factors_from_ast(ast)
+        guard = guard_factors(ast)
+        for _ in range(8):
+            line = _rand_line(rng)
+            if not creg.search(line):
+                continue
+            for f in fs:
+                assert f in line, (pat, f, line)
+            if guard is not None:
+                assert any(g in line for g in guard), (pat, guard, line)
+            checked += 1
+    assert checked > 50  # the property actually exercised
+
+
+# -- grouping ---------------------------------------------------------
+
+
+def _minted(k):
+    return [f"needle-{i:04d} fired" for i in range(k)]
+
+
+def test_plan_groups_bounds_and_partition():
+    pats = _minted(70) + ["x*", "a+b", r"\d{3}-\d{4}", "(?P<n>a)(?(n)b)"]
+    infos = analyze(pats)
+    plan = plan_groups(infos, max_group_patterns=16, max_group_positions=64)
+    seen = sorted(p for g in plan.groups for p in g)
+    assert seen == list(range(len(pats)))  # exact partition
+    for g, members in enumerate(plan.groups):
+        assert len(members) <= 16
+        pos = [infos[p].positions or 1 for p in members]
+        assert sum(pos) <= 64 or len(members) == 1
+        for p in members:
+            assert plan.group_of[p] == g
+    # Unguarded / unparseable patterns poison ONLY their own groups.
+    for i, info in enumerate(infos):
+        if info.guard is None:
+            assert int(plan.group_of[i]) in plan.always_groups
+    for g in plan.always_groups:
+        assert any(infos[p].guard is None for p in plan.groups[g])
+
+
+def test_group_clustering_by_shared_factor():
+    # Same-guard patterns must land in the same (or adjacent) groups,
+    # not interleave with a foreign family.
+    pats = [f"alpha-{i} x" for i in range(8)] + [f"zeta-{i} y" for i in range(8)]
+    infos = analyze(pats)
+    plan = plan_groups(infos, max_group_patterns=8)
+    g_alpha = {int(plan.group_of[i]) for i in range(8)}
+    g_zeta = {int(plan.group_of[i]) for i in range(8, 16)}
+    assert g_alpha.isdisjoint(g_zeta)
+
+
+# -- the factor-index sweep -------------------------------------------
+
+
+def test_index_candidates_are_necessary():
+    pats = ["ERROR", "panic: hard", "OOM[0-9]+", "disk (full|fail)",
+            "seq=99999", r"latency=49\dms", "FATAL|CRIT", "svc-0001 down"]
+    infos = analyze(pats)
+    plan = plan_groups(infos, max_group_patterns=2)
+    idx = FactorIndex(infos, plan)
+    lines = [b"an ERROR line", b"panic: hard stop", b"OOM123", b"",
+             b"disk fail", b"disk almost", b"seq=99999 latency=492ms",
+             b"CRIT x", b"svc-0001 down", b"benign chatter", b"x" * 300]
+    payload, offsets = _frame(lines)
+    pm = idx.pattern_candidates(payload, offsets)
+    gm = idx.group_candidates(payload, offsets)
+    for i, line in enumerate(lines):
+        for p, pat in enumerate(pats):
+            if re.search(pat.encode(), line):
+                assert pm[i, p], (line, pat)
+                assert gm[i, int(plan.group_of[p])], (line, pat)
+    # Selectivity: the benign line is a candidate for nothing.
+    assert not gm[lines.index(b"benign chatter")].any()
+    st = idx.last_stats
+    assert st.lines == len(lines) and st.groups == plan.n_groups
+    assert 0.0 < st.narrowing_ratio < 1.0
+
+
+def test_index_short_and_boundary_factors():
+    # 3-byte factors ride the 256-extension path; a factor at the very
+    # end of the payload must still be found (don't-care 4th byte).
+    pats = ["x!z", "tail-literal"]
+    infos = analyze(pats)
+    plan = plan_groups(infos)
+    idx = FactorIndex(infos, plan)
+    lines = [b"ax!z", b"x!z", b"no match", b"ends with tail-literal"]
+    payload, offsets = _frame(lines)
+    pm = idx.pattern_candidates(payload, offsets)
+    assert pm[0, 0] and pm[1, 0] and pm[3, 1]
+    assert not pm[2].any()
+
+
+def test_index_no_cross_line_false_negative():
+    # A factor spanning a line boundary in the payload must NOT count
+    # for either line... but a factor fully inside a line always must.
+    pats = ["abcd"]
+    infos = analyze(pats)
+    idx = FactorIndex(infos, plan_groups(infos))
+    lines = [b"ab", b"cd", b"xabcdx"]
+    payload, offsets = _frame(lines)
+    pm = idx.pattern_candidates(payload, offsets)
+    assert not pm[0, 0] and not pm[1, 0]
+    assert pm[2, 0]
+
+
+def test_index_random_property():
+    """Random guarded pattern sets + random lines: the per-pattern
+    candidate matrix never masks a true match (oracle parity on the
+    necessary side)."""
+    rng = random.Random(7)
+    alpha = b"abcdef0123-=/ :"
+    for _ in range(40):
+        pats = []
+        for _ in range(rng.randrange(2, 10)):
+            n = rng.randrange(3, 12)
+            pats.append("".join(chr(alpha[rng.randrange(len(alpha))])
+                                for _ in range(n)))
+        pats = [re.escape(p) for p in pats]
+        infos = analyze(pats)
+        plan = plan_groups(infos, max_group_patterns=3)
+        idx = FactorIndex(infos, plan)
+        lines = []
+        for _ in range(30):
+            body = bytes(alpha[rng.randrange(len(alpha))]
+                         for _ in range(rng.randrange(0, 40)))
+            if rng.random() < 0.4 and pats:
+                p = pats[rng.randrange(len(pats))]
+                body += re.escape(p).encode().replace(b"\\", b"")
+            lines.append(body)
+        payload, offsets = _frame(lines)
+        pm = idx.pattern_candidates(payload, offsets)
+        for i, line in enumerate(lines):
+            for p, pat in enumerate(pats):
+                if re.search(pat.encode(), line):
+                    assert pm[i, p], (pats, line.decode(), pat)
+
+
+# -- IndexedFilter ----------------------------------------------------
+
+MIXED_PATTERNS = [
+    "panic:", "oom-killer", "code=50[34]", "FATAL|CRIT",
+    r"retry \d+/\d+", "disk .*full", "seq=99999", r"latency=49\dms",
+    "svc-0007 unreachable", "tenant-0003.*quota", r"\d{5}-\d{4}",
+    "(?P<a>xx)(?(a)yy)",  # group-ref: stays on K-sequential re
+]
+
+
+def _corpus():
+    lines = [b"panic: oops", b"nothing to see", b"code=503 served",
+             b"CRIT hit", b"retry 3/5 backing off", b"disk is full",
+             b"seq=99999", b"latency=492ms tail", b"svc-0007 unreachable",
+             b"tenant-0003 hit quota", b"zip 12345-6789", b"xxyy", b"",
+             b"benign " * 20]
+    return lines * 9
+
+
+def test_indexed_filter_matches_re_oracle():
+    lines = _corpus()
+    filt = IndexedFilter(MIXED_PATTERNS, max_group_patterns=3)
+    exp = RegexFilter(MIXED_PATTERNS).match_lines(lines)
+    assert filt.match_lines(lines) == exp
+    assert 0.0 < filt.narrowing_ratio < 1.0
+    assert sum(filt.engine_kinds.values()) == len(filt.groups)
+    assert filt.engine_kinds.get("re", 0) >= 1  # the group-ref group
+
+
+def test_indexed_scan_all_comparator_parity():
+    lines = _corpus()
+    filt = IndexedFilter(MIXED_PATTERNS, max_group_patterns=3)
+    narrowed = filt.match_lines(lines)
+    filt.narrow = False
+    assert filt.match_lines(lines) == narrowed
+
+
+def test_indexed_filter_random_property():
+    rng = random.Random(20260803)
+    for _ in range(25):
+        pats = []
+        while len(pats) < rng.randrange(3, 12):
+            p = _rand_pattern(rng)
+            try:
+                re.compile(p.encode())
+            except re.error:
+                continue
+            pats.append(p)
+        lines = [_rand_line(rng) for _ in range(40)]
+        filt = IndexedFilter(pats, max_group_patterns=4, cache=False)
+        got = filt.match_lines(lines)
+        for line, v in zip(lines, got):
+            assert v == oracle(pats, line), (pats, line)
+
+
+def test_indexed_filter_framed_dispatch():
+    lines = _corpus()
+    payload, offsets = _frame(lines)
+    filt = IndexedFilter(MIXED_PATTERNS)
+    got = filt.fetch_framed(filt.dispatch_framed(payload, offsets))
+    assert got.tolist() == RegexFilter(MIXED_PATTERNS).match_lines(lines)
+
+
+def test_best_host_filter_auto_switch(monkeypatch):
+    monkeypatch.delenv("KLOGS_CPU_ENGINE", raising=False)
+    # Below the threshold: the single-DFA path, byte-identical to the
+    # pre-index engine selection (the K=32 no-regression guarantee).
+    filt, kind = best_host_filter([f"lit{i:02d}" for i in range(8)])
+    assert kind == "dfa"
+    monkeypatch.setenv("KLOGS_INDEX_MIN_K", "8")
+    filt, kind = best_host_filter([f"lit{i:02d}" for i in range(8)])
+    assert kind == "indexed"
+    assert filt.match_lines([b"lit03", b"nope"]) == [True, False]
+    monkeypatch.setenv("KLOGS_CPU_ENGINE", "dfa")
+    _, kind = best_host_filter([f"lit{i:02d}" for i in range(8)])
+    assert kind == "dfa"
+    monkeypatch.setenv("KLOGS_CPU_ENGINE", "indexed")
+    _, kind = best_host_filter(["onlyone"])
+    assert kind == "indexed"
+
+
+# -- global slot allocation (starvation regression) -------------------
+
+
+def test_slot_allocation_no_starvation():
+    """At a K where per-pattern clause demand overflows MAX_PAIR_SLOTS,
+    every pattern must still get req bits (rank-0 clauses allocate
+    before ANY pattern's rank-1) — under first-pattern-wins the tail
+    patterns got nothing and gating silently shut off for everyone."""
+    rng = random.Random(3)
+    alpha = "abcdefghijklmnopqrstuvwxyz0123456789:=/-_"
+    pats = ["".join(rng.choice(alpha) for _ in range(10))
+            for _ in range(120)]
+    pf = compile_prefilter(pats)
+    assert pf.usable, "tail patterns starved: gating disabled"
+    # Every pattern row demands at least one clause slot.
+    assert (pf.req != 0).any(axis=1).all()
+    # Necessity: a line containing the LAST pattern is its candidate.
+    lines = [pats[-1].encode(), pats[0].encode(), b"unrelated filler"]
+    m = candidate_matrix_host(pf, lines)
+    assert m[0, len(pats) - 1]
+    assert m[1, 0]
+    assert candidates_host(pf, lines)[:2] == [True, True]
+    # Selectivity survives: the unrelated line passes nothing.
+    assert not m[2].any()
+
+
+# -- LRU DFA table cache ----------------------------------------------
+
+
+def test_dfa_cache_hit_miss_events(tmp_path, monkeypatch):
+    from klogs_tpu.filters.compiler.dfa import build_dfa_cached
+
+    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path))
+    events = []
+    t1 = build_dfa_cached(["alpha[0-9]+"], on_event=events.append)
+    assert t1 is not None and events == ["miss"]
+    events.clear()
+    t2 = build_dfa_cached(["alpha[0-9]+"], on_event=events.append)
+    assert events == ["hit"]
+    assert np.array_equal(t1.table, t2.table)
+    assert np.array_equal(t1.accept, t2.accept)
+
+
+def test_dfa_cache_lru_eviction(tmp_path, monkeypatch):
+    from klogs_tpu.filters.compiler.dfa import build_dfa_cached
+
+    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path))
+    events = []
+    sets = [[f"evict-test-{i:02d}-[a-z]+x"] for i in range(5)]
+    for s in sets:
+        build_dfa_cached(s, on_event=events.append)
+        time.sleep(0.02)  # distinct mtimes: deterministic LRU order
+    assert events == ["miss"] * 5
+    cache = tmp_path / "klogs-tpu"
+    per_table = max(f.stat().st_size
+                    for f in cache.glob("dfa-*.npz"))
+    # Cap to ~2 tables and write one more: the OLDEST go, the newly
+    # written table (keep) and the freshest survive.
+    monkeypatch.setenv("KLOGS_DFA_CACHE_MB",
+                       str(2.5 * per_table / 1048576))
+    events.clear()
+    build_dfa_cached(["evict-test-05-[a-z]+x"], on_event=events.append)
+    assert events[0] == "miss" and events.count("evict") >= 3
+    names = {f.name for f in cache.glob("dfa-*.npz")}
+    # The just-written table is never evicted.
+    events.clear()
+    build_dfa_cached(["evict-test-05-[a-z]+x"], on_event=events.append)
+    assert events == ["hit"]
+    # The oldest table was evicted; the set rebuilds on demand.
+    events.clear()
+    build_dfa_cached(sets[0][0:1], on_event=events.append)
+    assert events[0] == "miss"
+    assert len(names) <= 3
+
+
+def test_dfa_cache_cap_rejects_nonpositive(monkeypatch):
+    """A negative/zero/nan KLOGS_DFA_CACHE_MB would turn the LRU into
+    evict-everything-on-every-write (warm starts silently recompile the
+    world); misconfigured values fall back to the default cap."""
+    from klogs_tpu.filters.compiler.dfa import (
+        DEFAULT_CACHE_MB,
+        _cache_cap_bytes,
+    )
+
+    default = DEFAULT_CACHE_MB * 1048576
+    for bad in ("-1", "0", "nan", "inf", "-inf", "bogus"):
+        monkeypatch.setenv("KLOGS_DFA_CACHE_MB", bad)
+        assert _cache_cap_bytes() == default, bad
+    monkeypatch.setenv("KLOGS_DFA_CACHE_MB", "64")
+    assert _cache_cap_bytes() == 64 * 1048576
+
+
+def test_indexed_warm_start_skips_recompile(tmp_path, monkeypatch):
+    """Second IndexedFilter build of the same set must be all cache
+    hits, zero misses — the K=4096 cold-start acceptance, exercised at
+    a tier-1-friendly K (the slow K=4096 twin below runs the real
+    thing)."""
+    from klogs_tpu.obs.metrics import Registry
+
+    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path))
+    pats = _minted(48)
+
+    def cache_events(reg):
+        fam = reg.family("klogs_prefilter_table_cache_events_total")
+        return {k: fam.labels(event=k).value
+                for k in ("hit", "miss", "evict")}
+
+    r1 = Registry()
+    f1 = IndexedFilter(pats, registry=r1)
+    ev1 = cache_events(r1)
+    # A miss is an ATTEMPT: every group tries the DFA engine first;
+    # the ones that overflow the state budget degrade (no table
+    # written) and re-attempt on every build — only successful
+    # determinizations are cached, so warm misses = non-DFA groups.
+    n_dfa = f1.engine_kinds.get("dfa", 0)
+    n_attempts = len(f1.groups)
+    assert n_dfa >= 1 and ev1["miss"] == n_attempts and ev1["hit"] == 0
+    r2 = Registry()
+    f2 = IndexedFilter(pats, registry=r2)
+    ev2 = cache_events(r2)
+    assert ev2["miss"] == n_attempts - n_dfa and ev2["hit"] == n_dfa
+    lines = [b"needle-0031 fired", b"noise"]
+    assert f1.match_lines(lines) == f2.match_lines(lines) == [True, False]
+
+
+@pytest.mark.slow
+def test_k4096_grouped_compile_and_warm_start(tmp_path, monkeypatch):
+    """The full acceptance: K=4096 compiles grouped (no subset-
+    construction blowup, RSS bounded), and a warm-cache cold start
+    skips recompilation entirely."""
+    import resource
+    import sys
+
+    from bench import make_patterns
+    from klogs_tpu.obs.metrics import Registry
+
+    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path))
+    pats = make_patterns(4096)
+    r1 = Registry()
+    t0 = time.perf_counter()
+    f1 = IndexedFilter(pats, registry=r1)
+    cold_s = time.perf_counter() - t0
+    assert len(f1.groups) >= 128  # genuinely grouped, no union automaton
+    rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / (
+        1024 * 1024 if sys.platform == "darwin" else 1024)
+    assert rss_mb < 4096, f"peak RSS {rss_mb:.0f} MiB"
+    fam = r1.family("klogs_prefilter_table_cache_events_total")
+    n_dfa = f1.engine_kinds.get("dfa", 0)
+    n_attempts = len(f1.groups)
+    assert n_dfa >= 64
+    assert fam.labels(event="miss").value == n_attempts
+    r2 = Registry()
+    t0 = time.perf_counter()
+    IndexedFilter(pats, registry=r2)
+    warm_s = time.perf_counter() - t0
+    fam2 = r2.family("klogs_prefilter_table_cache_events_total")
+    # Every determinized table loads from the cache; only the groups
+    # that can never cache (state-budget overflow) re-attempt.
+    assert fam2.labels(event="hit").value == n_dfa
+    assert fam2.labels(event="miss").value == n_attempts - n_dfa
+    assert warm_s < cold_s, (warm_s, cold_s)
+
+
+# -- host-vs-device candidate-matrix parity ---------------------------
+
+
+def _pack(lines, width):
+    from klogs_tpu.filters.tpu import pack_lines
+
+    batch, lengths = pack_lines(lines, width)
+    return batch, lengths
+
+
+def test_candidate_matrix_device_parity_byte_domain():
+    from klogs_tpu.ops.prefilter import candidate_matrix, device_tables
+
+    rng = random.Random(11)
+    for trial in range(6):
+        pats, lines = _parity_case(rng, trial)
+        pf = compile_prefilter(pats)
+        if not pf.usable:
+            continue
+        host = candidate_matrix_host(pf, lines)
+        batch, lengths = _pack(lines, 64)
+        dev = np.asarray(candidate_matrix(
+            device_tables(pf), batch, lengths))[:len(lines)]
+        assert dev.shape[1] == len(pats)
+        assert (dev == host).all(), (pats, trial)
+        _assert_necessary(pats, lines, host)
+
+
+def test_candidate_matrix_device_parity_class_domain():
+    from klogs_tpu.ops import nfa
+    from klogs_tpu.ops.prefilter import (
+        candidate_matrix_from_cls,
+        class_tables,
+        group_candidates,
+        pattern_group_onehot,
+    )
+
+    rng = random.Random(12)
+    for trial in range(6):
+        pats, lines = _parity_case(rng, trial)
+        pf = compile_prefilter(pats)
+        if not pf.usable:
+            continue
+        try:
+            dp, live, acc = nfa.compile_grouped(pats, max_positions=24)
+        except Exception:
+            continue
+        ct = class_tables(pf, dp.byte_class, dp.n_classes)
+        if ct is None:
+            continue
+        from klogs_tpu.filters.tpu import pack_classify
+
+        table = np.asarray(dp.byte_class).astype(np.int8)
+        cls = pack_classify(lines, 64, table, dp.begin_class,
+                            dp.end_class, dp.pad_class)[:len(lines)]
+        host = candidate_matrix_host(pf, lines)
+        dev = np.asarray(candidate_matrix_from_cls(ct, cls))
+        assert (dev[:, :len(pats)] == host).all(), (pats, trial)
+        _assert_necessary(pats, lines, host)
+        # The group reduction agrees with a host-side reduction
+        # through the same pattern -> kernel-group map.
+        G = int(np.asarray(dp.char_mask).shape[0])
+        oh = pattern_group_onehot(dp.pattern_group, G)
+        gm = np.asarray(group_candidates(dev, oh, len(pats)))
+        pg = np.asarray(dp.pattern_group)
+        for g in range(G):
+            cols = host[:, pg == g]
+            want = cols.any(axis=1) if cols.shape[1] else np.zeros(
+                len(lines), dtype=bool)
+            assert (gm[:, g] == want).all()
+
+
+def _parity_case(rng, trial):
+    """One random pattern set + line corpus for the parity sweeps —
+    mixes the realistic needle shapes with random supported-subset
+    patterns, and lines with planted needles."""
+    base = ["panic:", "code=50[34]", "FATAL|CRIT", r"retry \d+/\d+",
+            "svc-0001 unreachable", "seq=99999"]
+    pats = list(base[: 2 + trial])
+    for _ in range(trial):
+        p = _rand_pattern(rng)
+        try:
+            re.compile(p.encode())
+            parse(p)
+        except Exception:
+            continue
+        pats.append(p)
+    lines = [b"panic: x", b"fine", b"code=504", b"FATAL boom",
+             b"retry 9/9", b"svc-0001 unreachable", b"seq=99999", b""]
+    lines += [_rand_line(rng) for _ in range(16)]
+    return pats, lines
+
+
+def _assert_necessary(pats, lines, host):
+    for i, line in enumerate(lines):
+        for p, pat in enumerate(pats):
+            if re.search(pat.encode(), line):
+                assert host[i, p], (pat, line)
+
+
+def test_gated_tile_group_kernel_parity():
+    """The per-(tile, group) gated Pallas path must agree with the
+    plain kernel and the re oracle across tile sizes — a wrong
+    pattern_group map or flag layout shows up as a false negative
+    here."""
+    from klogs_tpu.filters.tpu import pack_classify
+    from klogs_tpu.ops import nfa
+    from klogs_tpu.ops.pallas_nfa import match_cls_grouped_pallas
+    from klogs_tpu.ops.prefilter import class_tables
+
+    rng = np.random.default_rng(0)
+    pats = ["ERROR", "panic:", "OOM[0-9]+", "disk (full|fail)",
+            "conn reset", "timeout=[0-9]+ms", "CRIT-00[0-9]",
+            "segfault at 0x[0-9a-f]+"]
+    dp, live, acc = nfa.compile_grouped(pats, max_positions=24)
+    assert len(set(dp.pattern_group)) >= 3  # genuinely multi-group
+    words = [b"the quick brown fox", b"ERROR something", b"panic: bad",
+             b"OOM123 kill", b"disk full now", b"conn reset by peer",
+             b"timeout=55ms", b"CRIT-007 x", b"segfault at 0xdeadbeef",
+             b"benign line ok", b"nothing here"]
+    lines = [words[rng.integers(len(words))] + b" " + str(i).encode()
+             for i in range(300)]
+    table = np.asarray(dp.byte_class).astype(np.int8)
+    cls = pack_classify(lines, 64, table, dp.begin_class, dp.end_class,
+                        dp.pad_class)[: len(lines)]
+    pf = compile_prefilter(pats)
+    ct = class_tables(pf, dp.byte_class, dp.n_classes)
+    assert pf.usable and ct is not None
+    exp = RegexFilter(pats).match_lines(lines)
+    for tile in (8, 64):
+        gated = np.asarray(match_cls_grouped_pallas(
+            dp, live, acc, cls, tile_b=tile, interpret=True,
+            prefilter_tables=ct))
+        assert gated.tolist() == exp, f"tile={tile}"
+
+
+def test_mesh_stack_clears_pattern_group():
+    """Sharded mesh programs stack per-shard DevicePrograms whose
+    pattern_group aux differs; the stack must clear it uniformly (mesh
+    gating stays per-tile) instead of failing the stack."""
+    from klogs_tpu.ops import nfa
+
+    dp1, _, _ = nfa.compile_grouped(["aaa", "bbb"], max_positions=8)
+    dp2, _, _ = nfa.compile_grouped(["ccc", "ddd"], max_positions=8)
+    assert dp1.pattern_group and dp2.pattern_group
+    import dataclasses
+
+    cleared = dataclasses.replace(dp1, pattern_group=())
+    assert cleared.pattern_group == ()
+    # aux equality is what jnp.stack-by-tree requires:
+    c2 = dataclasses.replace(dp2, pattern_group=())
+    assert cleared.tree_flatten()[1][:6] == c2.tree_flatten()[1][:6]

@@ -221,6 +221,28 @@ def test_http_sidecar_metrics_and_health_transitions():
     asyncio.run(run())
 
 
+def test_health_warmup_does_not_override_drain():
+    """mark_warm (the warmup-batch gate) must not un-drain a server: a
+    rolling restart can issue set_ready(False) the moment the process
+    is up, BEFORE the warmup batch lands — the late warmup completing
+    must leave readiness off (this raced in the sharded-tier drain
+    test). An explicit set_ready(True) still lifts the drain."""
+    h = Health()
+    assert h.readiness()[0] is False
+    h.mark_warm()  # normal cold start: warmup flips readiness on
+    assert h.readiness()[0] is True
+
+    h2 = Health()
+    h2.set_ready(False)  # drain arrives while still warming
+    h2.mark_warm()  # warmup lands late
+    assert h2.readiness()[0] is False, "warmup un-drained the server"
+    h2.set_ready(True)  # operator decision beats the latch
+    assert h2.readiness()[0] is True
+    h2.set_ready(False)
+    h2.mark_warm()
+    assert h2.readiness()[0] is False
+
+
 def test_http_sidecar_survives_garbage_requests():
     """A header line past the StreamReader limit (or any parse
     garbage) must drop the connection quietly — no unhandled-task

@@ -946,17 +946,38 @@ def test_readyz_drain_and_rejoin():
             # Both servers warm up (readiness flips on the warmup
             # batch); wait until the prober has seen them ready.
             async def until(pred):
-                for _ in range(100):
+                # 15s budget (scenario cap is 30s): a loaded CI box can
+                # stall the 0.03s prober well past the transition point.
+                for _ in range(300):
                     if pred():
                         return True
                     await asyncio.sleep(0.05)
                 return False
 
+            def state():
+                t = sc._probe_task
+                return (
+                    [(ep.target, ep.ready, ep.verified, ep.quarantined,
+                      ep.readyz) for ep in sc._endpoints],
+                    [(s.metrics_host, s.metrics_port, s.health._ready)
+                     for s in servers],
+                    None if t is None else
+                    (t.done(), t.exception() if t.done()
+                     and not t.cancelled() else None),
+                )
+
+            # Wait for the warmup batches to actually land (ep.ready
+            # defaults True, so the prober's view alone cannot prove
+            # warmth): draining before warmup completes exercises the
+            # mark_warm latch path, not the rejoin path under test.
             assert await until(
-                lambda: all(ep.ready for ep in sc._endpoints))
+                lambda: all(s.health._ready for s in servers)), state()
+            assert await until(
+                lambda: all(ep.ready for ep in sc._endpoints)), state()
             # Drain server B: readiness off, gRPC still serving.
             servers[1].health.set_ready(False)
-            assert await until(lambda: not sc._endpoints[1].ready)
+            assert await until(
+                lambda: not sc._endpoints[1].ready), state()
             before_b = batches.labels(endpoint=targets[1]).value
             for _ in range(4):
                 assert await sc.match([b"an ERROR", b"ok"]) == [True, False]
