@@ -113,11 +113,98 @@ def make_patterns(k: int) -> "list[str]":
     return out[:k]
 
 
+def bench_sweep_row(filt, payload: bytes, offsets, k: int,
+                    repeats: int) -> dict:
+    """Sweep-STAGE-only throughput for one K (BENCH_SWEEP.json): the
+    host factor sweep vs the device sweep over the same framed corpus,
+    so the narrowing stage has its own trajectory separate from the
+    end-to-end rows in BENCH_K.json.
+
+    The device number is measured on whatever jax backend is up —
+    recorded in the row, because on the CPU backend the dense sweep is
+    gather-bound and LOSES to the host sweep (that measurement is why
+    auto mode only flips the device path on real accelerators). The
+    row also re-asserts host/device mask parity on the bench corpus:
+    a throughput row for a sweep that disagrees would be noise. On a
+    cpu-only install (jax is the optional [tpu] extra) the device half
+    degrades to nulls — the host trajectory is meaningful alone."""
+    import numpy as np
+
+    from klogs_tpu.filters.base import pack_framed_rows
+
+    n = len(offsets) - 1
+    host_best, gm_host = 0.0, None
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        gm_host = filt.index.group_candidates(payload, offsets)
+        host_best = max(host_best, n / (time.perf_counter() - t0))
+
+    row = {
+        "k": k,
+        "n_lines": n,
+        "host_sweep_lps": round(host_best, 1),
+        "device_sweep_lps": None,
+        "device_vs_host": None,
+        "pack_lps": None,
+        "backend": None,
+        "parity": None,
+        "n_factors": filt.index.n_factors,
+        "n_groups": len(filt.groups),
+    }
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from klogs_tpu.ops.sweep import (
+            device_sweep_tables,
+            sweep_group_candidates,
+        )
+    except ImportError:
+        print(f"bench: K={k} sweep host={host_best:,.0f} l/s "
+              "device=unavailable (no jax)", file=sys.stderr)
+        return row
+
+    st = device_sweep_tables(filt.index.sweep_program())
+    lens = np.diff(np.asarray(offsets)).astype(np.int32)
+    width = 128
+    while width < int(lens.max() if n else 1):
+        width *= 2
+    t0 = time.perf_counter()
+    batch, _ = pack_framed_rows(payload, offsets, width)
+    pack_lps = n / (time.perf_counter() - t0)
+    batch_d = jnp.asarray(batch)
+    lens_d = jnp.asarray(lens)
+    gm_dev = np.asarray(sweep_group_candidates(st, batch_d, lens_d))
+    dev_best = 0.0
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(
+            sweep_group_candidates(st, batch_d, lens_d))
+        dev_best = max(dev_best, n / (time.perf_counter() - t0))
+    parity = bool(np.array_equal(gm_host, gm_dev))
+    row.update({
+        "device_sweep_lps": round(dev_best, 1),
+        "device_vs_host": round(dev_best / host_best, 3)
+        if host_best else None,
+        "pack_lps": round(pack_lps, 1),
+        "backend": jax.default_backend(),
+        "parity": parity,
+    })
+    print(f"bench: K={k} sweep host={host_best:,.0f} l/s "
+          f"device[{row['backend']}]={dev_best:,.0f} l/s "
+          f"parity={parity}", file=sys.stderr)
+    return row
+
+
 def bench_k_axis(ks=None, n_lines: "int | None" = None,
-                 repeats: "int | None" = None) -> dict:
+                 repeats: "int | None" = None,
+                 sweep_rows: "list | None" = None) -> dict:
     """One row per K (module comment above). Returns the BENCH_K
     payload; env knobs KLOGS_BENCH_K (comma-separated Ks),
-    KLOGS_BENCH_K_LINES, KLOGS_BENCH_REPEATS shrink smoke runs."""
+    KLOGS_BENCH_K_LINES, KLOGS_BENCH_REPEATS shrink smoke runs.
+    ``sweep_rows``, when a list, additionally collects the per-K
+    sweep-stage-only rows (bench_sweep_row) for BENCH_SWEEP.json —
+    measured here so the K=4096 index build is paid once."""
     import numpy as np
 
     from klogs_tpu.filters.base import frame_lines
@@ -147,8 +234,22 @@ def bench_k_axis(ks=None, n_lines: "int | None" = None,
     for k in ks:
         pats = make_patterns(k)
         t0 = time.perf_counter()
-        filt = IndexedFilter(pats)
+        # sweep="host" pins the K rows to the HOST narrowing stage on
+        # every machine: bench_sweep_row imports jax, which would flip
+        # later Ks' auto mode onto the device sweep on an accelerator
+        # host and mix two narrowing stages across one trajectory (the
+        # device stage has its own rows in BENCH_SWEEP.json).
+        filt = IndexedFilter(pats, sweep="host")
         build_s = time.perf_counter() - t0
+        # Pin the adaptive bypass OFF for the measurement: the K=32
+        # row's ratio (0.67) trips it mid-run, and a bypassed filter
+        # times scan-all while the row claims to time the index. The
+        # bypass is the production remedy for that row, not part of
+        # the index-vs-scan-all comparison (it has its own tests).
+        filt._bypass_min_lines = 1 << 62
+        if sweep_rows is not None:
+            sweep_rows.append(
+                bench_sweep_row(filt, payload, offsets, k, repeats))
         idx_lps, idx_matched = rate(filt)
         ratio = filt.narrowing_ratio
         # Scan-all comparator: SAME groups/tables, narrowing off.
@@ -453,11 +554,26 @@ def _device_subprocess(timeout_s: float):
 
 def main() -> None:
     if "--k-axis" in sys.argv[1:]:
-        payload = bench_k_axis()
+        sweep_rows: list = []
+        payload = bench_k_axis(sweep_rows=sweep_rows)
         out_path = os.environ.get("KLOGS_BENCH_K_OUT") or os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "BENCH_K.json")
         with open(out_path, "w") as f:
             json.dump(payload, f, indent=1)
+            f.write("\n")
+        sweep_payload = {
+            "metric": "narrowing-stage-only lines/sec: host factor "
+                      "sweep vs device literal sweep, per K (masks "
+                      "parity-checked on the corpus)",
+            "unit": "lines/sec",
+            "corpus": payload["corpus"],
+            "rows": sweep_rows,
+        }
+        sweep_out = os.environ.get("KLOGS_BENCH_SWEEP_OUT") or \
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_SWEEP.json")
+        with open(sweep_out, "w") as f:
+            json.dump(sweep_payload, f, indent=1)
             f.write("\n")
         print(json.dumps(payload))
         return

@@ -66,6 +66,13 @@ class FilterStats:
         self._bucket_width = r.family("klogs_engine_bucket_width_bytes")
         self._pad_bytes = r.family("klogs_engine_pad_bytes_total")
         self._payload_bytes = r.family("klogs_engine_payload_bytes_total")
+        # Device-sweep visibility (thousand-pattern fused path): which
+        # narrowing stage ran, what it let through, and the degrade /
+        # bypass events an operator needs to explain a throughput step.
+        self._sweep_batches = r.family("klogs_sweep_batches_total")
+        self._sweep_lines = r.family("klogs_sweep_lines_total")
+        self._sweep_cand = r.family("klogs_sweep_candidate_lines_total")
+        self._sweep_fallback = r.family("klogs_sweep_fallback_total")
         # Degrade-policy visibility (--on-filter-error, resilience):
         # batches/lines that bypassed or skipped filtering because the
         # filter service was unavailable.
@@ -153,6 +160,20 @@ class FilterStats:
         self._pf_candidates.inc(n_candidates)
         self._pf_tiles.inc(n_tiles)
         self._pf_tiles_live.inc(n_tiles_live)
+
+    def record_sweep(self, path: str, n_lines: int,
+                     n_candidates: int) -> None:
+        """One batch narrowed by the literal sweep: ``path`` is which
+        stage ran (device = fused on-device sweep, host = host factor
+        sweep)."""
+        self._sweep_batches.labels(path=path).inc()
+        self._sweep_lines.labels(path=path).inc(n_lines)
+        self._sweep_cand.labels(path=path).inc(n_candidates)
+
+    def record_sweep_fallback(self) -> None:
+        """The device sweep degraded (build or kernel failure) and the
+        batch ran on the fallback path instead."""
+        self._sweep_fallback.inc()
 
     def record_queue_wait(self, wait_s: float) -> None:
         self._queue.observe(wait_s)
@@ -246,6 +267,37 @@ def frame_lines(lines: list[bytes], strip_nl: bool = True):
         offsets[1:] = np.cumsum(
             np.fromiter((len(b) for b in bodies), np.int32, len(bodies)))
     return b"".join(bodies), offsets, raw
+
+
+def pack_framed_rows(payload: bytes, offsets, width: int,
+                     rows: "int | None" = None):
+    """Framed batch -> ([rows, width] u8 zero-padded row batch,
+    [B] int64 lens): the vectorized ragged scatter that turns the
+    collector's contiguous payload into the packed row layout device
+    kernels consume (the inverse of frame_lines, minus the padding).
+    Every payload byte's destination is its row stride minus the
+    source line start — one fancy-indexed assignment, no per-line
+    PyBytes. ``rows`` >= B pads extra zero rows (jit-cache row
+    bucketing); rows beyond B and columns beyond each line stay zero.
+    Callers must ensure every line fits ``width``. Shared by the
+    IndexedFilter device-sweep path and bench.py so the bench times
+    the SAME packer production runs."""
+    import numpy as np
+
+    lens = np.diff(np.asarray(offsets)).astype(np.int64)
+    B = len(lens)
+    if rows is None:
+        rows = B
+    batch = np.zeros((rows, width), dtype=np.uint8)
+    if int(offsets[-1]) - int(offsets[0]):
+        arr = np.frombuffer(payload, dtype=np.uint8)
+        starts = np.asarray(offsets[:-1], dtype=np.int64)
+        row_base = np.arange(B, dtype=np.int64) * width
+        shift = np.repeat(row_base - starts, lens)
+        src = np.arange(int(offsets[0]), int(offsets[-1]),
+                        dtype=np.int64)
+        batch.reshape(-1)[src + shift] = arr[src]
+    return batch, lens
 
 
 def split_frame(payload: bytes, offsets) -> list[bytes]:

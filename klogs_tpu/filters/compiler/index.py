@@ -59,6 +59,7 @@ K-axis (BENCH_K.json) quantifies exactly this gap.
 """
 
 from dataclasses import dataclass
+from typing import Optional
 
 import numpy as np
 
@@ -71,6 +72,15 @@ GRAM = 3
 # factors that fit one, the narrow tier (one code) for the rest.
 WIDE = 8
 NARROW = 4
+# Longest factor the sweep verifies — BY DEFINITION the factor
+# extractor's truncation bound (over-long guards only exist because
+# guard_factors returns un-truncated exact literals): such literals
+# are cut to their rarest window of this width at index build — still
+# a necessary condition, and it bounds the device verify at
+# SWEEP_FACTOR_CAP/4 word compares.
+from klogs_tpu.filters.compiler.factors import (  # noqa: E402
+    MAX_FACTOR_LEN as SWEEP_FACTOR_CAP,
+)
 # Bloom fold width: 2^16 bytes = 64 KiB per table, cache-resident,
 # ~1.5% load even at K=4096 (~one anchored code per factor) — and the
 # fold is the HIGH uint16 half of a Fibonacci multiply, readable as a
@@ -171,6 +181,16 @@ class FactorIndex:
         by_factor: "dict[bytes, list[int]]" = {}
         for info in infos:
             for f in info.guard or ():
+                # Over-long factors (un-truncated exact literals) sweep
+                # as their rarest SWEEP_FACTOR_CAP-byte window: a
+                # substring of a mandatory literal is itself mandatory,
+                # so necessity is preserved, and the cap bounds the
+                # verify word count on BOTH the host and device paths
+                # (the two must verify identical bytes for the device
+                # mask to equal the host mask bit for bit).
+                if len(f) > SWEEP_FACTOR_CAP:
+                    at = _anchor(f, SWEEP_FACTOR_CAP)
+                    f = f[at : at + SWEEP_FACTOR_CAP]
                 by_factor.setdefault(f, []).append(info.index)
         self.factors: "list[bytes]" = sorted(by_factor)
         self.pattern_ids: "list[np.ndarray]" = [
@@ -180,6 +200,15 @@ class FactorIndex:
             for pids in self.pattern_ids]
         self._factor_arrs = [
             np.frombuffer(f, dtype=np.uint8) for f in self.factors]
+        # Guarded = appears in some factor's pattern set (every guard
+        # member lists its patterns, so any guarded pattern is covered).
+        # The complement drives always-candidate masks for BOTH the
+        # plan-group sweep and any re-targeted device sweep program.
+        self.guarded = np.zeros(self.n_patterns, dtype=bool)
+        for pids in self.pattern_ids:
+            self.guarded[pids] = True
+        self._group_of = np.asarray(plan.group_of, dtype=np.int32)
+        self._sweep_prog: "Optional[SweepProgram]" = None
 
         # Stage-1 union bloom (one gather gates everything) + per-tier
         # discrimination blooms consulted only at surviving positions.
@@ -356,10 +385,209 @@ class FactorIndex:
         group matrix."""
         B = len(offsets) - 1
         pm = np.zeros((B, self.n_patterns), dtype=bool)
-        guarded = np.zeros(self.n_patterns, dtype=bool)
-        for pids in self.pattern_ids:
-            guarded[pids] = True
-        pm[:, ~guarded] = True
+        pm[:, ~self.guarded] = True
         for fi, lines in self._hits(payload, offsets):
             pm[np.ix_(lines, self.pattern_ids[fi])] = True
         return pm
+
+    # -- device sweep compilation ------------------------------------
+
+    def sweep_program(self, group_of: "np.ndarray | None" = None,
+                      n_groups: "int | None" = None) -> "SweepProgram":
+        """Pack this index into the device-resident sweep tables
+        (SweepProgram; consumed by klogs_tpu.ops.sweep).
+
+        ``group_of`` retargets the factor -> group mapping: the default
+        (None) packs against this index's OWN plan groups — the tier
+        whose host twin is ``group_candidates`` and the parity oracle —
+        while a caller fusing with the Pallas NFA kernel passes the
+        grouped DeviceProgram's ``pattern_group`` map so the mask gates
+        (tile, kernel-group) grid cells directly. Groups holding any
+        UNGUARDED pattern land in ``always_mask`` (candidates for every
+        line) under either mapping, so necessity is preserved exactly
+        as on the host.
+
+        Two probe tiers mirror the host sweep: factors >= WIDE key on
+        the MIX of their two chained half-window codes (hi * FIB ^ lo —
+        a 64-bit identity folded to one u32 key; collisions only deepen
+        a bucket, the exact verify keeps the mask identical), shorter
+        factors on their single narrow code. Without the wide mix,
+        minted rule families sharing a rarest window funnel into one
+        bucket and the device's STATIC probe loop pays the depth on
+        every position (measured: max bucket 137 at K=1024 single-tier
+        vs 2 two-tier). Factor bytes are packed as little-endian u32
+        words + byte masks so the verify compares against the rolling
+        code array itself — ceil(len/4) passes, not len.
+
+        Codes are LITTLE-ENDIAN regardless of host byte order (the
+        device builds its rolling codes from explicit byte shifts, so
+        the layout must not depend on where the tables were packed).
+        The default-map program is built once and cached."""
+        default = group_of is None and n_groups is None
+        if default and self._sweep_prog is not None:
+            return self._sweep_prog
+        gof = (self._group_of if group_of is None
+               else np.asarray(group_of, dtype=np.int32))
+        if len(gof) != self.n_patterns:
+            raise ValueError(
+                f"group_of maps {len(gof)} patterns, index has "
+                f"{self.n_patterns}")
+        G = int(n_groups) if n_groups is not None else (
+            int(gof.max()) + 1 if len(gof) else 1)
+        G = max(G, 1)
+        GW = (G + 31) // 32
+        always = np.zeros(GW, dtype=np.uint32)
+        for p in np.nonzero(~self.guarded)[0]:
+            g = int(gof[p])
+            always[g // 32] |= np.uint32(1 << (g % 32))
+
+        F = len(self.factors)
+        kmax = max((len(f) for f in self.factors), default=1)
+        n_words = (kmax + 3) // 4
+        fac_len = np.zeros(max(F, 1), dtype=np.int32)
+        fac_words = np.zeros((max(F, 1), n_words), dtype=np.uint32)
+        fac_wmask = np.zeros((max(F, 1), n_words), dtype=np.uint32)
+        fac_groups = np.zeros((max(F, 1), GW), dtype=np.uint32)
+        # (key, fid, anchor) per tier.
+        narrow: "list[tuple[int, int, int]]" = []
+        wide: "list[tuple[int, int, int]]" = []
+
+        def le_code(w: bytes) -> int:
+            return int.from_bytes(w.ljust(4, b"\0"), "little")
+
+        for fi, f in enumerate(self.factors):
+            fac_len[fi] = len(f)
+            for j in range(0, len(f), 4):
+                w = f[j : j + 4]
+                fac_words[fi, j // 4] = le_code(w)
+                fac_wmask[fi, j // 4] = (1 << (8 * len(w))) - 1
+            for g in np.unique(gof[self.pattern_ids[fi]]):
+                fac_groups[fi, int(g) // 32] |= np.uint32(
+                    1 << (int(g) % 32))
+            if len(f) >= WIDE:
+                at = _anchor(f, WIDE)
+                hi, lo = le_code(f[at : at + 4]), le_code(f[at + 4 : at + 8])
+                wide.append((((hi * _FIB) & 0xFFFFFFFF) ^ lo, fi, at))
+            elif len(f) >= NARROW:
+                at = _anchor(f, NARROW)
+                narrow.append((le_code(f[at : at + 4]), fi, at))
+            else:
+                # 3-byte factor: all 256 one-byte extensions, anchor 0
+                # (same don't-care-4th-byte rule as the host tiers; the
+                # device pads each row with 4 zero columns, so the
+                # extension byte exists even at the line's very end).
+                for ext in range(256):
+                    narrow.append((le_code(f + bytes([ext])), fi, 0))
+
+        n_tier = pack_sweep_tier(narrow)
+        w_tier = pack_sweep_tier(wide)
+        # Per-tier verify bound: the narrow tier only holds factors
+        # shorter than WIDE, so its word loop is 2 compares max no
+        # matter how long the wide tier's factors run.
+        n_tier.n_words = max(
+            (int(fac_len[fi]) + 3) // 4 for _, fi, _ in narrow) if narrow \
+            else 0
+        w_tier.n_words = max(
+            (int(fac_len[fi]) + 3) // 4 for _, fi, _ in wide) if wide \
+            else 0
+        prog = SweepProgram(
+            narrow=n_tier, wide=w_tier,
+            fac_len=fac_len, fac_words=fac_words, fac_wmask=fac_wmask,
+            fac_groups=fac_groups, always_mask=always, n_groups=G)
+        if default:
+            self._sweep_prog = prog
+        return prog
+
+
+def pack_sweep_tier(entries: "list[tuple[int, int, int]]",
+                    hash_size: "int | None" = None) -> "SweepTier":
+    """Pack one probe tier's (key, fid, anchor) entries: sorted unique
+    keys with bucketed entry runs, plus the open-addressed hash table
+    the device probes INSTEAD of a binary search (searchsorted lowers
+    to log2 E dependent gather rounds; the hash probe is max_probe
+    independent gathers into a cache/VMEM-resident table — measured
+    ~8x cheaper on XLA CPU, same shape win on the TPU VPU).
+    ``hash_size`` forces the table size (power of two) so mesh shards
+    can be stacked shape-uniform; linear probing, keys unique."""
+    entries = sorted(entries)
+    keys_all = np.asarray([e[0] for e in entries], dtype=np.uint64)
+    fid = np.asarray([e[1] for e in entries] or [0], dtype=np.int32)
+    anchor = np.asarray([e[2] for e in entries] or [0], dtype=np.int32)
+    keys, starts = np.unique(keys_all, return_index=True)
+    bucket_start = np.append(starts, len(entries)).astype(np.int32)
+    max_bucket = int(np.diff(bucket_start).max()) if len(keys) else 0
+    H = hash_size if hash_size is not None else _sweep_hash_size(len(keys))
+    if H & (H - 1) or H < len(keys):
+        raise ValueError(f"hash_size {H} not a power of two >= {len(keys)}")
+    bits = H.bit_length() - 1
+    slot_key = np.zeros(H, dtype=np.uint32)
+    slot_eid = np.full(H, -1, dtype=np.int32)
+    max_probe = 0
+    for eid, k in enumerate(keys):
+        h = ((int(k) * _FIB) & 0xFFFFFFFF) >> (32 - bits)
+        j = 0
+        while slot_eid[(h + j) & (H - 1)] >= 0:
+            j += 1
+        slot_key[(h + j) & (H - 1)] = np.uint32(k)
+        slot_eid[(h + j) & (H - 1)] = eid
+        max_probe = max(max_probe, j + 1)
+    return SweepTier(keys=keys.astype(np.uint32),
+                     bucket_start=bucket_start, fid=fid, anchor=anchor,
+                     slot_key=slot_key, slot_eid=slot_eid,
+                     max_probe=max_probe, max_bucket=max_bucket)
+
+
+def _sweep_hash_size(n_keys: int) -> int:
+    """Power-of-two table ≥ 4x the key count (≤ 25% load keeps linear-
+    probe clusters, and with them the device's unrolled probe depth,
+    short)."""
+    H = 16
+    while H < 4 * n_keys:
+        H *= 2
+    return H
+
+
+@dataclass
+class SweepTier:
+    """One probe tier of a SweepProgram: sorted unique probe keys with
+    bucketed entry runs (factor id + window anchor per entry), the
+    open-addressed hash table the device probes (slot_eid -1 = empty),
+    and the static loop bounds — deepest probe cluster, deepest
+    bucket, widest verify in u32 words."""
+
+    keys: np.ndarray         # [E] u32, sorted unique
+    bucket_start: np.ndarray  # [E+1] i32
+    fid: np.ndarray          # [NE] i32 (min length 1)
+    anchor: np.ndarray       # [NE] i32
+    slot_key: np.ndarray     # [H] u32 (H a power of two)
+    slot_eid: np.ndarray     # [H] i32, -1 = empty slot
+    max_probe: int
+    max_bucket: int
+    n_words: int = 0
+
+
+@dataclass
+class SweepProgram:
+    """Host-packed tables for the DEVICE literal sweep (compiled once
+    per pattern set; klogs_tpu.ops.sweep turns them into device arrays
+    and runs the jitted sweep).
+
+    Layout: two SweepTiers — ``narrow`` keyed on the factor's rarest
+    little-endian 4-byte window code (3-byte factors: all 256
+    extensions), ``wide`` keyed on the Fibonacci mix of the two chained
+    half-window codes of the rarest 8-byte window. ``fac_len`` /
+    ``fac_words`` / ``fac_wmask`` carry the full factor bytes as padded
+    u32 words + byte masks for the exact on-device verify, and
+    ``fac_groups`` is each factor's target-group BITSET (32 groups per
+    uint32 lane). ``always_mask`` holds groups owning unguarded
+    patterns. No bloom ships to the device: the dense exact probe IS
+    the gate there (ops/sweep.py module docstring)."""
+
+    narrow: SweepTier
+    wide: SweepTier
+    fac_len: np.ndarray      # [F] i32 (min length 1)
+    fac_words: np.ndarray    # [F, W] u32 LE factor words, zero-padded
+    fac_wmask: np.ndarray    # [F, W] u32 byte masks (0 past the factor)
+    fac_groups: np.ndarray   # [F, GW] u32 group bitsets
+    always_mask: np.ndarray  # [GW] u32
+    n_groups: int

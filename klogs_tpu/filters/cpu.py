@@ -147,6 +147,53 @@ class DFAFilter(LogFilter):
 INDEX_MIN_K = 64
 
 
+def index_min_k() -> int:
+    """The auto-mode thousand-pattern threshold (KLOGS_INDEX_MIN_K,
+    default INDEX_MIN_K). One reading shared by best_host_filter's
+    indexed-engine choice and the TPU engine's device-sweep auto rule,
+    so the host and device paths flip to index mode at the same K."""
+    import os
+
+    try:
+        return int(os.environ.get("KLOGS_INDEX_MIN_K", str(INDEX_MIN_K)))
+    except ValueError:
+        return INDEX_MIN_K
+
+
+def device_sweep_env() -> str:
+    """Validated KLOGS_TPU_SWEEP (auto | 0 | 1). Malformed values
+    raise — a typo'd knob silently running without the sweep would be
+    an unexplained ~10x at thousand-pattern K. One reading shared by
+    the single-chip engine and the mesh so the contract cannot
+    diverge."""
+    import os
+
+    env = os.environ.get("KLOGS_TPU_SWEEP", "auto")
+    if env not in ("auto", "0", "1"):
+        raise ValueError(
+            f"KLOGS_TPU_SWEEP={env!r}: expected auto, 0 or 1")
+    return env
+
+
+def device_sweep_wanted(n_patterns: int,
+                        interpret: bool = False) -> bool:
+    """The shared engine/mesh device-sweep decision: forced by
+    KLOGS_TPU_SWEEP=1, off by =0, and in auto mode on only past the
+    SAME K threshold that flips best_host_filter to the indexed
+    engine AND on a real accelerator backend — the CPU backend's
+    dense sweep is gather-bound and loses to the host sweep
+    (BENCH_SWEEP.json). ``interpret`` keeps auto off for interpret-
+    mode meshes (debug shape, nothing to win)."""
+    env = device_sweep_env()
+    if env != "auto":
+        return env == "1"
+    if n_patterns < index_min_k() or interpret:
+        return False
+    import jax
+
+    return jax.default_backend() not in ("cpu",)
+
+
 def best_host_filter(patterns: list[str], ignore_case: bool = False,
                      registry=None):
     """Strongest CPU engine this pattern set admits: the factor-index
@@ -170,10 +217,7 @@ def best_host_filter(patterns: list[str], ignore_case: bool = False,
     if choice == "combined":
         return (CombinedRegexFilter(patterns, ignore_case=ignore_case),
                 "combined-re")
-    try:
-        min_k = int(os.environ.get("KLOGS_INDEX_MIN_K", str(INDEX_MIN_K)))
-    except ValueError:
-        min_k = INDEX_MIN_K
+    min_k = index_min_k()
     if choice == "indexed" or (choice == "auto" and len(patterns) >= min_k):
         from klogs_tpu.filters.indexed import IndexedFilter
 

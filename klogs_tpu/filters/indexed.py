@@ -20,6 +20,8 @@ index's win honestly (same tables, same engines, only the narrowing
 differs).
 """
 
+import os
+import time
 from typing import Any
 
 import numpy as np
@@ -42,6 +44,22 @@ GROUP_MAX_STATES = 8192
 # Lines per sweep slab: bounds the sweep's transient numpy arrays
 # (~16 bytes per payload byte) regardless of caller batch size.
 SLAB_LINES = 65536
+# Device-sweep row-width cap: a slab holding a line longer than this
+# sweeps on the host instead (padding every row to a jumbo line's
+# width would swamp the device pass; long lines are rare in log
+# corpora and the host sweep is O(payload)).
+SWEEP_MAX_WIDTH = 4096
+# ... and a padded-batch byte cap: ONE moderately long line in a full
+# slab would otherwise pad rows x width to hundreds of MB (65536 rows
+# x 4096 B = 256 MB for ~10 MB of payload). Past the cap the slab
+# narrows on the host — same degrade, bounded memory.
+SWEEP_MAX_BATCH_BYTES = 64 << 20
+# Adaptive bypass (KLOGS_INDEX_BYPASS_RATIO / _LINES): once this many
+# lines have been swept, a cumulative narrowing ratio still above the
+# threshold means the index is not paying for itself on this stream —
+# switch to scan-all for subsequent batches and say so once.
+BYPASS_RATIO = 0.5
+BYPASS_MIN_LINES = 65536
 
 
 class _Group:
@@ -93,9 +111,12 @@ class IndexedFilter(LogFilter):
                  *, narrow: bool = True, cache: bool = True,
                  max_group_patterns: int = MAX_GROUP_PATTERNS,
                  max_group_positions: int = MAX_GROUP_POSITIONS,
-                 registry: Any = None) -> None:
+                 registry: Any = None, sweep: str = "auto") -> None:
         if not patterns:
             raise ValueError("IndexedFilter needs at least one pattern")
+        if sweep not in ("auto", "host", "device"):
+            raise ValueError(
+                f"sweep={sweep!r}: expected auto, host or device")
         from klogs_tpu.obs.metrics import Registry
 
         self.registry = registry if registry is not None else Registry()
@@ -107,6 +128,12 @@ class IndexedFilter(LogFilter):
         cache_events = r.family("klogs_prefilter_table_cache_events_total")
         self._m_cache = {kind: cache_events.labels(event=kind)
                          for kind in ("hit", "miss", "evict")}
+        self._m_sweep_batches = r.family("klogs_sweep_batches_total")
+        self._m_sweep_lines = r.family("klogs_sweep_lines_total")
+        self._m_sweep_cand = r.family("klogs_sweep_candidate_lines_total")
+        self._m_sweep_s = r.family("klogs_sweep_seconds")
+        self._m_sweep_fallback = r.family("klogs_sweep_fallback_total")
+        self._m_bypass = r.family("klogs_sweep_bypass_total")
 
         self.narrow = narrow
         self.infos: "list[PatternInfo]" = analyze(
@@ -129,6 +156,70 @@ class IndexedFilter(LogFilter):
         self.swept_cells = 0
         self.candidate_cells = 0
         self.candidate_lines = 0
+        # Adaptive bypass state: once the stream's cumulative narrowing
+        # ratio proves the index is not narrowing (class satellite:
+        # BENCH_K K=32 ratio 0.67 -> indexed 0.18x of scan-all), stop
+        # paying the sweep. bypassed is only ever flipped on, and only
+        # after _bypass_min_lines have been swept.
+        self.bypassed = False
+        self._bypass_ratio = _env_float(
+            "KLOGS_INDEX_BYPASS_RATIO", BYPASS_RATIO)
+        self._bypass_min_lines = int(_env_float(
+            "KLOGS_INDEX_BYPASS_LINES", BYPASS_MIN_LINES))
+        # Narrowing stage: the device sweep (ops/sweep.py via jax) when
+        # requested — or in auto mode when a real accelerator backend
+        # is up — else the host sweep. Device-path failures fall back
+        # to the host sweep loudly and permanently (the host sweep is
+        # the parity oracle, so the verdicts cannot change).
+        self._sweep_path = "host"
+        self._sweep_tables: Any = None
+        if sweep != "host":
+            self._init_device_sweep(sweep)
+
+    def _init_device_sweep(self, sweep: str) -> None:
+        import sys
+
+        if sweep == "auto":
+            from klogs_tpu.filters.cpu import device_sweep_env
+
+            if device_sweep_env() == "0":
+                # KLOGS_TPU_SWEEP=0 kills every AUTO sweep path — the
+                # host engine's device narrowing included. An explicit
+                # sweep="device" constructor arg is code, not config,
+                # and stays above the env knob.
+                return
+            if "jax" not in sys.modules:
+                # A process that never imported jax is a --backend=cpu
+                # deployment (jax is the optional [tpu] extra): auto
+                # mode must not pay the jax import — let alone a
+                # device-client init — for a narrowing stage it would
+                # reject anyway.
+                return
+        try:
+            import jax
+
+            from klogs_tpu.ops.sweep import device_sweep_tables
+        except ImportError:
+            if sweep == "device":
+                raise
+            return  # expected configuration, not a degrade
+        try:
+            if sweep == "auto" and jax.default_backend() in ("cpu",):
+                # Dense device sweep on the CPU backend is gather-bound
+                # and loses to the host sweep (BENCH_SWEEP.json) —
+                # auto only flips on real accelerators.
+                return
+            self._sweep_tables = device_sweep_tables(
+                self.index.sweep_program())
+            self._sweep_path = "device"
+        except Exception as e:
+            if sweep == "device":
+                raise
+            from klogs_tpu.ui import term
+
+            term.warning(
+                "device sweep unavailable (%s: %s); narrowing on the "
+                "host sweep", type(e).__name__, e)
 
     def _on_cache_event(self, kind: str) -> None:
         c = self._m_cache.get(kind)
@@ -178,14 +269,31 @@ class IndexedFilter(LogFilter):
                     offsets: np.ndarray) -> np.ndarray:
         B = len(offsets) - 1
         out = np.zeros(B, dtype=bool)
-        if self.narrow:
-            gm = self.index.group_candidates(payload, offsets)
-            st = self.index.last_stats
-            self.swept_lines += st.lines
-            self.swept_cells += st.lines * st.groups
-            self.candidate_cells += st.candidate_cells
-            self.candidate_lines += st.candidate_lines
-            self._m_ratio.observe(st.narrowing_ratio)
+        if self.narrow and not self.bypassed:
+            t0 = time.perf_counter()
+            path = "host"
+            gm = None
+            if self._sweep_path == "device":
+                gm = self._device_candidates(payload, offsets)
+                if gm is not None:
+                    path = "device"
+            if gm is None:
+                gm = self.index.group_candidates(payload, offsets)
+            G = len(self.groups)
+            cand_lines = int(gm.any(axis=1).sum())
+            cand_cells = int(gm.sum())
+            self.swept_lines += B
+            self.swept_cells += B * G
+            self.candidate_cells += cand_cells
+            self.candidate_lines += cand_lines
+            ratio = cand_cells / (B * G) if B and G else 1.0
+            self._m_ratio.observe(ratio)
+            self._m_sweep_batches.labels(path=path).inc()
+            self._m_sweep_lines.labels(path=path).inc(B)
+            self._m_sweep_cand.labels(path=path).inc(cand_lines)
+            self._m_sweep_s.labels(path=path).observe(
+                time.perf_counter() - t0)
+            self._maybe_bypass()
         else:
             gm = np.ones((B, len(self.groups)), dtype=bool)
             self.swept_lines += B
@@ -216,6 +324,80 @@ class IndexedFilter(LogFilter):
                 grp.filt.dispatch_framed(sub_pay, sub_off)))
             out[rows[verd[:len(rows)]]] = True
         return out
+
+    def _maybe_bypass(self) -> None:
+        """Adaptive bypass: after the probation window, a cumulative
+        narrowing ratio above the threshold means the sweep is not
+        ruling out enough scans to pay for itself — switch this stream
+        to scan-all for subsequent batches and say so ONCE."""
+        if (self.bypassed
+                or self.swept_lines < self._bypass_min_lines
+                or self.narrowing_ratio <= self._bypass_ratio):
+            return
+        self.bypassed = True
+        self._m_bypass.inc()
+        from klogs_tpu.ui import term
+
+        term.info(
+            "index narrowing ratio %.2f stayed above %.2f after %d "
+            "lines; switching to scan-all for subsequent batches",
+            self.narrowing_ratio, self._bypass_ratio, self.swept_lines)
+
+    def _device_candidates(self, payload: bytes,
+                           offsets: np.ndarray) -> "np.ndarray | None":
+        """Device-sweep narrowing for one slab: pack the framed rows
+        into a width-bucketed [B', W] batch (vectorized ragged scatter,
+        power-of-two buckets for jit-cache discipline) and run the
+        jitted sweep. Returns None — host takes over — when the slab
+        holds a line past SWEEP_MAX_WIDTH, or permanently after a
+        device failure (loud, counted)."""
+        lens = np.diff(offsets).astype(np.int64)
+        B = len(lens)
+        wmax = int(lens.max()) if B else 0
+        if wmax > SWEEP_MAX_WIDTH:
+            return None
+        width = 128
+        while width < wmax:
+            width *= 2
+        rows = 8
+        while rows < B:
+            rows *= 2
+        if rows * width > SWEEP_MAX_BATCH_BYTES:
+            return None
+        try:
+            from klogs_tpu.filters.base import pack_framed_rows
+            from klogs_tpu.ops.sweep import sweep_group_candidates
+
+            batch, _ = pack_framed_rows(payload, offsets, width,
+                                        rows=rows)
+            gm = np.asarray(sweep_group_candidates(
+                self._sweep_tables, batch,
+                np.pad(lens.astype(np.int32), (0, rows - B))))
+            return gm[:B]
+        except Exception as e:
+            from klogs_tpu.ui import term
+
+            term.warning(
+                "device sweep failed (%s); narrowing on the host sweep "
+                "from here on", str(e)[:120])
+            self._sweep_path = "host"
+            self._m_sweep_fallback.inc()
+            return None
+
+
+def _env_float(name: str, default: float) -> float:
+    """Env override parsed strictly: a malformed value raises (silent
+    misconfiguration of a degrade knob hides real regressions)."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r}: expected a number") from None
+    if not np.isfinite(v) or v < 0:
+        raise ValueError(f"{name}={raw!r}: expected a finite value >= 0")
+    return v
 
 
 def _gather_frame(arr: np.ndarray, offsets: np.ndarray, lens: np.ndarray,

@@ -275,6 +275,98 @@ class NFAEngineFilter(LogFilter):
                     term.info("%s", reason)
                     if self._stats is not None:
                         self._stats.pf_disabled_reason = reason
+            # Thousand-pattern fused path: the device literal sweep
+            # (ops/sweep.py) gates (tile, group) kernel grid cells with
+            # the factor-index candidate mask, computed ON DEVICE in
+            # the same dispatch (frame -> sweep -> gated match, no host
+            # round-trip). Auto at the same K threshold that flips
+            # best_host_filter to the indexed engine, and only on a
+            # real accelerator — on the CPU backend the dense sweep is
+            # gather-bound and loses to the host sweep (BENCH_SWEEP).
+            self._sweep_tables = None
+            if engine is None:
+                self._init_sweep(patterns, ignore_case)
+        else:
+            self._sweep_tables = None
+            from klogs_tpu.filters.cpu import device_sweep_env
+
+            if engine is None and device_sweep_env() == "1":
+                # The fused sweep only exists for the pallas/interpret
+                # kernels; a forced knob silently doing nothing here
+                # would be the exact unexplained-~10x the validation
+                # exists to prevent.
+                from klogs_tpu.ui import term
+
+                term.info(
+                    "KLOGS_TPU_SWEEP=1 ignored: the fused sweep needs "
+                    "the pallas/interpret kernel (running %s)",
+                    kernel)
+
+    def _init_sweep(self, patterns: list[str], ignore_case: bool) -> None:
+        """Build the device sweep tables when the auto rule (or
+        KLOGS_TPU_SWEEP=1) selects the fused path. Any build failure
+        degrades LOUDLY to the plain kernel — same contract as the
+        indexed-engine auto fallback in best_host_filter."""
+        from klogs_tpu.filters.cpu import (
+            device_sweep_env,
+            device_sweep_wanted,
+        )
+        from klogs_tpu.ui import term
+
+        env = device_sweep_env()
+        if not device_sweep_wanted(
+                len(patterns),
+                interpret=self._kernel == "interpret"):
+            # Same auto rule as the mesh: interpret is the debug
+            # shape, auto never fuses the sweep into it (=1 still
+            # forces it for kernel-parity tests).
+            return
+        if self._pf_tables is not None and env != "1":
+            # The sweep subsumes the pair-CNF gate and the kernel
+            # accepts one gate only (_check_fused_combo). An EXPLICIT
+            # prefilter opt-in beats the auto sweep; a forced sweep
+            # beats the prefilter — but the working prefilter is only
+            # discarded AFTER the sweep tables actually build (below):
+            # a failed build must not leave the engine with neither
+            # gate.
+            term.info(
+                "KLOGS_TPU_PREFILTER=1 active; device sweep stays "
+                "off (set KLOGS_TPU_SWEEP=1 to prefer the sweep)")
+            return
+        pg = self._dp_grouped.pattern_group
+        if not pg:
+            term.warning(
+                "device sweep unavailable: grouped program carries no "
+                "pattern_group map; running the plain kernel")
+            return
+        try:
+            from klogs_tpu.filters.compiler.groups import analyze, plan_groups
+            from klogs_tpu.filters.compiler.index import FactorIndex
+            from klogs_tpu.ops.sweep import device_sweep_tables
+
+            infos = analyze(patterns, ignore_case=ignore_case)
+            index = FactorIndex(infos, plan_groups(infos))
+            prog = index.sweep_program(
+                group_of=np.asarray(pg, dtype=np.int32),
+                n_groups=int(self._dp_grouped.follow.shape[0]))
+            tables = device_sweep_tables(prog)
+            if self._pf_tables is not None:
+                term.info(
+                    "KLOGS_TPU_SWEEP=1 supersedes KLOGS_TPU_PREFILTER: "
+                    "the literal sweep subsumes the pair-CNF gate")
+            with self._state_lock:
+                self._pf_tables = None
+                self._sweep_tables = tables
+        except Exception as e:
+            # Auto/forced sweep failing to BUILD must not kill the
+            # engine: the plain kernel is always correct — but say so,
+            # a silent fallback at this K is an unexplained ~10x.
+            term.warning(
+                "device sweep build failed for this %d-pattern set "
+                "(%s: %s); running the plain kernel",
+                len(patterns), type(e).__name__, e)
+            if self._stats is not None:
+                self._stats.record_sweep_fallback()
 
     def match_lines(self, lines: list[bytes]) -> list[bool]:
         return self.fetch(self.dispatch(lines))
@@ -306,7 +398,14 @@ class NFAEngineFilter(LogFilter):
 
     def _use_cls(self) -> bool:
         if self._engine is not None:
-            return getattr(self._engine, "cls_table", None) is not None
+            # A mesh engine running the fused sweep consumes raw bytes.
+            return (getattr(self._engine, "cls_table", None) is not None
+                    and not getattr(self._engine, "swept", False))
+        if getattr(self, "_sweep_tables", None) is not None:
+            # The fused sweep consumes raw bytes (the cls hot path
+            # never ships them to the device); short lines take the
+            # byte-consuming grouped entry instead.
+            return False
         return (self._kernel in ("pallas", "interpret")
                 and getattr(self, "_cls_table", None) is not None)
 
@@ -399,13 +498,10 @@ class NFAEngineFilter(LogFilter):
             buckets.setdefault(
                 _bucket_len(len(bodies[i]), self._chunk_bytes), []
             ).append(i)
-        if self._engine is not None:
-            # MeshEngine exposes its global classifier when class ids
-            # fit int8 — the multi-chip hot path takes cls directly.
-            use_cls = getattr(self._engine, "cls_table", None) is not None
-        else:
-            use_cls = (self._kernel in ("pallas", "interpret")
-                       and getattr(self, "_cls_table", None) is not None)
+        # MeshEngine exposes its global classifier when class ids fit
+        # int8 — the multi-chip hot path takes cls directly; an active
+        # device sweep forces the byte path instead (_use_cls).
+        use_cls = self._use_cls()
         for width, idxs in buckets.items():
             sub = [bodies[i] for i in idxs]
             self._record_sub_batch(width, _bucket_batch(len(sub)),
@@ -414,8 +510,7 @@ class NFAEngineFilter(LogFilter):
                 parts.append((idxs, *self._match_cls_dispatch(sub, width)))
             else:
                 batch, lengths = pack_lines(sub, width)
-                parts.append((idxs, *self._match_full(batch, lengths),
-                              None))
+                parts.append((idxs, *self._match_full(batch, lengths)))
         if long_idx:
             parts.append(
                 (long_idx, self._match_long([bodies[i] for i in long_idx]),
@@ -458,9 +553,15 @@ class NFAEngineFilter(LogFilter):
                 pf = None
             out[idxs] = vals[: len(idxs)]
             if pf is not None and self._stats is not None:
+                swept = isinstance(pf, tuple) and pf and pf[0] == "sweep"
+                if swept:
+                    pf = pf[1]
                 n_cand, n_live, n_tiles = (int(np.asarray(x)) for x in pf)
                 self._stats.record_prefilter(
                     len(idxs), min(n_cand, len(idxs)), n_tiles, n_live)
+                if swept:
+                    self._stats.record_sweep(
+                        "device", len(idxs), min(n_cand, len(idxs)))
         return out
 
     def _match_cls_dispatch(self, bodies: list[bytes], width: int):
@@ -593,13 +694,25 @@ class NFAEngineFilter(LogFilter):
 
     def _match_full(self, batch: np.ndarray, lengths: np.ndarray):
         """Byte-consuming full-line path (device-side classify).
-        Returns (device_mask, retry_or_None) — the retry covers an
-        ASYNC failure of a defaulted chain variant surfacing at
-        fetch(), mirroring _match_cls_dispatch."""
+        Returns (device_mask, retry_or_None, sweep_stats_or_None) — the
+        retry covers an ASYNC failure (defaulted chain variant or the
+        fused sweep kernel) surfacing at fetch(), mirroring
+        _match_cls_dispatch."""
         if self._engine is not None:
             eng = self._engine
             retry = None
-            if getattr(eng, "gated", False):
+            swept_before = getattr(eng, "swept", False)
+            if swept_before:
+                # Async failure of the fused sweep fn surfaces at
+                # fetch: drop the sweep, count the degrade (the mesh
+                # holds no stats handle), rerun on the classify path
+                # (whose own gated/chain degrades then apply).
+                def retry(batch=batch, lengths=lengths):
+                    eng.disable_sweep()
+                    if self._stats is not None:
+                        self._stats.record_sweep_fallback()
+                    return eng.match_batch(batch, lengths)
+            elif getattr(eng, "gated", False):
                 def retry(batch=batch, lengths=lengths):
                     eng.disable_prefilter()
                     return eng.match_batch(batch, lengths)
@@ -607,7 +720,15 @@ class NFAEngineFilter(LogFilter):
                 def retry(batch=batch, lengths=lengths):
                     eng.degrade_chain()
                     return eng.match_batch(batch, lengths)
-            return eng.match_batch(batch, lengths), retry
+            mask = eng.match_batch(batch, lengths)
+            if (swept_before and not getattr(eng, "swept", False)
+                    and self._stats is not None):
+                # The mesh degraded internally at dispatch (its own
+                # try/except warned already) — surface it on the
+                # wrapper's counter so klogs_sweep_fallback_total is
+                # the one place sweep degrades show.
+                self._stats.record_sweep_fallback()
+            return mask, retry, None
         if self._kernel in ("pallas", "interpret"):
             interpret = self._kernel == "interpret"
             kw, chain_defaulted = self._chain_kwargs(interpret)
@@ -621,10 +742,54 @@ class NFAEngineFilter(LogFilter):
                     batch, lengths, interpret=interpret,
                     **dict(kw, mask_block=1))
 
-            try:
-                mask = self._pallas.match_batch_grouped_pallas(
+            def run_plain(run_kw):
+                return self._pallas.match_batch_grouped_pallas(
                     self._dp_grouped, self._g_live, self._g_acc,
-                    batch, lengths, interpret=interpret, **kw)
+                    batch, lengths, interpret=interpret, **run_kw)
+
+            sweep = getattr(self, "_sweep_tables", None)
+            if sweep is not None:
+
+                def sweep_retry(record: bool = True):
+                    # Fetch-time failure of the FUSED sweep kernel:
+                    # drop the sweep gate (one cause at a time — the
+                    # chain variant is independent), record the
+                    # degrade, rerun plain. np.asarray forces the rerun
+                    # synchronous so a second async fault surfaces
+                    # here.
+                    with self._state_lock:
+                        self._sweep_tables = None
+                    if self._stats is not None:
+                        self._stats.record_sweep_fallback()
+                    try:
+                        return np.asarray(run_plain(kw))
+                    except Exception:
+                        if not chain_defaulted:
+                            raise
+                        return plain_retry()
+
+                want_stats = self._stats is not None
+                try:
+                    res = self._pallas.match_batch_grouped_pallas(
+                        self._dp_grouped, self._g_live, self._g_acc,
+                        batch, lengths, interpret=interpret,
+                        sweep_tables=sweep, return_stats=want_stats,
+                        **kw)
+                    mask, sw = res if want_stats else (res, None)
+                    return (mask, sweep_retry,
+                            None if sw is None else ("sweep", sw))
+                except Exception as e:
+                    from klogs_tpu.ui import term
+
+                    term.warning(
+                        "fused sweep kernel unavailable (%s); "
+                        "falling back to plain NFA", str(e)[:120])
+                    with self._state_lock:
+                        self._sweep_tables = None
+                    if self._stats is not None:
+                        self._stats.record_sweep_fallback()
+            try:
+                mask = run_plain(kw)
             except Exception as e:
                 if not chain_defaulted:
                     raise
@@ -634,9 +799,9 @@ class NFAEngineFilter(LogFilter):
                     "default mask_block=%d chain failed on this backend "
                     "(%s); continuing on the plain chain",
                     kw.get("mask_block"), str(e)[:120])
-                return plain_retry(), None
-            return mask, (plain_retry if chain_defaulted else None)
-        return self._nfa.match_batch(self._dp, batch, lengths), None
+                return plain_retry(), None, None
+            return mask, (plain_retry if chain_defaulted else None), None
+        return self._nfa.match_batch(self._dp, batch, lengths), None, None
 
     def _match_long(self, bodies: list[bytes]) -> np.ndarray:
         """Carried-state chunked matching: all long lines advance in

@@ -146,6 +146,30 @@ SPECS: dict[str, dict] = {
         "evict (LRU removal past KLOGS_DFA_CACHE_MB).",
         labels=("event",)),
 
+    # -- literal sweep (device/host narrowing stage) ------------------
+    "klogs_sweep_batches_total": _m(
+        "counter", "Batches narrowed by the literal sweep, by which "
+        "stage ran: device (fused on-device sweep, ops/sweep.py) or "
+        "host (host factor sweep).", labels=("path",)),
+    "klogs_sweep_lines_total": _m(
+        "counter", "Lines swept by the literal sweep, by stage.",
+        labels=("path",)),
+    "klogs_sweep_candidate_lines_total": _m(
+        "counter", "Lines the sweep could NOT rule out (at least one "
+        "candidate group), by stage. candidate/swept is the live "
+        "narrowing ratio.", labels=("path",)),
+    "klogs_sweep_seconds": _m(
+        "histogram", "Sweep-stage latency per batch, by stage.",
+        labels=("path",), buckets=LATENCY_BUCKETS),
+    "klogs_sweep_fallback_total": _m(
+        "counter", "Device-sweep degrades: build or kernel failures "
+        "that dropped a batch (and every later one) to the fallback "
+        "path."),
+    "klogs_sweep_bypass_total": _m(
+        "counter", "Adaptive sweep bypasses: an IndexedFilter observed "
+        "a narrowing ratio above KLOGS_INDEX_BYPASS_RATIO after its "
+        "probation window and switched itself to scan-all."),
+
     # -- fanout layer (FanoutRunner) ----------------------------------
     "klogs_fanout_active_streams": _m(
         "gauge", "Log streams currently open."),

@@ -299,7 +299,7 @@ def _grouped_kernel_body(g, cls_ref, char_mask_t_ref, follow_t_ref, out_ref,
 
 
 def _check_fused_combo(fused, prefilter_tables, unroll, interleave,
-                       mask_block=1):
+                       mask_block=1, sweep_tables=None):
     """The fused kernel has no gated variant and a single dependency
     chain per group (no interleave/unroll). Silently running a
     DIFFERENT kernel than the caller asked to measure would corrupt the
@@ -309,12 +309,21 @@ def _check_fused_combo(fused, prefilter_tables, unroll, interleave,
             "mask_block (KLOGS_TPU_MASK_BLOCK) and interleave "
             "(KLOGS_TPU_INTERLEAVE) are mutually exclusive chain "
             "restructurings; set at most one")
+    if sweep_tables is not None and prefilter_tables is not None:
+        raise ValueError(
+            "sweep_tables (KLOGS_TPU_SWEEP) and prefilter_tables "
+            "(KLOGS_TPU_PREFILTER) are mutually exclusive gates; the "
+            "literal sweep subsumes the pair-CNF mask — set one")
     if not fused:
         return
     if prefilter_tables is not None:
         raise ValueError(
             "fused=True (KLOGS_TPU_FUSED_GROUPS) has no gated variant; "
             "drop the prefilter tables or unset KLOGS_TPU_PREFILTER")
+    if sweep_tables is not None:
+        raise ValueError(
+            "fused=True (KLOGS_TPU_FUSED_GROUPS) has no gated variant; "
+            "drop the sweep tables or unset KLOGS_TPU_SWEEP")
     if unroll != 1 or interleave != 1 or mask_block != 1:
         raise ValueError(
             "fused=True ignores unroll/interleave/mask_block; unset "
@@ -393,7 +402,7 @@ def _grouped_kernel_gated(flags_ref, cls_ref, char_mask_t_ref, follow_t_ref,
 @functools.partial(jax.jit, static_argnames=("live", "acc", "tile_b",
                                              "interpret", "unroll",
                                              "interleave", "fused",
-                                             "mask_block"))
+                                             "mask_block", "return_stats"))
 def match_batch_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
                                batch: jax.Array, lengths: jax.Array,
                                tile_b: int = DEFAULT_TILE_B_GROUPED,
@@ -402,7 +411,9 @@ def match_batch_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
                                interleave: int = 1,
                                prefilter_tables=None,
                                fused: bool = False,
-                               mask_block: int = 1) -> jax.Array:
+                               mask_block: int = 1,
+                               sweep_tables=None,
+                               return_stats: bool = False):
     """Full-line match over a compile_grouped program ([G, ...] leaves,
     shared byte classifier): [B, L] u8 + [B] -> [B] bool.
 
@@ -424,10 +435,20 @@ def match_batch_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
       form — no gathers).
     - 3-tuple from ops.prefilter.device_tables: byte-domain LUT-gather
       mask (fallback; measured ~NFA-kernel-cost on v5e, see
-      BENCH_DEVICE.json)."""
+      BENCH_DEVICE.json).
+
+    ``sweep_tables`` (an ops.sweep.SweepTables packed against THIS
+    program's pattern_group map) enables the FUSED thousand-pattern
+    path instead: the device literal sweep produces the per-(line,
+    group) candidate mask right here on device and gates (tile, group)
+    grid cells — frame -> sweep -> gated match in one dispatch, no
+    host round-trip. Only this byte-consuming entry can fuse the sweep
+    (the cls hot path never ships raw bytes to the device). With
+    ``return_stats`` (and a gate active) returns (matched,
+    (n_candidates, n_tiles_live, n_tiles)) like the cls entry."""
     B = batch.shape[0]
     _check_fused_combo(fused, prefilter_tables, unroll, interleave,
-                       mask_block)
+                       mask_block, sweep_tables)
     # +3: BEGIN, END, latch columns; then the mask_block T-padding the
     # launcher will add, so the VMEM budget sees the true cls width.
     T_cap = -(-(batch.shape[1] + 3) // mask_block) * mask_block
@@ -444,10 +465,14 @@ def match_batch_grouped_pallas(dp: DeviceProgram, live: int, acc: int,
     cand_input = None
     if prefilter_tables is not None and len(prefilter_tables) != 4:
         cand_input = (batch, lengths)  # byte-LUT tables need raw bytes
+    sweep_input = (batch, lengths) if sweep_tables is not None else None
     return _launch_grouped(dp, live, acc, cls, B, TILE_B,
                            interpret, unroll, interleave,
                            prefilter_tables, cand_input, fused=fused,
-                           mask_block=mask_block)
+                           mask_block=mask_block,
+                           sweep_tables=sweep_tables,
+                           sweep_input=sweep_input,
+                           return_stats=return_stats)
 
 
 @functools.partial(jax.jit, static_argnames=("live", "acc", "tile_b",
@@ -518,7 +543,8 @@ def _launch_grouped(dp, live, acc, cls, B, TILE_B,
                     interpret, unroll, interleave,
                     prefilter_tables, cand_input,
                     return_stats: bool = False, fused: bool = False,
-                    mask_block: int = 1):
+                    mask_block: int = 1,
+                    sweep_tables=None, sweep_input=None):
     """Shared kernel launch over classified [Bp, T] i32 ids (padded to a
     TILE_B multiple); B is the real row count to slice back to."""
     if mask_block > 1 and cls.shape[1] % mask_block:
@@ -561,7 +587,7 @@ def _launch_grouped(dp, live, acc, cls, B, TILE_B,
     kern_kw = dict(T=T, C=C, live=live, acc=acc,
                    unroll=unroll, interleave=interleave,
                    mask_block=mask_block)
-    if prefilter_tables is None:
+    if prefilter_tables is None and sweep_tables is None:
         out = pl.pallas_call(
             functools.partial(_grouped_kernel, **kern_kw),
             grid=(Bp // TILE_B, G),  # groups innermost: out block revisited
@@ -589,19 +615,38 @@ def _launch_grouped(dp, live, acc, cls, B, TILE_B,
         pattern_group_onehot,
     )
 
-    if len(prefilter_tables) == 4:  # class-domain tables (fast form)
-        pm = candidate_matrix_from_cls(prefilter_tables, cls)  # [Bp, Pp]
+    # One gated tail, two candidate sources (the launcher rejects both
+    # gates at once in _check_fused_combo): the fused literal sweep
+    # produces the exact per-(line, group) mask directly — its tables
+    # were packed against this program's pattern_group map — while the
+    # pair-CNF prefilter produces a per-(line, pattern) matrix reduced
+    # to groups when the program carries a pattern_group map.
+    if sweep_tables is not None:
+        from klogs_tpu.ops.sweep import sweep_group_candidates
+
+        gm = sweep_group_candidates(sweep_tables, *sweep_input)  # [Bp, G]
+        if gm.shape[1] != G:
+            raise ValueError(
+                f"sweep tables target {gm.shape[1]} groups, grouped "
+                f"program has {G} (pack with this program's "
+                "pattern_group map)")
+        cand = gm.any(axis=1)
     else:
-        pm = candidate_matrix(prefilter_tables, *cand_input)  # [Bp, Pp]
-    cand = pm.any(axis=1)
+        if len(prefilter_tables) == 4:  # class-domain tables (fast form)
+            pm = candidate_matrix_from_cls(prefilter_tables, cls)  # [Bp, Pp]
+        else:
+            pm = candidate_matrix(prefilter_tables, *cand_input)  # [Bp, Pp]
+        cand = pm.any(axis=1)
+        gm = None
+        if dp.pattern_group:
+            # Thousand-pattern narrowing: gate per (tile, GROUP) — a
+            # tile whose candidates all come from other groups'
+            # patterns skips this group's scan loop entirely.
+            onehot = pattern_group_onehot(dp.pattern_group, G)
+            gm = group_candidates(pm, onehot, len(dp.pattern_group))
     order, inv, tile_live = cluster_candidates(cand, TILE_B)
     n_tiles = Bp // TILE_B
-    if dp.pattern_group:
-        # Thousand-pattern narrowing: gate per (tile, GROUP) — a tile
-        # whose candidates all come from other groups' patterns skips
-        # this group's scan loop entirely.
-        onehot = pattern_group_onehot(dp.pattern_group, G)
-        gm = group_candidates(pm, onehot, len(dp.pattern_group))
+    if gm is not None:
         flags = (gm[order].reshape(n_tiles, TILE_B, G).any(axis=1)
                  .astype(jnp.int32))
     else:

@@ -1,0 +1,329 @@
+"""Device-side literal sweep: factor-index narrowing on the TPU.
+
+The host sweep (filters/compiler/index.py) narrows each line to its
+candidate pattern groups at ~570k lines/s — an order of magnitude below
+the device match pipeline — so in thousand-pattern mode the NARROWING
+stage, not the match kernel, bounds throughput (ROADMAP item 2's open
+half; the Hyperscan-FDR / GLoP literal-gating shape from PAPERS.md).
+This module is the device twin: the same compiled factor tables
+(FactorIndex.sweep_program), evaluated as a fixed sequence of
+vectorized array passes over the packed ``[B, L]`` byte batch, so the
+per-(line, group) candidate mask is produced ON DEVICE and can gate
+the grouped Pallas NFA kernel in the same dispatch — frame -> sweep ->
+gated match with no host round-trip (ops/pallas_nfa.py).
+
+Stage structure (all dense — XLA needs static shapes, so there is no
+survivor extraction; instead every stage is a cheap full-width pass
+and the EXPENSIVE work is bounded by compile-time constants):
+
+1. **Rolling codes via shifted slices.** The row is padded with 8 zero
+   columns and the little-endian 4-byte code at every position is four
+   shifted uint32 slices OR-ed together — no gather, pure VPU. The
+   wide tier's chained key derives from the same array: the code 4
+   positions ahead, Fibonacci-mixed in (one multiply + one xor).
+2. **Exact two-tier hash probe.** Every position's key probes the
+   tier's open-addressed table: ``max_probe`` UNROLLED gather+compare
+   rounds into a cache/VMEM-resident table (searchsorted's log2 E
+   dependent binary-search rounds measured ~8x slower on XLA CPU and
+   lower the same way on TPU). The two tiers are what keep buckets
+   shallow: minted rule families share a rarest 4-byte window, and a
+   single-code table funnels them into one bucket whose depth the
+   static walk pays at EVERY position (measured max bucket 137 at
+   K=1024 single-tier vs 2 two-tier).
+3. **Masked word verify.** A matched key selects a bucket of at most
+   ``max_bucket`` entries (compile-time constant, typically 1-2). For
+   each bucket slot, the candidate factor's bytes are compared as
+   masked uint32 words against the SAME rolling-code array (window
+   position minus the entry's rarity anchor gives the factor start;
+   per-tier ceil(len/4) masked compares, zero-mask words are
+   don't-care) together with the line-bounds check — EXACTLY the host
+   sweep's verify, so the device mask equals the host mask bit for bit
+   (property-tested in tests/test_sweep.py).
+4. **Group-bitset accumulate.** Verified hits OR their factor's group
+   bitset ([GW] uint32 lanes, 32 groups/lane) into a per-line
+   accumulator; one unpack + the always-candidate mask yields the
+   [B, G] bool candidate matrix.
+
+Unlike the host sweep there is NO bloom stage: the dense exact probe
+IS the gate here (equality beats a superset bloom at the same cost),
+so the host's 64 KiB union bloom never ships to the device.
+
+Exactness matters: the mask is a NECESSARY condition (a False cell
+proves no pattern of that group matches the line), and host parity
+makes the host sweep the oracle for the device path. Padded rows
+(length 0) can never host a factor, so batch padding is safe.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from klogs_tpu.filters.compiler.index import (
+    SweepProgram,
+    SweepTier,
+    pack_sweep_tier,
+)
+
+# Fibonacci multiply fold, shared with the host tables and the
+# wide-tier key mix (filters/compiler/index.py _fold1): hash slot =
+# high log2(H) bits of the wrapping 32-bit product.
+_FIB = 2654435761
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class SweepTables:
+    """SweepProgram as a device pytree. Array leaves carry the tables;
+    ``n_groups`` and the static loop bounds are pytree AUX (they shape
+    the unpack and bound the probe/verify loops), so mesh stacking
+    requires them uniform across shards — ``stack_sweep_tables`` forces
+    the maxima."""
+
+    n_slot_key: Any   # [Hn] u32 narrow hash slots
+    n_slot_eid: Any   # [Hn] i32, -1 = empty
+    n_start: Any      # [En+1] i32 bucket starts
+    n_fid: Any        # [NEn] i32
+    n_anchor: Any     # [NEn] i32
+    w_slot_key: Any   # [Hw] u32 wide hash slots
+    w_slot_eid: Any   # [Hw] i32
+    w_start: Any      # [Ew+1] i32
+    w_fid: Any        # [NEw] i32
+    w_anchor: Any     # [NEw] i32
+    fac_len: Any      # [F] i32
+    fac_words: Any    # [F, W] u32
+    fac_wmask: Any    # [F, W] u32
+    fac_groups: Any   # [F, GW] u32
+    always_mask: Any  # [GW] u32
+    n_groups: int
+    n_bounds: "tuple[int, int, int]"  # narrow (max_probe, max_bucket, n_words)
+    w_bounds: "tuple[int, int, int]"  # wide   (max_probe, max_bucket, n_words)
+
+    def tree_flatten(self) -> "tuple[tuple, tuple]":
+        leaves = (self.n_slot_key, self.n_slot_eid, self.n_start,
+                  self.n_fid, self.n_anchor,
+                  self.w_slot_key, self.w_slot_eid, self.w_start,
+                  self.w_fid, self.w_anchor,
+                  self.fac_len, self.fac_words, self.fac_wmask,
+                  self.fac_groups, self.always_mask)
+        return leaves, (self.n_groups, self.n_bounds, self.w_bounds)
+
+    @classmethod
+    def tree_unflatten(cls, aux: tuple, leaves: tuple) -> "SweepTables":
+        return cls(*leaves, *aux)
+
+    def leaf_iter(self) -> "Iterator[Any]":
+        yield from self.tree_flatten()[0]
+
+
+def _tier_leaves(t: SweepTier) -> "tuple[Any, ...]":
+    return (jnp.asarray(t.slot_key), jnp.asarray(t.slot_eid),
+            jnp.asarray(t.bucket_start), jnp.asarray(t.fid),
+            jnp.asarray(t.anchor))
+
+
+def device_sweep_tables(prog: SweepProgram) -> SweepTables:
+    """Ship a packed SweepProgram to the device (jnp arrays)."""
+    return SweepTables(
+        *_tier_leaves(prog.narrow), *_tier_leaves(prog.wide),
+        fac_len=jnp.asarray(prog.fac_len),
+        fac_words=jnp.asarray(prog.fac_words),
+        fac_wmask=jnp.asarray(prog.fac_wmask),
+        fac_groups=jnp.asarray(prog.fac_groups),
+        always_mask=jnp.asarray(prog.always_mask),
+        n_groups=prog.n_groups,
+        n_bounds=(prog.narrow.max_probe, prog.narrow.max_bucket,
+                  prog.narrow.n_words),
+        w_bounds=(prog.wide.max_probe, prog.wide.max_bucket,
+                  prog.wide.n_words),
+    )
+
+
+def stack_sweep_tables(progs: "list[SweepProgram]") -> SweepTables:
+    """Shape-uniform [n_shards, ...] stack of per-shard SweepPrograms
+    for shard_map (parallel/mesh.py): every array leaf is padded to the
+    fleet maxima and the aux loop bounds are forced to the maxima too.
+    Hash tables are REBUILT at the uniform power-of-two size (slot
+    indices depend on the table size, so padding in place would break
+    the probe), entry pads sit in zero-length buckets so they are never
+    walked, and a shard whose bound is below the forced maximum reads
+    only empty probe slots / empty bucket tails. Requires uniform
+    n_groups — mesh shards are compiled with a forced group count
+    already."""
+    if not progs:
+        raise ValueError("stack_sweep_tables needs at least one program")
+    gs = {p.n_groups for p in progs}
+    if len(gs) != 1:
+        raise ValueError(f"shard sweep programs disagree on n_groups: {gs}")
+
+    def pad1(a: np.ndarray, n: int, fill: int = 0) -> np.ndarray:
+        out = np.full((n,) + a.shape[1:], fill, dtype=a.dtype)
+        out[: len(a)] = a
+        return out
+
+    def stack_tier(
+        tiers: "list[SweepTier]",
+    ) -> "tuple[tuple[Any, ...], tuple[int, int, int]]":
+        H = max(len(t.slot_key) for t in tiers)
+        rebuilt = []
+        for t in tiers:
+            if len(t.slot_key) == H:
+                rebuilt.append(t)
+                continue
+            entries = [(int(t.keys[e]), int(t.fid[i]), int(t.anchor[i]))
+                       for e in range(len(t.keys))
+                       for i in range(int(t.bucket_start[e]),
+                                      int(t.bucket_start[e + 1]))]
+            nt = pack_sweep_tier(entries, hash_size=H)
+            nt.n_words = t.n_words
+            rebuilt.append(nt)
+        E = max(len(t.keys) for t in rebuilt)
+        NE = max(len(t.fid) for t in rebuilt)
+        leaves = (
+            np.stack([pad1(t.slot_key, H) for t in rebuilt]),
+            np.stack([pad1(t.slot_eid, H, -1) for t in rebuilt]),
+            np.stack([np.concatenate(
+                [t.bucket_start,
+                 np.full(E - len(t.keys), t.bucket_start[-1],
+                         dtype=t.bucket_start.dtype)])
+                for t in rebuilt]),
+            np.stack([pad1(t.fid, NE) for t in rebuilt]),
+            np.stack([pad1(t.anchor, NE) for t in rebuilt]),
+        )
+        bounds = (max(t.max_probe for t in rebuilt),
+                  max(t.max_bucket for t in rebuilt),
+                  max(t.n_words for t in rebuilt))
+        return tuple(jnp.asarray(x) for x in leaves), bounds
+
+    n_leaves, n_bounds = stack_tier([p.narrow for p in progs])
+    w_leaves, w_bounds = stack_tier([p.wide for p in progs])
+    F = max(p.fac_len.shape[0] for p in progs)
+    W = max(p.fac_words.shape[1] for p in progs)
+    GW = max(p.fac_groups.shape[1] for p in progs)
+
+    def pad2(a: np.ndarray, cols: int) -> np.ndarray:
+        out = np.zeros((F, cols), dtype=a.dtype)
+        out[: a.shape[0], : a.shape[1]] = a
+        return out
+
+    return SweepTables(
+        *n_leaves, *w_leaves,
+        fac_len=jnp.asarray(np.stack([pad1(p.fac_len, F)
+                                      for p in progs])),
+        fac_words=jnp.asarray(np.stack([pad2(p.fac_words, W)
+                                        for p in progs])),
+        fac_wmask=jnp.asarray(np.stack([pad2(p.fac_wmask, W)
+                                        for p in progs])),
+        fac_groups=jnp.asarray(np.stack([pad2(p.fac_groups, GW)
+                                         for p in progs])),
+        always_mask=jnp.asarray(np.stack([pad1(p.always_mask, GW)
+                                          for p in progs])),
+        n_groups=progs[0].n_groups,
+        n_bounds=n_bounds, w_bounds=w_bounds,
+    )
+
+
+def _unpack_bits(packed: Any, n_groups: int) -> Any:
+    """[..., GW] u32 bitset -> [..., n_groups] bool (static index
+    arrays, so the lane/shift selects compile to gathers-by-constant)."""
+    g = np.arange(n_groups)
+    lane = g // 32
+    shift = jnp.asarray((g % 32).astype(np.uint32))
+    return ((packed[..., lane] >> shift) & jnp.uint32(1)) > 0
+
+
+def _rolling_codes(batch: Any) -> Any:
+    """[B, L] u8 -> [B, L+4] u32: the little-endian 4-byte code at
+    every position (positions L..L+3 read zero pad only — present so
+    the wide tier's +4 chained lookup stays in bounds)."""
+    B, L = batch.shape
+    xb = jnp.concatenate(
+        [batch, jnp.zeros((B, 8), dtype=jnp.uint8)], axis=1)
+    x32 = xb.astype(jnp.uint32)
+    n = L + 4
+    return (x32[:, :n]
+            | (x32[:, 1 : n + 1] << jnp.uint32(8))
+            | (x32[:, 2 : n + 2] << jnp.uint32(16))
+            | (x32[:, 3 : n + 3] << jnp.uint32(24)))
+
+
+def _probe_tier(keys_at: Any, roll: Any, slot_key: Any, slot_eid: Any,
+                start: Any, fid: Any, anchor: Any,
+                bounds: "tuple[int, int, int]", st: SweepTables,
+                lens: Any, accw: "list[Any]") -> None:
+    """One tier's dense hash probe + bounded bucket walk + masked word
+    verify, OR-ing verified factors' group bitsets into ``accw``.
+    ``keys_at`` is the per-position tier KEY array ([B, L]); ``roll``
+    the shared rolling-code array ([B, L+4]) the verify compares
+    against."""
+    max_probe, max_bucket, n_words = bounds
+    H = int(slot_key.shape[0])
+    E = int(start.shape[0]) - 1
+    if max_probe == 0 or E <= 0:
+        return
+    B, L = keys_at.shape
+    bits = H.bit_length() - 1
+    h = (keys_at * jnp.uint32(_FIB)) >> jnp.uint32(32 - bits)
+    eid = jnp.full((B, L), -1, dtype=jnp.int32)
+    for j in range(max_probe):
+        s = ((h + jnp.uint32(j)) & jnp.uint32(H - 1)).astype(jnp.int32)
+        m = (slot_key[s] == keys_at) & (slot_eid[s] >= 0)
+        eid = jnp.where(m, slot_eid[s], eid)  # keys unique: <=1 match
+    hit = eid >= 0
+    eidc = jnp.clip(eid, 0, E - 1)
+    b_lo = start[eidc]
+    b_hi = start[eidc + 1]
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    NE = int(fid.shape[0])
+    GW = int(st.fac_groups.shape[-1])
+    for j in range(max_bucket):
+        e = b_lo + j
+        in_bucket = hit & (e < b_hi)
+        ec = jnp.clip(e, 0, NE - 1)
+        f = fid[ec]
+        flen = st.fac_len[f]
+        begin = pos - anchor[ec]
+        ver = in_bucket & (begin >= 0) & (begin + flen <= lens)
+        bc = jnp.clip(begin, 0, L - 1)
+        for w in range(n_words):
+            cw = jnp.take_along_axis(
+                roll, jnp.minimum(bc + 4 * w, L + 3), axis=1)
+            ver = ver & ((cw & st.fac_wmask[..., w][f])
+                         == st.fac_words[..., w][f])
+        for g in range(GW):
+            bits_g = jnp.where(ver, st.fac_groups[..., g][f],
+                               jnp.uint32(0))  # [B, L]
+            accw[g] = accw[g] | jax.lax.reduce(
+                bits_g, np.uint32(0), jax.lax.bitwise_or, (1,))
+
+
+@jax.jit
+def sweep_group_candidates(st: SweepTables, batch: Any,
+                           lengths: Any) -> Any:
+    """[B, L] u8 rows + [B] lengths -> [B, G] bool candidate matrix:
+    True where some guard factor of group g occurs INSIDE the line (or
+    g is always-candidate). Device twin of the host
+    ``FactorIndex.group_candidates`` — exact same survivors (module
+    docstring), just packed rows instead of a framed payload."""
+    B, L = batch.shape
+    G = st.n_groups
+    GW = int(st.fac_groups.shape[-1])
+    always = jnp.broadcast_to(
+        _unpack_bits(st.always_mask[None, :], G), (B, G))
+    if L == 0 or (st.n_bounds[0] == 0 and st.w_bounds[0] == 0):
+        return always
+    roll = _rolling_codes(batch)          # [B, L+4]
+    codes = roll[:, :L]
+    lens = lengths.astype(jnp.int32)[:, None]
+    accw = [jnp.zeros((B,), dtype=jnp.uint32) for _ in range(GW)]
+    _probe_tier(codes, roll, st.n_slot_key, st.n_slot_eid, st.n_start,
+                st.n_fid, st.n_anchor, st.n_bounds, st, lens, accw)
+    # Wide tier key: Fibonacci mix of this code and the one 4 bytes
+    # ahead — the chained half-window conjunction as ONE u32 key.
+    wkey = (codes * jnp.uint32(_FIB)) ^ roll[:, 4 : L + 4]
+    _probe_tier(wkey, roll, st.w_slot_key, st.w_slot_eid, st.w_start,
+                st.w_fid, st.w_anchor, st.w_bounds, st, lens, accw)
+    acc = jnp.stack(accw, axis=1)  # [B, GW]
+    return _unpack_bits(acc, G) | always

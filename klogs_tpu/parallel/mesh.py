@@ -189,7 +189,10 @@ class MeshEngine:
         import os
 
         from klogs_tpu.ops.nfa import _pad_to
-        from klogs_tpu.ops.pallas_nfa import match_cls_grouped_pallas
+        from klogs_tpu.ops.pallas_nfa import (
+            match_batch_grouped_pallas,
+            match_cls_grouped_pallas,
+        )
 
         probe = [nfa.compile_grouped(ps, ignore_case=ignore_case)[0]
                  for ps in groups]
@@ -251,6 +254,36 @@ class MeshEngine:
                 and self.cls_table is not None:
             pf_stacked = self._stack_prefilters(groups, ignore_case, glob, C)
 
+        # Device literal sweep (thousand-pattern fused path): per-shard
+        # sweep tables stacked shape-uniform, gating each shard's
+        # (tile, group) grid cells on ITS patterns' factor-index
+        # candidate mask. Same auto rule as the single-chip engine
+        # (K threshold + real accelerator; KLOGS_TPU_SWEEP=0/1
+        # overrides), and an explicit prefilter opt-in wins over the
+        # auto sweep — the kernel takes one gate.
+        sweep_stacked = None
+        n_patterns = sum(len(ps) for ps in groups)
+        from klogs_tpu.filters.cpu import device_sweep_env, device_sweep_wanted
+
+        if device_sweep_wanted(n_patterns, interpret=interpret):
+            from klogs_tpu.ui import term
+
+            if pf_stacked is not None and device_sweep_env() != "1":
+                # Explicit prefilter opt-in beats the auto sweep —
+                # same precedence and operator notice as _init_sweep.
+                term.info(
+                    "KLOGS_TPU_PREFILTER=1 active; device sweep stays "
+                    "off (set KLOGS_TPU_SWEEP=1 to prefer the sweep)")
+            else:
+                sweep_stacked = self._stack_sweeps(
+                    groups, ignore_case, dps, G)
+                if sweep_stacked is not None and pf_stacked is not None:
+                    term.info(
+                        "KLOGS_TPU_SWEEP=1 supersedes "
+                        "KLOGS_TPU_PREFILTER on the mesh: the "
+                        "literal sweep subsumes the pair-CNF gate")
+                    pf_stacked = None
+
         # Same chain-variant policy as the single-chip hot path
         # (tune.chain_selection: measured default mask_block=4 on
         # hardware, env-overridable), minus `fused` — it has no gated
@@ -306,11 +339,47 @@ class MeshEngine:
 
         self._build = build
 
+        def build_sweep(vkw=vkw):
+            def per_shard(dp_shard, batch_local, lengths_local,
+                          sweep_shard):
+                local = jax.tree_util.tree_map(lambda x: x[0], dp_shard)
+                st = jax.tree_util.tree_map(lambda x: x[0], sweep_shard)
+                matched = match_batch_grouped_pallas(
+                    local, live, acc, batch_local, lengths_local,
+                    interpret=interpret, sweep_tables=st, **vkw)
+                return jax.lax.pmax(matched.astype(jnp.int32),
+                                    "pattern") > 0
+
+            specs = dict(
+                mesh=self.mesh,
+                in_specs=(
+                    jax.tree_util.tree_map(lambda _: P("pattern"),
+                                           stacked),
+                    P("data", None),
+                    P("data"),
+                    jax.tree_util.tree_map(lambda _: P("pattern"),
+                                           sweep_stacked),
+                ),
+                out_specs=P("data"),
+            )
+            try:
+                smapped = shard_map(per_shard, check_vma=False, **specs)
+            except TypeError:
+                smapped = shard_map(per_shard, check_rep=False, **specs)
+            return jax.jit(
+                lambda dp, batch, lengths, st=sweep_stacked:
+                smapped(dp, batch, lengths, st))
+
         # The plain fn always exists: it is both the default path and
         # the degrade target when the opt-in gated kernel fails (same
         # contract as the single-chip fetch-time fallback).
         self._fn = build(False)
         self._fn_gated = build(True) if pf_stacked is not None else None
+        # Byte-consuming fused path: match_batch routes through it when
+        # built (frame -> sweep -> gated match per shard, one device
+        # dispatch); match_cls cannot (no bytes to sweep).
+        self._fn_sweep = (build_sweep() if sweep_stacked is not None
+                          else None)
         self.impl = impl
 
     def disable_prefilter(self) -> None:
@@ -321,6 +390,45 @@ class MeshEngine:
     @property
     def gated(self) -> bool:
         return getattr(self, "_fn_gated", None) is not None
+
+    def disable_sweep(self) -> None:
+        """Degrade the fused sweep path to host-classify + plain kernel
+        (e.g. after a sweep-kernel failure surfaced at fetch)."""
+        self._fn_sweep = None
+
+    @property
+    def swept(self) -> bool:
+        return getattr(self, "_fn_sweep", None) is not None
+
+    @staticmethod
+    def _stack_sweeps(groups, ignore_case, dps, G):
+        """Per-shard device-sweep tables over each shard's OWN pattern
+        set, retargeted to its grouped program's pattern_group map (the
+        forced-uniform G makes always/group bitsets shape-uniform), and
+        stacked [n_shards, ...] via ops.sweep.stack_sweep_tables.
+        Returns None (sweep off everywhere) when any shard's tables
+        fail to build — shard_map runs one program."""
+        from klogs_tpu.filters.compiler.groups import analyze, plan_groups
+        from klogs_tpu.filters.compiler.index import FactorIndex
+        from klogs_tpu.ops.sweep import stack_sweep_tables
+
+        progs = []
+        try:
+            for ps, dp in zip(groups, dps):
+                infos = analyze(ps, ignore_case=ignore_case)
+                index = FactorIndex(infos, plan_groups(infos))
+                progs.append(index.sweep_program(
+                    group_of=np.asarray(dp.pattern_group,
+                                        dtype=np.int32),
+                    n_groups=G))
+            return stack_sweep_tables(progs)
+        except Exception as e:
+            from klogs_tpu.ui import term
+
+            term.warning(
+                "mesh device sweep unavailable (%s: %s); running the "
+                "plain kernel", type(e).__name__, e)
+            return None
 
     @staticmethod
     def _stack_prefilters(groups, ignore_case, glob, C):
@@ -373,6 +481,20 @@ class MeshEngine:
         match_cls — same verdicts, one extra host pass; filters that can
         produce cls directly (pack_classify) should call match_cls."""
         if self.impl in ("pallas", "pallas_interpret"):
+            if self.swept:
+                try:
+                    return self._match_batch_swept(batch, lengths)
+                except Exception as e:
+                    # Fused-sweep compile/dispatch trouble degrades to
+                    # the classify path, not a dead stream (same
+                    # contract as the gated kernel).
+                    from klogs_tpu.ui import term
+
+                    term.warning(
+                        "mesh fused sweep kernel unavailable (%s); "
+                        "falling back to host classify + plain NFA",
+                        str(e)[:120])
+                    self.disable_sweep()
             from klogs_tpu.filters.tpu import classify_batch
 
             cls = classify_batch(batch, lengths, self._glob,
@@ -391,6 +513,26 @@ class MeshEngine:
             )
         return self._fn(self.dp, self._place_data(batch, P("data", None)),
                         self._place_data(lengths, P("data")))
+
+    def _match_batch_swept(self, batch: np.ndarray, lengths: np.ndarray):
+        """Fused byte path: [B, L] u8 + [B] i32 -> [>=B] bool device
+        mask via frame -> device sweep -> gated match per shard (one
+        dispatch, no host classify). Rows pad to a data-axis multiple;
+        zero-length pad rows can never host a factor or match."""
+        B = batch.shape[0]
+        d = self.grid[0]
+        Bp = math.ceil(B / d) * d
+        if Bp != B:
+            batch = np.concatenate(
+                [batch, np.zeros((Bp - B, batch.shape[1]),
+                                 dtype=batch.dtype)])
+            lengths = np.concatenate(
+                [lengths, np.zeros((Bp - B,), dtype=lengths.dtype)])
+        return self._fn_sweep(
+            self.dp, self._place_data(batch, P("data", None)),
+            self._place_data(np.ascontiguousarray(lengths,
+                                                  dtype=np.int32),
+                             P("data")))
 
     def match_cls(self, cls: np.ndarray, plain: bool = False):
         """Hot-path entry for pallas impls: [B, T] int8/int32 class ids

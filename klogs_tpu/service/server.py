@@ -67,6 +67,32 @@ def _make_filter(patterns: list[str], backend: str,
     return build_include_exclude(one, patterns, exclude)
 
 
+def _uses_device_sweep(filt) -> bool:
+    """True when any engine behind ``filt`` (possibly an
+    include/exclude combiner) runs the fused device literal sweep —
+    the TPU engine's sweep tables or an IndexedFilter narrowing on the
+    device path."""
+    stack = [filt]
+    while stack:
+        f = stack.pop()
+        for attr in ("include", "exclude", "inner"):
+            sub = getattr(f, attr, None)
+            if sub is not None:
+                stack.append(sub)
+        if getattr(f, "_sweep_tables", None) is not None and \
+                getattr(f, "_sweep_path", "device") == "device" and \
+                not getattr(f, "bypassed", False):
+            # bypassed: an IndexedFilter that switched itself to
+            # scan-all no longer sweeps at all — stop advertising it.
+            return True
+        # A mesh-backed engine carries the sweep inside MeshEngine
+        # (its _fn_sweep, surfaced as `swept`), not in the wrapper's
+        # _sweep_tables.
+        if getattr(getattr(f, "_engine", None), "swept", False):
+            return True
+    return False
+
+
 def _read_tls(path: str, what: str) -> bytes:
     try:
         with open(path, "rb") as f:
@@ -156,11 +182,24 @@ class FilterServer:
             # a closed service means restart; a merely-cold one does not.
             self.health.add_live_check(
                 "coalescer", lambda: not self._service._closed)
-        self._service = AsyncFilterService(
-            _make_filter(patterns, backend, ignore_case=ignore_case,
-                         exclude=self.exclude, stats=self._stats),
-            stats=self._stats)
+        self._filter = _make_filter(patterns, backend,
+                                    ignore_case=ignore_case,
+                                    exclude=self.exclude,
+                                    stats=self._stats)
+        self._service = AsyncFilterService(self._filter,
+                                           stats=self._stats)
         self._server: grpc.aio.Server | None = None
+
+    @property
+    def device_sweep(self) -> bool:
+        """Engine-detail discovery (Hello): whether the thousand-
+        pattern device sweep is gating this server's kernel RIGHT NOW
+        — an operator debugging a fleet throughput step needs to see
+        which servers run the fused path without scraping each
+        sidecar. Computed per Hello, not cached at startup: a sweep
+        that degraded mid-run (kernel failure, host fallback) must
+        stop being advertised."""
+        return _uses_device_sweep(self._filter)
 
     @property
     def auth_enabled(self) -> bool:
@@ -266,6 +305,10 @@ class FilterServer:
             # Old clients ignore both keys.
             "metrics_port": self.metrics_port,
             "metrics_host": self.metrics_host,
+            # Engine detail: whether the fused device literal sweep is
+            # gating this server's kernel (thousand-pattern mode).
+            # Old clients ignore the key.
+            "device_sweep": self.device_sweep,
         })
 
     async def _match(self, request: bytes, context) -> bytes:

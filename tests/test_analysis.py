@@ -121,7 +121,7 @@ def test_mypy_gate():
         pytest.skip("mypy not installed in this environment")
     proc = subprocess.run(
         ["mypy", "klogs_tpu/obs", "klogs_tpu/filters/compiler",
-         "klogs_tpu/service/transport.py"],
+         "klogs_tpu/ops/sweep.py", "klogs_tpu/service/transport.py"],
         cwd=REPO, capture_output=True, text=True, timeout=300)
     assert proc.returncode == 0, proc.stdout + proc.stderr
 

@@ -59,6 +59,7 @@ def test_bench_k_axis_contract(tmp_path):
     driver and docs/PATTERNS.md promise) — smoke-sized Ks here; the
     real K ∈ {32..4096} sweep is the committed BENCH_K.json."""
     out = tmp_path / "BENCH_K.json"
+    sweep_out = tmp_path / "BENCH_SWEEP.json"
     env = dict(os.environ)
     # Ambient engine overrides (README-documented knobs) would flip
     # the auto_engine row and fail the assertion below spuriously.
@@ -70,6 +71,7 @@ def test_bench_k_axis_contract(tmp_path):
         "KLOGS_BENCH_K_LINES": "6000",
         "KLOGS_BENCH_REPEATS": "1",
         "KLOGS_BENCH_K_OUT": str(out),
+        "KLOGS_BENCH_SWEEP_OUT": str(sweep_out),
     })
     res = subprocess.run(
         [sys.executable, "bench.py", "--k-axis"], cwd=REPO, env=env,
@@ -91,6 +93,16 @@ def test_bench_k_axis_contract(tmp_path):
     # sweep itself; above the auto threshold the indexed engine is
     # the production path.
     assert rec["rows"][1]["auto_engine"] == "indexed"
+    # The narrowing stage's own trajectory rides along: one
+    # BENCH_SWEEP row per K, host vs device sweep, and the masks must
+    # have agreed on the corpus (parity is measured, not assumed).
+    sw = json.loads(sweep_out.read_text())
+    assert [r["k"] for r in sw["rows"]] == [8, 64]
+    for row in sw["rows"]:
+        assert row["host_sweep_lps"] > 0
+        assert row["device_sweep_lps"] > 0
+        assert row["backend"]
+        assert row["parity"] is True
 
 
 def test_graft_entry_contract():

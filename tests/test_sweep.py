@@ -1,0 +1,620 @@
+"""Device-side literal sweep (ops/sweep.py): host-vs-device candidate
+mask PARITY (the host sweep is the oracle — exact same survivors, bit
+for bit), fused sweep+NFA dispatch vs the plain kernel, mesh table
+stacking, the engine auto/override rules, and every degrade path.
+
+The load-bearing invariant: the device mask must EQUAL the host mask,
+not merely bound it. Equality is what lets the host sweep act as the
+parity oracle for a path that normally only runs on accelerators."""
+
+import random
+import re
+
+import numpy as np
+import pytest
+
+import jax
+
+from klogs_tpu.filters.base import frame_lines
+from klogs_tpu.filters.compiler.groups import analyze, plan_groups
+from klogs_tpu.filters.compiler.index import (
+    SWEEP_FACTOR_CAP,
+    FactorIndex,
+    pack_sweep_tier,
+)
+from klogs_tpu.ops import nfa, pallas_nfa
+from klogs_tpu.ops.sweep import (
+    device_sweep_tables,
+    stack_sweep_tables,
+    sweep_group_candidates,
+)
+
+ALPHA = b"abcdef0123-=/ :"
+
+
+def _index(pats: "list[str]", **plan_kw) -> FactorIndex:
+    infos = analyze(pats)
+    return FactorIndex(infos, plan_groups(infos, **plan_kw))
+
+
+def _frame(lines):
+    payload, offsets, _ = frame_lines(lines)
+    return payload, np.asarray(offsets, dtype=np.int32)
+
+
+def _pack(lines, width: "int | None" = None):
+    w = width if width is not None else max(
+        [len(l) for l in lines] + [1])
+    batch = np.zeros((len(lines), w), dtype=np.uint8)
+    for i, l in enumerate(lines):
+        batch[i, : len(l)] = np.frombuffer(l, dtype=np.uint8)
+    return batch, np.asarray([len(l) for l in lines], dtype=np.int32)
+
+
+def _host_mask(idx: FactorIndex, lines) -> np.ndarray:
+    payload, offsets = _frame(lines)
+    return idx.group_candidates(payload, offsets)
+
+
+def _device_mask(idx: FactorIndex, lines,
+                 width: "int | None" = None) -> np.ndarray:
+    st = device_sweep_tables(idx.sweep_program())
+    batch, lens = _pack(lines, width)
+    return np.asarray(sweep_group_candidates(st, batch, lens))
+
+
+# -- host/device candidate-mask parity --------------------------------
+
+
+def test_parity_mixed_tiers():
+    # Narrow (4-7B), wide (>=8B), 3-byte extension tier, an OR guard,
+    # and an unguarded pattern (always-candidate lane) in one set.
+    pats = ["ERR!", "panic: out of memory", "x!z", "FATAL|CRIT",
+            r"[a-z]*\d?"]
+    idx = _index(pats, max_group_patterns=2)
+    lines = [b"an ERR! line", b"panic: out of memory now", b"ax!zb",
+             b"CRIT boom", b"benign", b"", b"x!z",
+             b"panic: out of memor_", b"ERR", b"FATA"]
+    host = _host_mask(idx, lines)
+    dev = _device_mask(idx, lines)
+    assert np.array_equal(host, dev), (host, dev)
+
+
+def test_parity_boundary_placements():
+    # Factor at position 0, flush against the line end, line exactly
+    # the factor, line one byte short, and empty lines.
+    pats = ["headlit", "tail4"]
+    idx = _index(pats)
+    lines = [b"headlit rest", b"ends with tail4", b"headlit", b"tail4",
+             b"headli", b"ail4", b"", b"x"]
+    assert np.array_equal(_host_mask(idx, lines),
+                          _device_mask(idx, lines))
+
+
+def test_parity_cross_line_factor():
+    """A factor spanning two framed lines counts for NEITHER on the
+    host; the packed device rows can never see it — parity means the
+    host sweep must agree (regression for the framed path's boundary
+    masking)."""
+    pats = ["abcdefgh", "wxyz"]
+    idx = _index(pats)
+    lines = [b"abcd", b"efgh", b"ww", b"xyz", b"xabcdefghx"]
+    host = _host_mask(idx, lines)
+    dev = _device_mask(idx, lines)
+    assert np.array_equal(host, dev)
+    assert not host[0].any() and not host[1].any()
+    assert host[4].any()
+
+
+def test_parity_overlong_factor_cap():
+    # A mandatory literal past SWEEP_FACTOR_CAP sweeps as a rarest
+    # window of exactly the cap on BOTH paths.
+    lit = "prefix-" + "q" * SWEEP_FACTOR_CAP + "-suffix"
+    pats = [lit, "other-lit"]
+    idx = _index(pats)
+    lines = [lit.encode(), lit.encode()[:-4], b"other-lit here",
+             b"no hits at all", b"q" * SWEEP_FACTOR_CAP]
+    assert np.array_equal(_host_mask(idx, lines),
+                          _device_mask(idx, lines))
+
+
+def test_parity_padded_rows_inert():
+    # Width padding beyond every line is zero bytes: it must neither
+    # create nor destroy candidates vs the tight packing.
+    pats = ["needle-lit", "ha[yx]stack"]
+    idx = _index(pats)
+    lines = [b"a needle-lit b", b"haystack", b"nothing"]
+    tight = _device_mask(idx, lines)
+    wide = _device_mask(idx, lines, width=256)
+    assert np.array_equal(tight, wide)
+    assert np.array_equal(tight, _host_mask(idx, lines))
+
+
+def test_parity_random_property():
+    """Random literal sets + lines with planted factors at random
+    offsets (including offset 0 and flush-right): full mask equality,
+    and the mask never hides a true regex match (necessity)."""
+    rng = random.Random(20260803)
+    for _ in range(14):
+        pats = []
+        for _ in range(rng.randrange(2, 10)):
+            n = rng.randrange(3, 14)
+            pats.append(re.escape(
+                "".join(chr(ALPHA[rng.randrange(len(ALPHA))])
+                        for _ in range(n))))
+        idx = _index(pats, max_group_patterns=3)
+        lines = []
+        for _ in range(40):
+            body = bytes(ALPHA[rng.randrange(len(ALPHA))]
+                         for _ in range(rng.randrange(0, 48)))
+            if rng.random() < 0.5:
+                p = pats[rng.randrange(len(pats))]
+                raw = p.replace("\\", "").encode()
+                at = rng.choice([0, len(body),
+                                 rng.randrange(len(body) + 1)])
+                body = body[:at] + raw + body[at:]
+            lines.append(body)
+        host = _host_mask(idx, lines)
+        dev = _device_mask(idx, lines)
+        assert np.array_equal(host, dev), (pats, lines)
+        gof = idx._group_of
+        for i, line in enumerate(lines):
+            for p, pat in enumerate(pats):
+                if re.search(pat.encode(), line):
+                    assert dev[i, int(gof[p])], (pat, line)
+
+
+@pytest.mark.slow
+def test_parity_k1024_bench_corpus():
+    """The BENCH_K shapes at K=1024: full host/device mask parity over
+    the real bench corpus and pattern minting (multi-minute at K=4096,
+    so the tier-1 copy stops at 1k — the bench run itself re-asserts
+    parity per K in BENCH_SWEEP.json)."""
+    import bench
+
+    pats = bench.make_patterns(1024)
+    idx = _index(pats)
+    lines = [ln.rstrip(b"\n") for ln in bench.make_lines(8192)]
+    host = _host_mask(idx, lines)
+    dev = _device_mask(idx, lines)
+    assert np.array_equal(host, dev)
+
+
+# -- table packing ----------------------------------------------------
+
+
+def test_sweep_program_cached_and_retarget():
+    idx = _index(["aaaa-lit", "bbbb-lit"])
+    assert idx.sweep_program() is idx.sweep_program()
+    re_t = idx.sweep_program(
+        group_of=np.zeros(2, dtype=np.int32), n_groups=5)
+    assert re_t is not idx.sweep_program()
+    assert re_t.n_groups == 5
+
+
+def test_sweep_program_group_of_validation():
+    idx = _index(["aaaa-lit", "bbbb-lit"])
+    with pytest.raises(ValueError, match="maps 3 patterns"):
+        idx.sweep_program(group_of=np.zeros(3, dtype=np.int32))
+
+
+def test_pack_sweep_tier_forced_hash_size():
+    entries = [(i * 2654435761 % (1 << 32), i, 0) for i in range(9)]
+    t = pack_sweep_tier(entries)
+    big = pack_sweep_tier(entries, hash_size=4 * len(t.slot_key))
+    assert len(big.slot_key) == 4 * len(t.slot_key)
+    # Same (key -> entries) content regardless of table size.
+    assert np.array_equal(t.keys, big.keys)
+    assert np.array_equal(t.fid, big.fid)
+    with pytest.raises(ValueError, match="power of two"):
+        pack_sweep_tier(entries, hash_size=48)
+    with pytest.raises(ValueError, match="power of two"):
+        pack_sweep_tier(entries, hash_size=4)
+
+
+def test_sweep_tables_pytree_roundtrip():
+    idx = _index(["roundtrip-lit", "x!z"])
+    st = device_sweep_tables(idx.sweep_program())
+    leaves, treedef = jax.tree_util.tree_flatten(st)
+    st2 = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert st2.n_groups == st.n_groups
+    assert st2.n_bounds == st.n_bounds and st2.w_bounds == st.w_bounds
+    lines = [b"a roundtrip-lit b", b"nope", b"x!z"]
+    batch, lens = _pack(lines)
+    assert np.array_equal(
+        np.asarray(sweep_group_candidates(st, batch, lens)),
+        np.asarray(sweep_group_candidates(st2, batch, lens)))
+
+
+def test_stack_sweep_tables_per_shard_parity():
+    """Stacking pads every leaf to fleet maxima and REBUILDS smaller
+    hash tables at the uniform size: each shard's slice of the stack
+    must produce that shard's exact mask."""
+    sets = [["shard0-lit", "aaaa", "x!z"],
+            ["shard1-" + "w" * 20] + [f"svc-{i:03d} down"
+                                      for i in range(24)]]
+    G = 8
+    idxs = [_index(ps) for ps in sets]
+    progs = [idx.sweep_program(
+        group_of=np.asarray(idx._group_of, dtype=np.int32), n_groups=G)
+        for idx in idxs]
+    stacked = stack_sweep_tables(progs)
+    lines = [b"a shard0-lit b", b"svc-007 down", b"x!z", b"benign",
+             b"shard1-" + b"w" * 20, b""]
+    batch, lens = _pack(lines)
+    for i, prog in enumerate(progs):
+        solo = np.asarray(sweep_group_candidates(
+            device_sweep_tables(prog), batch, lens))
+        shard = jax.tree_util.tree_map(lambda x, i=i: x[i], stacked)
+        got = np.asarray(sweep_group_candidates(shard, batch, lens))
+        assert np.array_equal(got, solo), i
+
+
+def test_stack_sweep_tables_validation():
+    idx = _index(["aaaa-lit"])
+    with pytest.raises(ValueError, match="at least one"):
+        stack_sweep_tables([])
+    a = idx.sweep_program(n_groups=2)
+    b = idx.sweep_program(n_groups=3)
+    with pytest.raises(ValueError, match="disagree on n_groups"):
+        stack_sweep_tables([a, b])
+
+
+# -- fused sweep + NFA dispatch ---------------------------------------
+
+FUSE_PATTERNS = (["ERROR 00[0-9]7", "WARN disk", "user=[a-z]+ failed",
+                  "FATAL|CRIT"]
+                 + [f"svc-{i} timeout" for i in range(28)])
+FUSE_LINES = [b"x ERROR 0007 boom", b"nothing here",
+              b"svc-13 timeout hit", b"WARN disk full",
+              b"user=bob failed", b"", b"CRIT", b"svc-27 timeout",
+              b"svc-28 timeout", b"almost WARN dis"]
+
+
+def _fuse_setup():
+    dp, live, acc = nfa.compile_grouped(FUSE_PATTERNS)
+    idx = _index(FUSE_PATTERNS)
+    prog = idx.sweep_program(
+        group_of=np.asarray(dp.pattern_group, dtype=np.int32),
+        n_groups=int(dp.follow.shape[0]))
+    return dp, live, acc, device_sweep_tables(prog)
+
+
+def test_fused_dispatch_matches_plain_and_oracle():
+    """One fused frame -> sweep -> gated-match dispatch returns the
+    exact verdicts of the two-dispatch path (plain kernel) and the re
+    oracle, and its stats triple is coherent."""
+    dp, live, acc, st = _fuse_setup()
+    batch, lens = _pack(FUSE_LINES, width=32)
+    plain = np.asarray(pallas_nfa.match_batch_grouped_pallas(
+        dp, live, acc, batch, lens, interpret=True))
+    fused, stats = pallas_nfa.match_batch_grouped_pallas(
+        dp, live, acc, batch, lens, interpret=True,
+        sweep_tables=st, return_stats=True)
+    fused = np.asarray(fused)
+    want = np.array([any(re.search(p.encode(), l)
+                         for p in FUSE_PATTERNS) for l in FUSE_LINES])
+    assert np.array_equal(fused, plain)
+    assert np.array_equal(fused, want)
+    n_cand, n_live, n_tiles = (int(np.asarray(s)) for s in stats)
+    assert 0 < n_cand <= len(FUSE_LINES)
+    assert 0 < n_live <= n_tiles
+
+
+def test_fused_dispatch_rejects_wrong_group_count():
+    dp, live, acc, _ = _fuse_setup()
+    idx = _index(FUSE_PATTERNS)
+    bad = device_sweep_tables(idx.sweep_program(
+        group_of=np.asarray(dp.pattern_group, dtype=np.int32),
+        n_groups=int(dp.follow.shape[0]) + 3))
+    batch, lens = _pack(FUSE_LINES, width=32)
+    with pytest.raises(Exception, match="pattern_group"):
+        np.asarray(pallas_nfa.match_batch_grouped_pallas(
+            dp, live, acc, batch, lens, interpret=True,
+            sweep_tables=bad))
+
+
+def test_fused_combo_exclusions():
+    # The kernel takes ONE gate: sweep + prefilter is an error, and
+    # the fused-groups variant has no gated form at all.
+    from klogs_tpu.ops.pallas_nfa import _check_fused_combo
+
+    with pytest.raises(ValueError, match="mutually exclusive gates"):
+        _check_fused_combo(False, ("pf",), 1, 1, sweep_tables=("st",))
+    with pytest.raises(ValueError, match="no gated variant"):
+        _check_fused_combo(True, None, 1, 1, sweep_tables=("st",))
+
+
+# -- NFAEngineFilter wiring -------------------------------------------
+
+
+def test_engine_forced_sweep_parity(monkeypatch):
+    monkeypatch.setenv("KLOGS_TPU_SWEEP", "1")
+    from klogs_tpu.filters.tpu import NFAEngineFilter
+    from klogs_tpu.obs.metrics import Registry
+    from klogs_tpu.filters.base import FilterStats
+
+    reg = Registry()
+    f = NFAEngineFilter(FUSE_PATTERNS, kernel="interpret",
+                        stats=FilterStats(registry=reg))
+    assert f._sweep_tables is not None
+    got = f.match_lines(FUSE_LINES)
+    want = [any(re.search(p.encode(), l) for p in FUSE_PATTERNS)
+            for l in FUSE_LINES]
+    assert got == want
+    fam = reg.family("klogs_sweep_batches_total")
+    assert fam.labels(path="device").value >= 1
+
+
+def test_engine_sweep_env_off_and_auto_rules(monkeypatch):
+    from klogs_tpu.filters import tpu as tpu_mod
+
+    monkeypatch.setenv("KLOGS_TPU_SWEEP", "0")
+    f = tpu_mod.NFAEngineFilter(FUSE_PATTERNS, kernel="interpret")
+    assert f._sweep_tables is None
+    # auto on the CPU backend stays off even past the K threshold
+    # (dense sweep is gather-bound there; BENCH_SWEEP.json).
+    monkeypatch.setenv("KLOGS_TPU_SWEEP", "auto")
+    f = tpu_mod.NFAEngineFilter(FUSE_PATTERNS * 2, kernel="interpret")
+    assert f._sweep_tables is None
+    monkeypatch.setenv("KLOGS_TPU_SWEEP", "bogus")
+    with pytest.raises(ValueError, match="KLOGS_TPU_SWEEP"):
+        tpu_mod.NFAEngineFilter(FUSE_PATTERNS, kernel="interpret")
+
+
+def test_engine_auto_k_threshold(monkeypatch):
+    """On an accelerator backend auto follows the SAME K threshold as
+    best_host_filter's indexed choice: K=32 stays on the PR 7 path
+    (no sweep tables), K >= index_min_k builds them."""
+    import jax as jax_mod
+
+    from klogs_tpu.filters import tpu as tpu_mod
+
+    monkeypatch.setattr(jax_mod, "default_backend", lambda: "tpu")
+    k32 = [f"svc-{i:02d} timeout" for i in range(32)]
+    f = tpu_mod.NFAEngineFilter(k32, kernel="pallas")
+    assert f._sweep_tables is None
+    k96 = [f"svc-{i:02d} timeout" for i in range(96)]
+    f = tpu_mod.NFAEngineFilter(k96, kernel="pallas")
+    assert f._sweep_tables is not None
+    # interpret is the debug shape: auto never fuses the sweep into
+    # it (same rule as the mesh); =1 still forces it.
+    f = tpu_mod.NFAEngineFilter(k96, kernel="interpret")
+    assert f._sweep_tables is None
+
+
+def test_engine_fused_kernel_failure_degrades(monkeypatch):
+    """A sweep kernel that blows up at dispatch drops the engine to
+    the plain kernel LOUDLY (fallback counter) — verdicts unchanged."""
+    monkeypatch.setenv("KLOGS_TPU_SWEEP", "1")
+    from klogs_tpu.filters.base import FilterStats
+    from klogs_tpu.filters.tpu import NFAEngineFilter
+    from klogs_tpu.obs.metrics import Registry
+
+    reg = Registry()
+    f = NFAEngineFilter(FUSE_PATTERNS, kernel="interpret",
+                        stats=FilterStats(registry=reg))
+    assert f._sweep_tables is not None
+
+    real = f._pallas.match_batch_grouped_pallas
+
+    def boom(*a, **kw):
+        # Only the FUSED dispatch faults; the plain rerun must work
+        # (that is the degrade contract under test).
+        if kw.get("sweep_tables") is not None:
+            raise RuntimeError("injected sweep fault")
+        return real(*a, **kw)
+
+    monkeypatch.setattr(f._pallas, "match_batch_grouped_pallas", boom)
+    got = f.match_lines(FUSE_LINES)
+    want = [any(re.search(p.encode(), l) for p in FUSE_PATTERNS)
+            for l in FUSE_LINES]
+    assert got == want
+    assert f._sweep_tables is None
+    assert reg.family("klogs_sweep_fallback_total").value >= 1
+    # Subsequent batches run plain without re-attempting the sweep.
+    assert f.match_lines(FUSE_LINES) == want
+
+
+def test_forced_sweep_build_failure_keeps_prefilter(monkeypatch):
+    """KLOGS_TPU_SWEEP=1 over an explicit KLOGS_TPU_PREFILTER=1: the
+    working prefilter gate is only discarded AFTER the sweep tables
+    build — a failed build must not leave the engine with neither
+    gate."""
+    monkeypatch.setenv("KLOGS_TPU_SWEEP", "1")
+    monkeypatch.setenv("KLOGS_TPU_PREFILTER", "1")
+    from klogs_tpu.filters.tpu import NFAEngineFilter
+    from klogs_tpu.ops import sweep as sweep_mod
+
+    f = NFAEngineFilter(FUSE_PATTERNS, kernel="interpret")
+    assert f._sweep_tables is not None and f._pf_tables is None
+
+    def boom(prog):
+        raise RuntimeError("injected build fault")
+
+    monkeypatch.setattr(sweep_mod, "device_sweep_tables", boom)
+    f = NFAEngineFilter(FUSE_PATTERNS, kernel="interpret")
+    assert f._sweep_tables is None
+    assert f._pf_tables is not None  # the requested gate survives
+
+
+# -- IndexedFilter device narrowing -----------------------------------
+
+
+def test_indexed_filter_device_vs_host_sweep():
+    from klogs_tpu.filters.cpu import RegexFilter
+    from klogs_tpu.filters.indexed import IndexedFilter
+    from klogs_tpu.obs.metrics import Registry
+
+    rng = random.Random(8)
+    lines = []
+    for _ in range(300):
+        body = bytes(ALPHA[rng.randrange(len(ALPHA))]
+                     for _ in range(rng.randrange(0, 60)))
+        if rng.random() < 0.3:
+            body += rng.choice([b"svc-007 down", b"ERR!", b"x!z"])
+        lines.append(body)
+    pats = ["ERR!", "x!z", "svc-007 down", "svc-1[0-9]+ down",
+            "panic: out of memory"]
+    reg = Registry()
+    dev = IndexedFilter(pats, sweep="device", registry=reg)
+    assert dev._sweep_path == "device"
+    host = IndexedFilter(pats, sweep="host")
+    want = RegexFilter(pats).match_lines(lines)
+    assert dev.match_lines(lines) == want
+    assert host.match_lines(lines) == want
+    fam = reg.family("klogs_sweep_batches_total")
+    assert fam.labels(path="device").value >= 1
+    with pytest.raises(ValueError, match="sweep="):
+        IndexedFilter(pats, sweep="gpu")
+
+
+def test_indexed_filter_device_fallback(monkeypatch):
+    from klogs_tpu.filters.indexed import IndexedFilter
+    from klogs_tpu.obs.metrics import Registry
+    from klogs_tpu.ops import sweep as sweep_mod
+
+    reg = Registry()
+    f = IndexedFilter(["fallback-lit", "aaaa"], sweep="device",
+                      registry=reg)
+
+    def boom(*a, **kw):
+        raise RuntimeError("injected device fault")
+
+    monkeypatch.setattr(sweep_mod, "sweep_group_candidates", boom)
+    lines = [b"a fallback-lit b", b"benign", b"aaaa"]
+    assert f.match_lines(lines) == [True, False, True]
+    assert f._sweep_path == "host"
+    assert reg.family("klogs_sweep_fallback_total").value == 1
+    fam = reg.family("klogs_sweep_batches_total")
+    assert fam.labels(path="host").value >= 1
+
+
+def test_indexed_filter_jumbo_line_routes_host():
+    from klogs_tpu.filters import indexed as indexed_mod
+    from klogs_tpu.filters.indexed import IndexedFilter
+    from klogs_tpu.obs.metrics import Registry
+
+    reg = Registry()
+    f = IndexedFilter(["jumbo-lit", "aaaa"], sweep="device",
+                      registry=reg)
+    long = b"x" * (indexed_mod.SWEEP_MAX_WIDTH + 1) + b"jumbo-lit"
+    assert f.match_lines([long, b"benign"]) == [True, False]
+    fam = reg.family("klogs_sweep_batches_total")
+    assert fam.labels(path="host").value == 1
+    assert f._sweep_path == "device"  # not a failure: next slab retries
+    # Padded rows x width past the batch-byte cap also route host
+    # (one 3KB line must not inflate a 64k-row slab to 256 MB).
+    monkeypatch = pytest.MonkeyPatch()
+    try:
+        monkeypatch.setattr(indexed_mod, "SWEEP_MAX_BATCH_BYTES", 256)
+        assert f.match_lines([b"a jumbo-lit b", b"nope"]) == [True, False]
+        assert fam.labels(path="host").value == 2
+    finally:
+        monkeypatch.undo()
+
+
+def test_hello_sweep_flag_tracks_degrades():
+    """_uses_device_sweep (the Hello device_sweep source) reflects the
+    LIVE state: a device-narrowing IndexedFilter counts until it
+    bypasses itself to scan-all."""
+    from klogs_tpu.filters.indexed import IndexedFilter
+    from klogs_tpu.service.server import _uses_device_sweep
+
+    f = IndexedFilter(["hello-flag-lit"], sweep="device")
+    assert _uses_device_sweep(f)
+    f.bypassed = True
+    assert not _uses_device_sweep(f)
+    f.bypassed = False
+    f._sweep_path = "host"
+    assert not _uses_device_sweep(f)
+
+
+def test_indexed_auto_respects_global_kill_switch(monkeypatch):
+    """KLOGS_TPU_SWEEP=0 covers EVERY sweep consumer — the host
+    engine's auto device narrowing included."""
+    import jax as jax_mod
+
+    from klogs_tpu.filters.indexed import IndexedFilter
+
+    monkeypatch.setattr(jax_mod, "default_backend", lambda: "tpu")
+    f = IndexedFilter(["kill-switch-lit"])
+    assert f._sweep_path == "device"
+    monkeypatch.setenv("KLOGS_TPU_SWEEP", "0")
+    f = IndexedFilter(["kill-switch-lit"])
+    assert f._sweep_path == "host"
+
+
+# -- adaptive bypass --------------------------------------------------
+
+
+def test_adaptive_bypass_flips_once(monkeypatch):
+    """A stream the index cannot narrow (every line hits the guard)
+    flips to scan-all after the probation window — once — and the
+    verdicts never change."""
+    monkeypatch.setenv("KLOGS_INDEX_BYPASS_LINES", "64")
+    from klogs_tpu.filters.indexed import IndexedFilter
+    from klogs_tpu.obs.metrics import Registry
+
+    reg = Registry()
+    f = IndexedFilter(["hot-lit"], registry=reg)
+    lines = [b"hot-lit everywhere"] * 40 + [b"hot-lit tail"] * 40
+    want = [True] * 80
+    assert f.match_lines(lines) == want
+    assert f.bypassed
+    assert reg.family("klogs_sweep_bypass_total").value == 1
+    # Still correct (and still counted) after the flip.
+    assert f.match_lines([b"hot-lit x", b"cold"]) == [True, False]
+    assert reg.family("klogs_sweep_bypass_total").value == 1
+
+
+def test_adaptive_bypass_spares_narrowing_streams(monkeypatch):
+    monkeypatch.setenv("KLOGS_INDEX_BYPASS_LINES", "64")
+    from klogs_tpu.filters.indexed import IndexedFilter
+
+    f = IndexedFilter(["rare-needle-lit"])
+    lines = [b"benign chatter"] * 100 + [b"a rare-needle-lit b"]
+    got = f.match_lines(lines)
+    assert got == [False] * 100 + [True]
+    assert not f.bypassed
+
+
+def test_bypass_env_validation(monkeypatch):
+    monkeypatch.setenv("KLOGS_INDEX_BYPASS_RATIO", "nan")
+    from klogs_tpu.filters.indexed import IndexedFilter
+
+    with pytest.raises(ValueError, match="KLOGS_INDEX_BYPASS_RATIO"):
+        IndexedFilter(["aaaa"])
+
+
+# -- mesh -------------------------------------------------------------
+
+
+def test_mesh_sweep_env_validation(monkeypatch):
+    # Same contract as the single-chip engine: a typo'd knob raises,
+    # it does not silently run without the sweep.
+    monkeypatch.setenv("KLOGS_TPU_SWEEP", "bogus")
+    from klogs_tpu.parallel.mesh import MeshEngine
+
+    with pytest.raises(ValueError, match="KLOGS_TPU_SWEEP"):
+        MeshEngine(["mesh-env-lit"], impl="pallas_interpret")
+
+
+def test_mesh_sweep_parity(monkeypatch):
+    """Per-shard stacked sweep tables gate each shard's grid on the
+    fused byte path; verdicts equal the plain mesh path and the
+    oracle, and disable_sweep degrades in place."""
+    monkeypatch.setenv("KLOGS_TPU_SWEEP", "1")
+    from klogs_tpu.parallel.mesh import MeshEngine
+
+    eng = MeshEngine(FUSE_PATTERNS, impl="pallas_interpret")
+    assert eng.swept
+    batch, lens = _pack(FUSE_LINES, width=32)
+    want = np.array([any(re.search(p.encode(), l)
+                         for p in FUSE_PATTERNS) for l in FUSE_LINES])
+    got = np.asarray(eng.match_batch(batch, lens))[: len(FUSE_LINES)]
+    assert np.array_equal(got, want)
+    eng.disable_sweep()
+    assert not eng.swept
+    got = np.asarray(eng.match_batch(batch, lens))[: len(FUSE_LINES)]
+    assert np.array_equal(got, want)

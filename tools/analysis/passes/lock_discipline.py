@@ -55,7 +55,8 @@ SHARED_STATE: dict = {
         # run in AsyncFilterService's executor threads while the loop
         # thread dispatches; the jit-shape set is read/written on both.
         "NFAEngineFilter": _decl("lock", "_state_lock", "_chain_fallback",
-                                 "_pf_tables", "_shapes_seen"),
+                                 "_pf_tables", "_shapes_seen",
+                                 "_sweep_tables"),
     },
     "klogs_tpu/runtime/fanout.py": {
         # Event-loop-confined: no lock, so no sync method (reachable
