@@ -445,6 +445,20 @@ async def _run_async_inner(
 
             obs_registry.family("klogs_build_info").labels(
                 version=_ver).set(1)
+        # Tracing (opt-in): --trace-json turns head sampling fully on
+        # (unless KLOGS_TRACE_SAMPLE pins a rate) and appends every
+        # finished span to the file; with KLOGS_TRACE_SAMPLE alone the
+        # spans still feed /traces (--metrics-port sidecar) and the
+        # degrade flight recorder. Trace counters ride the run
+        # registry when one exists.
+        from klogs_tpu.obs import trace as _trace
+
+        if opts.trace_json is not None:
+            _trace.TRACER.enable_default()
+            _trace.TRACER.set_json_path(opts.trace_json)
+        if obs_registry is not None:
+            _trace.TRACER.bind_registry(obs_registry)
+            _trace.RECORDER.bind_registry(obs_registry)
         # Resilience observability rides the same per-run registry:
         # fault firings, kube retry attempts (the backend exists before
         # the registry, hence the late bind), breaker state (bound in
@@ -628,6 +642,12 @@ async def _run_async_inner(
                 await metrics_srv.stop()
             if pipeline is not None:
                 await pipeline.aclose()
+            # A degrade trigger armed near the end of the run may have
+            # no further root span to ride — write it now, and stop
+            # appending spans to this run's --trace-json file.
+            _trace.RECORDER.flush()
+            if opts.trace_json is not None:
+                _trace.TRACER.set_json_path(None)
     finally:
         if profiling:
             import jax.profiler

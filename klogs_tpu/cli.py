@@ -54,6 +54,8 @@ New (north-star) flags, absent from the reference:
   --metrics-port    serve Prometheus /metrics + /healthz for this run
                     (obs subsystem; see docs/OBSERVABILITY.md)
   --stats-json      one-shot JSON metrics dump at exit (non-server runs)
+  --trace-json      per-batch trace spans as JSON lines (tracing +
+                    flight recorder; see docs/OBSERVABILITY.md)
   --cluster         cluster backend: kube (real) | fake (hermetic demo)
 """
 
@@ -89,6 +91,7 @@ class Options:
     stats: bool = False
     metrics_port: int | None = None
     stats_json: str | None = None
+    trace_json: str | None = None
     profile: str | None = None
     cluster: str = "kube"
     watch_new: bool = False
@@ -243,6 +246,17 @@ def build_parser() -> argparse.ArgumentParser:
         "PATH at exit (the scrapeless option for batch runs)",
     )
     p.add_argument(
+        "--trace-json",
+        default=None,
+        dest="trace_json",
+        metavar="PATH",
+        help="Write every finished trace span as one JSON line to PATH "
+        "(batch tracing across fanout/coalescer/shard/RPC/device/sink; "
+        "implies KLOGS_TRACE_SAMPLE=1 unless that variable is set). "
+        "The same spans serve /traces on --metrics-port and feed the "
+        "degrade flight recorder — see docs/OBSERVABILITY.md",
+    )
+    p.add_argument(
         "-o",
         "--output",
         choices=["files", "stdout", "both"],
@@ -351,6 +365,7 @@ def parse_args(argv: list[str] | None = None) -> Options:
         stats=ns.stats,
         metrics_port=ns.metrics_port,
         stats_json=ns.stats_json,
+        trace_json=ns.trace_json,
         profile=ns.profile,
         cluster=ns.cluster,
         watch_new=ns.watch_new,

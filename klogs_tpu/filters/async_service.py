@@ -35,6 +35,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 
 from klogs_tpu.filters.base import FilterStats, LogFilter, frame_lines
+from klogs_tpu.obs import trace
 
 # Each in-flight fetch blocks one worker thread for a full host<->device
 # round trip, so sustained batches/s caps at workers / RTT. On a remote
@@ -147,7 +148,16 @@ class AsyncFilterService:
             raise RuntimeError("AsyncFilterService is closed")
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
-        self._pending.append((payload, offsets, n, fut, time.perf_counter()))
+        # The caller's span context rides the pending entry: the
+        # coalesced group's dispatch span parents under the FIRST
+        # caller's trace (one trace carries the full downstream story)
+        # and the other members are linked as events.
+        ctx = trace.TRACER.current_context()
+        if ctx is not None:
+            trace.TRACER.event("coalescer.enqueue", lines=n,
+                               queue_depth=len(self._pending))
+        self._pending.append((payload, offsets, n, fut,
+                              time.perf_counter(), ctx))
         self._pending_lines += n
         if self._m is not None:
             self._m["depth"].set(len(self._pending))
@@ -214,37 +224,69 @@ class AsyncFilterService:
                 base += len(e[0])
             parts.append(np.asarray([base], dtype=np.int32))
             offsets = np.concatenate(parts)
-        try:
-            t_sem = time.perf_counter()
-            async with self._sem:
-                t_dispatch = time.perf_counter()
-                if self._stats is not None:
-                    self._stats.mark_batch_started(t_dispatch)
-                    for *_, enq in group:
-                        self._stats.record_queue_wait(t_dispatch - enq)
-                if self._m is not None:
-                    self._m["bp_wait"].observe(t_dispatch - t_sem)
-                    self._m["groups"].inc()
-                    self._m["members"].observe(len(group))
-                    self._m["lines"].observe(len(offsets) - 1)
-                handle = self._filter.dispatch_framed(payload, offsets)
-                self.batches_dispatched += 1
-                if self._m is not None:
-                    self._m["dispatch"].observe(
-                        time.perf_counter() - t_dispatch)
-                verdicts = await loop.run_in_executor(
-                    self._pool, self._filter.fetch_framed, handle
-                )
-                if self._stats is not None:
-                    self._stats.record_device_batch(
-                        time.perf_counter() - t_dispatch)
-        except Exception as e:
-            for _, _, _, fut, _ in group:
-                if not fut.done():
-                    fut.set_exception(e)
-            return
+        # One trace carries the group's downstream story: the first
+        # member with a recording context parents the dispatch span;
+        # the other members' traces are linked as events (a span cannot
+        # have N parents, but the flight recorder can still connect
+        # them through the link events).
+        parent = next(
+            (e[5] for e in group
+             if e[5] is not None and e[5].sampled),
+            next((e[5] for e in group if e[5] is not None), None))
+        with trace.TRACER.span("coalescer.dispatch", parent=parent,
+                               members=len(group),
+                               lines=len(offsets) - 1) as sp:
+            for e in group:
+                ctx = e[5]
+                if (ctx is not None and ctx is not parent
+                        and getattr(ctx, "sampled", False)):
+                    sp.add_event("coalescer.link",
+                                 trace_id=f"{ctx.trace_id:032x}",
+                                 span_id=f"{ctx.span_id:016x}")
+            try:
+                t_sem = time.perf_counter()
+                async with self._sem:
+                    t_dispatch = time.perf_counter()
+                    if self._stats is not None:
+                        self._stats.mark_batch_started(t_dispatch)
+                        for e in group:
+                            self._stats.record_queue_wait(t_dispatch - e[4])
+                    if self._m is not None:
+                        self._m["bp_wait"].observe(t_dispatch - t_sem)
+                        self._m["groups"].inc()
+                        self._m["members"].observe(len(group))
+                        self._m["lines"].observe(len(offsets) - 1)
+                    sp.add_event("coalescer.dispatching",
+                                 backpressure_wait_s=t_dispatch - t_sem)
+                    handle = self._filter.dispatch_framed(payload, offsets)
+                    self.batches_dispatched += 1
+                    if self._m is not None:
+                        self._m["dispatch"].observe(
+                            time.perf_counter() - t_dispatch)
+                    # The fetch blocks an executor thread for the full
+                    # device round trip; the span wraps the AWAIT (the
+                    # context var does not cross into the thread — the
+                    # await site owns the timing).
+                    with trace.TRACER.span("device.fetch"):
+                        verdicts = await loop.run_in_executor(
+                            self._pool, self._filter.fetch_framed, handle
+                        )
+                    if self._stats is not None:
+                        self._stats.record_device_batch(
+                            time.perf_counter() - t_dispatch)
+            except Exception as e:
+                # The exception is consumed here (routed to the member
+                # futures), so __exit__ would record status=ok — mark
+                # the span explicitly or the flight dump shows a
+                # clean-looking dispatch for the batch that failed.
+                sp.set_status("error")
+                sp.set_attr("error", f"{type(e).__name__}: {e}")
+                for _, _, _, fut, *_ in group:
+                    if not fut.done():
+                        fut.set_exception(e)
+                return
         off = 0
-        for _, _, n, fut, _ in group:
+        for _, _, n, fut, *_ in group:
             if not fut.done():
                 fut.set_result(verdicts[off : off + n])
             off += n

@@ -152,7 +152,12 @@ class FilterStats:
         self._bytes_in.inc(n_bytes_in)
         self._bytes_out.inc(n_bytes_out)
         self._batches.inc()
-        self._batch.observe(latency_s)
+        # Exemplar: when a trace is recording this batch, the latency
+        # sample links to it in the exposition — a p99 outlier points
+        # straight at its hop-by-hop story.
+        from klogs_tpu.obs.trace import TRACER
+
+        self._batch.observe(latency_s, exemplar=TRACER.exemplar())
 
     def record_prefilter(self, n_lines: int, n_candidates: int,
                          n_tiles: int, n_tiles_live: int) -> None:
@@ -174,12 +179,17 @@ class FilterStats:
         """The device sweep degraded (build or kernel failure) and the
         batch ran on the fallback path instead."""
         self._sweep_fallback.inc()
+        from klogs_tpu.obs.trace import flight_trigger
+
+        flight_trigger("sweep-fallback")
 
     def record_queue_wait(self, wait_s: float) -> None:
         self._queue.observe(wait_s)
 
     def record_device_batch(self, latency_s: float) -> None:
-        self._device.observe(latency_s)
+        from klogs_tpu.obs.trace import TRACER
+
+        self._device.observe(latency_s, exemplar=TRACER.exemplar())
 
     def record_deadline_flush(self) -> None:
         """A flush forced by the follow-mode deadline (not batch size)
@@ -270,7 +280,7 @@ def frame_lines(lines: list[bytes], strip_nl: bool = True):
 
 
 def pack_framed_rows(payload: bytes, offsets, width: int,
-                     rows: "int | None" = None):
+                     rows: "int | None" = None, sel=None, lens=None):
     """Framed batch -> ([rows, width] u8 zero-padded row batch,
     [B] int64 lens): the vectorized ragged scatter that turns the
     collector's contiguous payload into the packed row layout device
@@ -279,24 +289,50 @@ def pack_framed_rows(payload: bytes, offsets, width: int,
     source line start — one fancy-indexed assignment, no per-line
     PyBytes. ``rows`` >= B pads extra zero rows (jit-cache row
     bucketing); rows beyond B and columns beyond each line stay zero.
-    Callers must ensure every line fits ``width``. Shared by the
-    IndexedFilter device-sweep path and bench.py so the bench times
-    the SAME packer production runs."""
+    Callers must ensure every line fits ``width``.
+
+    ``sel`` (int row indices) packs only those frame rows, in ``sel``
+    order; ``lens`` overrides the per-row byte counts (selected rows
+    when ``sel`` is given) — how the TPU engine's framed byte entry
+    packs one width bucket with trailing newlines stripped. Shared by
+    that entry, the IndexedFilter device-sweep path, and bench.py so
+    the bench times the SAME packer production runs."""
     import numpy as np
 
-    lens = np.diff(np.asarray(offsets)).astype(np.int64)
+    offsets = np.asarray(offsets)
+    starts = offsets[:-1].astype(np.int64)
+    contiguous = sel is None and lens is None
+    if sel is not None:
+        starts = starts[sel]
+        if lens is None:
+            lens = np.diff(offsets).astype(np.int64)[sel]
+    if lens is None:
+        lens = np.diff(offsets).astype(np.int64)
+    lens = np.asarray(lens, dtype=np.int64)
     B = len(lens)
     if rows is None:
         rows = B
     batch = np.zeros((rows, width), dtype=np.uint8)
-    if int(offsets[-1]) - int(offsets[0]):
+    total = int(lens.sum())
+    if total:
         arr = np.frombuffer(payload, dtype=np.uint8)
-        starts = np.asarray(offsets[:-1], dtype=np.int64)
         row_base = np.arange(B, dtype=np.int64) * width
-        shift = np.repeat(row_base - starts, lens)
-        src = np.arange(int(offsets[0]), int(offsets[-1]),
-                        dtype=np.int64)
-        batch.reshape(-1)[src + shift] = arr[src]
+        if contiguous:
+            # Whole frame, unmodified lens: the source indices are one
+            # arange over the payload span.
+            shift = np.repeat(row_base - starts, lens)
+            src = np.arange(int(offsets[0]), int(offsets[-1]),
+                            dtype=np.int64)
+            batch.reshape(-1)[src + shift] = arr[src]
+        else:
+            # General ragged gather/scatter (row subset and/or
+            # stripped lens): absolute source index per byte via the
+            # standard ragged-range trick.
+            ends = np.cumsum(lens)
+            intra = np.arange(total, dtype=np.int64) - np.repeat(
+                ends - lens, lens)
+            src = np.repeat(starts, lens) + intra
+            batch.reshape(-1)[np.repeat(row_base, lens) + intra] = arr[src]
     return batch, lens
 
 

@@ -194,6 +194,47 @@ def device_sweep_wanted(n_patterns: int,
     return jax.default_backend() not in ("cpu",)
 
 
+def device_gate_choice(n_patterns: int, have_prefilter: bool,
+                       interpret: bool = False) -> str:
+    """THE sweep-vs-prefilter precedence decision, shared by the
+    single-chip engine (tpu.py _init_sweep) and the mesh
+    (parallel/mesh.py) so the two copies can never drift (deferred
+    from PR 8). Returns:
+
+    - ``"off"``: the sweep is not wanted (auto rule / kill switch) —
+      keep whatever prefilter the caller built.
+    - ``"prefilter"``: the sweep IS wanted but an explicit
+      KLOGS_TPU_PREFILTER=1 opt-in wins (the kernel takes one gate);
+      the operator notice is printed here.
+    - ``"sweep"``: build the sweep tables. The caller must only
+      discard a working prefilter AFTER the tables actually build
+      (note_sweep_supersedes prints the notice) — a failed build must
+      not leave the engine with neither gate.
+    """
+    if not device_sweep_wanted(n_patterns, interpret=interpret):
+        return "off"
+    if have_prefilter and device_sweep_env() != "1":
+        from klogs_tpu.ui import term
+
+        term.info(
+            "KLOGS_TPU_PREFILTER=1 active; device sweep stays "
+            "off (set KLOGS_TPU_SWEEP=1 to prefer the sweep)")
+        return "prefilter"
+    return "sweep"
+
+
+def note_sweep_supersedes(mesh: bool = False) -> None:
+    """The operator notice when a FORCED sweep replaces a working
+    prefilter — printed only after the sweep tables built (see
+    device_gate_choice)."""
+    from klogs_tpu.ui import term
+
+    term.info(
+        "KLOGS_TPU_SWEEP=1 supersedes KLOGS_TPU_PREFILTER%s: "
+        "the literal sweep subsumes the pair-CNF gate",
+        " on the mesh" if mesh else "")
+
+
 def best_host_filter(patterns: list[str], ignore_case: bool = False,
                      registry=None):
     """Strongest CPU engine this pattern set admits: the factor-index

@@ -27,6 +27,7 @@ from typing import Any
 import numpy as np
 
 from klogs_tpu.filters.base import LogFilter, frame_lines
+from klogs_tpu.obs import trace
 from klogs_tpu.filters.compiler.groups import (
     MAX_GROUP_PATTERNS,
     MAX_GROUP_POSITIONS,
@@ -273,12 +274,14 @@ class IndexedFilter(LogFilter):
             t0 = time.perf_counter()
             path = "host"
             gm = None
-            if self._sweep_path == "device":
-                gm = self._device_candidates(payload, offsets)
-                if gm is not None:
-                    path = "device"
-            if gm is None:
-                gm = self.index.group_candidates(payload, offsets)
+            with trace.TRACER.span("device.sweep", lines=B) as sp:
+                if self._sweep_path == "device":
+                    gm = self._device_candidates(payload, offsets)
+                    if gm is not None:
+                        path = "device"
+                if gm is None:
+                    gm = self.index.group_candidates(payload, offsets)
+                sp.set_attr("path", path)
             G = len(self.groups)
             cand_lines = int(gm.any(axis=1).sum())
             cand_cells = int(gm.sum())
@@ -366,8 +369,15 @@ class IndexedFilter(LogFilter):
             return None
         try:
             from klogs_tpu.filters.base import pack_framed_rows
-            from klogs_tpu.ops.sweep import sweep_group_candidates
+            from klogs_tpu.ops.sweep import (
+                sweep_group_candidates,
+                sweep_span_attrs,
+            )
 
+            sp = trace.TRACER.current_span()
+            if sp is not None and sp.sampled:
+                for k, v in sweep_span_attrs(self._sweep_tables).items():
+                    sp.set_attr(k, v)
             batch, _ = pack_framed_rows(payload, offsets, width,
                                         rows=rows)
             gm = np.asarray(sweep_group_candidates(
@@ -382,6 +392,7 @@ class IndexedFilter(LogFilter):
                 "from here on", str(e)[:120])
             self._sweep_path = "host"
             self._m_sweep_fallback.inc()
+            trace.flight_trigger("sweep-fallback", error=str(e))
             return None
 
 

@@ -20,6 +20,7 @@ from typing import Callable
 
 from klogs_tpu.filters.base import FilterStats, LogFilter
 from klogs_tpu.filters.framer import LineFramer
+from klogs_tpu.obs import trace
 from klogs_tpu.resilience import Unavailable
 from klogs_tpu.runtime.fanout import StreamJob
 from klogs_tpu.runtime.sink import FileSink, Sink
@@ -102,8 +103,14 @@ class FilteredSink(Sink):
             await self._flush_pending()
 
     async def _flush_pending(self, final: bool = False) -> None:
-        async with self._flush_lock:
-            await self._flush_pending_locked(final=final)
+        # One span per flush: the batch's first hop when no fanout span
+        # is active (deadline flusher, close), otherwise a child of the
+        # chunk's fanout.read span — either way the root of everything
+        # downstream (coalescer/shard/RPC/device/write).
+        with trace.TRACER.span("sink.flush",
+                               pending=self._pending_count()):
+            async with self._flush_lock:
+                await self._flush_pending_locked(final=final)
 
     async def _flush_pending_locked(self, final: bool = False) -> None:
         if self._batcher is not None:
@@ -159,7 +166,8 @@ class FilteredSink(Sink):
                 out = b"".join(ln for ln, keep in zip(pending, mask) if keep)
             bytes_in = sum(len(ln) for ln in pending)
         if out:
-            await self._inner.write(out)
+            with trace.TRACER.span("sink.write", bytes=len(out)):
+                await self._inner.write(out)
         self._stats.record_batch(
             n_lines=len(pending),
             n_matched=n_kept,
@@ -196,7 +204,8 @@ class FilteredSink(Sink):
             payload, np.ascontiguousarray(offsets), n,
             np.ascontiguousarray(mask_arr, dtype=np.uint8).tobytes())
         if out:
-            await self._inner.write(out)
+            with trace.TRACER.span("sink.write", bytes=len(out)):
+                await self._inner.write(out)
         self._stats.record_batch(
             n_lines=n,
             n_matched=n_kept,
@@ -216,6 +225,14 @@ class FilteredSink(Sink):
         failed (partial-fleet failure is rerouted upstream, never
         degraded), so this path still means 'filtering is truly
         gone'."""
+        # Flight recorder: a degraded batch is exactly the event an
+        # operator reconstructs after the fact — arm a dump carrying
+        # this batch's hop story (trace event rides the sink.flush
+        # span; the trigger writes when the trace completes).
+        trace.TRACER.event("sink.degrade",
+                           action=self._on_filter_error, error=str(e))
+        trace.flight_trigger("filter-degrade",
+                             action=self._on_filter_error, error=str(e))
         if self._on_filter_error == "abort":
             raise e
         if not self._degrade_warned:
@@ -344,6 +361,10 @@ class FilterPipeline:
                 if isinstance(r, Unavailable):
                     term.error("filter service unavailable and "
                                "--on-filter-error=abort: stopping (%s)", r)
+                    # The run is ending on a degrade: flush the armed
+                    # dump NOW — no further root span may ever finish.
+                    trace.flight_trigger("abort-escalation", error=str(r))
+                    trace.RECORDER.flush()
                     if stop is not None:
                         stop.set()
                     raise r

@@ -306,32 +306,20 @@ class NFAEngineFilter(LogFilter):
         """Build the device sweep tables when the auto rule (or
         KLOGS_TPU_SWEEP=1) selects the fused path. Any build failure
         degrades LOUDLY to the plain kernel — same contract as the
-        indexed-engine auto fallback in best_host_filter."""
-        from klogs_tpu.filters.cpu import (
-            device_sweep_env,
-            device_sweep_wanted,
-        )
+        indexed-engine auto fallback in best_host_filter. The
+        sweep-vs-prefilter precedence itself lives in ONE place shared
+        with the mesh (cpu.device_gate_choice): the kernel accepts one
+        gate only, an explicit prefilter opt-in beats the auto sweep,
+        a forced sweep beats the prefilter — but the working prefilter
+        is only discarded AFTER the tables actually build (a failed
+        build must not leave the engine with neither gate)."""
+        from klogs_tpu.filters.cpu import device_gate_choice
         from klogs_tpu.ui import term
 
-        env = device_sweep_env()
-        if not device_sweep_wanted(
-                len(patterns),
-                interpret=self._kernel == "interpret"):
-            # Same auto rule as the mesh: interpret is the debug
-            # shape, auto never fuses the sweep into it (=1 still
-            # forces it for kernel-parity tests).
-            return
-        if self._pf_tables is not None and env != "1":
-            # The sweep subsumes the pair-CNF gate and the kernel
-            # accepts one gate only (_check_fused_combo). An EXPLICIT
-            # prefilter opt-in beats the auto sweep; a forced sweep
-            # beats the prefilter — but the working prefilter is only
-            # discarded AFTER the sweep tables actually build (below):
-            # a failed build must not leave the engine with neither
-            # gate.
-            term.info(
-                "KLOGS_TPU_PREFILTER=1 active; device sweep stays "
-                "off (set KLOGS_TPU_SWEEP=1 to prefer the sweep)")
+        choice = device_gate_choice(
+            len(patterns), have_prefilter=self._pf_tables is not None,
+            interpret=self._kernel == "interpret")
+        if choice != "sweep":
             return
         pg = self._dp_grouped.pattern_group
         if not pg:
@@ -351,9 +339,9 @@ class NFAEngineFilter(LogFilter):
                 n_groups=int(self._dp_grouped.follow.shape[0]))
             tables = device_sweep_tables(prog)
             if self._pf_tables is not None:
-                term.info(
-                    "KLOGS_TPU_SWEEP=1 supersedes KLOGS_TPU_PREFILTER: "
-                    "the literal sweep subsumes the pair-CNF gate")
+                from klogs_tpu.filters.cpu import note_sweep_supersedes
+
+                note_sweep_supersedes()
             with self._state_lock:
                 self._pf_tables = None
                 self._sweep_tables = tables
@@ -412,9 +400,12 @@ class NFAEngineFilter(LogFilter):
     def dispatch_framed(self, payload: bytes, offsets):
         """Framed-batch dispatch: no per-line PyBytes on the hot path.
         Rows are width-bucketed vectorized (numpy over the offsets), each
-        bucket packs straight out of the contiguous payload via the C
-        framed packer, and the cls matrices go to the same device calls
-        as the list path. Long/huge rows (rare) bridge to the chunked /
+        bucket packs straight out of the contiguous payload — via the C
+        framed packer on the cls hot path, via the shared
+        ``pack_framed_rows`` ragged scatter on the byte path (active
+        device sweep, which consumes raw bytes; deferred from PR 8 —
+        this entry used to detour through split_frame's per-line
+        PyBytes there). Long/huge rows (rare) bridge to the chunked /
         seq-scan paths via slicing."""
         import numpy as np
 
@@ -426,56 +417,145 @@ class NFAEngineFilter(LogFilter):
             return (0, [])
         if self._prog.match_all:
             return (n, None)
-        if (hostops is None or not hasattr(hostops, "pack_classify_framed")
-                or not self._use_cls()):
-            from klogs_tpu.filters.base import split_frame
+        if (hostops is not None
+                and hasattr(hostops, "pack_classify_framed")
+                and self._use_cls()):
+            return self._dispatch_framed_cls(payload, offsets, n)
+        if self._frames_bytes():
+            return self._dispatch_framed_bytes(payload, offsets, n)
+        from klogs_tpu.filters.base import split_frame
 
-            return self.dispatch(split_frame(payload, offsets))
+        return self.dispatch(split_frame(payload, offsets))
+
+    def _frames_bytes(self) -> bool:
+        """True when the active execution path consumes raw byte
+        batches AND the framed byte packer should feed it directly:
+        the fused device sweep (single-chip tables or a swept mesh
+        engine) — its kernel takes bytes, so the cls packer cannot
+        serve it and split_frame would cost n PyBytes per flush."""
+        if getattr(self, "_sweep_tables", None) is not None:
+            return True
+        eng = self._engine
+        return eng is not None and getattr(eng, "swept", False)
+
+    def _framed_width_buckets(self, lens, short, n: int):
+        """Power-of-two width bucket per row (jit-cache discipline,
+        same buckets as the list path: every assignment clamps to
+        chunk_bytes exactly like _bucket_len, or a non-power-of-two
+        chunk_bytes would mint an EXTRA jit shape above it and pad
+        every top-bucket row past the chunk width)."""
+        import numpy as np
+
+        chunk = self._chunk_bytes
+        width_of = np.full(n, min(MIN_BUCKET, chunk), dtype=np.int64)
+        w = MIN_BUCKET
+        while w < chunk and bool((short & (lens > w)).any()):
+            w *= 2
+            width_of[lens > w // 2] = min(w, chunk)
+        return width_of
+
+    def _dispatch_framed_cls(self, payload: bytes, offsets, n: int):
+        """The cls hot path: C framed packer -> class ids -> kernel.
+        Raw lengths may include a trailing newline the C packer strips
+        — the only effect is an occasional one-bucket-up pad, never a
+        wrong width."""
+        import numpy as np
+
+        from klogs_tpu.native import hostops
+        from klogs_tpu.obs import trace
+
         lens = np.diff(offsets)
         parts = []
         short = lens <= self._chunk_bytes
         if short.any():
-            # Power-of-two width bucket per row (jit-cache discipline,
-            # same buckets as the list path: every assignment clamps to
-            # chunk_bytes exactly like _bucket_len, or a non-power-of-
-            # two chunk_bytes would mint an EXTRA jit shape above it
-            # and pad every top-bucket row past the chunk width). Raw
-            # lengths may include a trailing newline the C packer
-            # strips — the only effect is an occasional one-bucket-up
-            # pad, never a wrong width.
-            chunk = self._chunk_bytes
-            width_of = np.full(n, min(MIN_BUCKET, chunk), dtype=np.int64)
-            w = MIN_BUCKET
-            while w < chunk and bool((short & (lens > w)).any()):
-                w *= 2
-                width_of[lens > w // 2] = min(w, chunk)
+            width_of = self._framed_width_buckets(lens, short, n)
             tab, bc, ec, pc = self._cls_args()
             tab_b = tab.tobytes()
             for w in np.unique(width_of[short]):
                 sel = np.nonzero(short & (width_of == w))[0].astype(np.int32)
                 rows = _bucket_batch(len(sel))
-                buf, _ = hostops.pack_classify_framed(
-                    payload, offsets, n, sel.tobytes(), int(w),
-                    rows, tab_b, bc, ec, pc)
-                cls = np.frombuffer(buf, dtype=np.int8).reshape(
-                    -1, int(w) + 3)
+                with trace.TRACER.span("device.frame", width=int(w),
+                                       rows=rows, path="cls"):
+                    buf, _ = hostops.pack_classify_framed(
+                        payload, offsets, n, sel.tobytes(), int(w),
+                        rows, tab_b, bc, ec, pc)
+                    cls = np.frombuffer(buf, dtype=np.int8).reshape(
+                        -1, int(w) + 3)
                 self._record_sub_batch(int(w), rows, int(lens[sel].sum()))
-                parts.append((sel, *self._match_cls_device(cls)))
+                # device.kernel times the (asynchronous) dispatch
+                # enqueue; the round-trip completion is device.fetch.
+                with trace.TRACER.span("device.kernel", width=int(w),
+                                       rows=rows):
+                    parts.append((sel, *self._match_cls_device(cls)))
         if not bool(short.all()):
             rest = np.nonzero(~short)[0]
             bodies = {int(i): payload[offsets[i]:offsets[i + 1]]
                       .rstrip(b"\n") for i in rest}
-            long_idx = [i for i in rest if
-                        len(bodies[int(i)]) <= self.SEQ_SCAN_BYTES]
-            huge_idx = [i for i in rest if
-                        len(bodies[int(i)]) > self.SEQ_SCAN_BYTES]
-            if long_idx:
-                parts.append((long_idx, self._match_long(
-                    [bodies[int(i)] for i in long_idx]), None, None))
-            if huge_idx:
-                parts.append((huge_idx, self._match_huge(
-                    [bodies[int(i)] for i in huge_idx]), None, None))
+            self._dispatch_framed_rest(rest, bodies, parts)
         return (n, parts)
+
+    def _dispatch_framed_bytes(self, payload: bytes, offsets, n: int):
+        """The byte path (fused device sweep): width-bucketed [B, W] u8
+        batches packed straight from the contiguous payload by the
+        shared ``pack_framed_rows`` ragged scatter (filters/base), so
+        the sweep path pays no per-line PyBytes either. Trailing
+        newlines are peeled vectorized (rstrip parity with dispatch)."""
+        import numpy as np
+
+        from klogs_tpu.filters.base import pack_framed_rows
+        from klogs_tpu.obs import trace
+
+        starts = offsets[:-1].astype(np.int64)
+        ends = offsets[1:].astype(np.int64).copy()
+        if len(payload):
+            arr = np.frombuffer(payload, dtype=np.uint8)
+            while True:
+                # Loop count = the longest trailing-newline run
+                # (almost always 1); each pass is one vectorized scan.
+                m = (ends > starts) & (arr[np.maximum(ends, 1) - 1] == 0x0A)
+                if not bool(m.any()):
+                    break
+                ends[m] -= 1
+        lens = ends - starts
+        parts = []
+        short = lens <= self._chunk_bytes
+        if bool(short.any()):
+            width_of = self._framed_width_buckets(lens, short, n)
+            for w in np.unique(width_of[short]):
+                sel = np.nonzero(short & (width_of == w))[0]
+                rows = _bucket_batch(len(sel))
+                with trace.TRACER.span("device.frame", width=int(w),
+                                       rows=rows, path="bytes"):
+                    batch, sub_lens = pack_framed_rows(
+                        payload, offsets, int(w), rows=rows, sel=sel,
+                        lens=lens[sel])
+                lengths = np.zeros(rows, dtype=np.int32)
+                lengths[:len(sel)] = sub_lens
+                self._record_sub_batch(int(w), rows, int(lens[sel].sum()))
+                with trace.TRACER.span("device.kernel", width=int(w),
+                                       rows=rows, swept=True):
+                    parts.append((sel, *self._match_full(batch, lengths)))
+        if not bool(short.all()):
+            rest = np.nonzero(~short)[0]
+            bodies = {int(i): payload[int(starts[i]):int(ends[i])]
+                      for i in rest}
+            self._dispatch_framed_rest(rest, bodies, parts)
+        return (n, parts)
+
+    def _dispatch_framed_rest(self, rest, bodies: dict, parts: list) -> None:
+        """Long/huge rows shared by both framed paths: bridge to the
+        carried-state chunk path / seq-scan via the (already stripped)
+        body slices."""
+        long_idx = [int(i) for i in rest
+                    if len(bodies[int(i)]) <= self.SEQ_SCAN_BYTES]
+        huge_idx = [int(i) for i in rest
+                    if len(bodies[int(i)]) > self.SEQ_SCAN_BYTES]
+        if long_idx:
+            parts.append((long_idx, self._match_long(
+                [bodies[i] for i in long_idx]), None, None))
+        if huge_idx:
+            parts.append((huge_idx, self._match_huge(
+                [bodies[i] for i in huge_idx]), None, None))
 
     def dispatch(self, lines: list[bytes]):
         """Enqueue device work for a batch WITHOUT blocking on results

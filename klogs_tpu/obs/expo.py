@@ -39,8 +39,27 @@ def _labelstr(names: Sequence[str], values: Sequence[str],
     return "{" + body + "}"
 
 
-def render(registry: "Registry") -> str:
-    """Registry -> Prometheus text exposition."""
+def _exemplar_str(ex: "tuple[dict, float, float] | None") -> str:
+    """OpenMetrics exemplar suffix for a bucket sample: links the
+    observation to its trace (` # {trace_id="..",span_id=".."} v ts`).
+    Empty when the bucket never recorded one."""
+    if ex is None:
+        return ""
+    labels, value, ts = ex
+    body = ",".join(f'{k}="{_escape_label(str(v))}"'
+                    for k, v in labels.items())
+    return f" # {{{body}}} {_fmt(value)} {_fmt(round(ts, 3))}"
+
+
+def render(registry: "Registry", exemplars: bool = False) -> str:
+    """Registry -> Prometheus text exposition.
+
+    ``exemplars=False`` (the default, and what a plain /metrics scrape
+    gets) emits strict text format 0.0.4 — the classic parser rejects
+    anything after a sample value, so exemplar suffixes there would
+    fail the ENTIRE scrape. ``exemplars=True`` appends OpenMetrics
+    exemplar syntax to bucket samples that recorded one; the sidecar
+    serves it only when the scraper opts in (/metrics?exemplars=1)."""
     out: list[str] = []
     for fam in registry.collect():
         out.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
@@ -49,15 +68,18 @@ def render(registry: "Registry") -> str:
             lab = _labelstr(fam.labelnames, labelvalues)
             if fam.type == "histogram":
                 counts, total, n = child.snapshot()
+                ex = child.exemplars() if exemplars else {}
                 cum = 0
-                for bound, c in zip(child.buckets, counts):
+                for i, (bound, c) in enumerate(zip(child.buckets, counts)):
                     cum += c
                     le = _labelstr(fam.labelnames, labelvalues,
                                    extra=(("le", _fmt(float(bound))),))
-                    out.append(f"{fam.name}_bucket{le} {cum}")
+                    out.append(f"{fam.name}_bucket{le} {cum}"
+                               + _exemplar_str(ex.get(i)))
                 inf = _labelstr(fam.labelnames, labelvalues,
                                 extra=(("le", "+Inf"),))
-                out.append(f"{fam.name}_bucket{inf} {n}")
+                out.append(f"{fam.name}_bucket{inf} {n}"
+                           + _exemplar_str(ex.get(len(child.buckets))))
                 out.append(f"{fam.name}_sum{lab} {_fmt(total)}")
                 out.append(f"{fam.name}_count{lab} {n}")
             else:

@@ -95,11 +95,15 @@ class MetricsHTTPServer:
     scrape config in docs/OBSERVABILITY.md points)."""
 
     def __init__(self, registry: "Registry", health: "Health | None" = None,
-                 host: str = "127.0.0.1", port: int = 0) -> None:
+                 host: str = "127.0.0.1", port: int = 0,
+                 tracer=None) -> None:
         self.registry = registry
         self.health = health
         self.host = host
         self.port = port
+        # /traces serves this tracer's finished spans; None = the
+        # process-global one (a process runs one trace story).
+        self.tracer = tracer
         self._server: asyncio.AbstractServer | None = None
 
     async def start(self) -> int:
@@ -122,14 +126,16 @@ class MetricsHTTPServer:
             parts = line.decode("latin-1", "replace").split()
             if len(parts) < 2:
                 return
-            method, path = parts[0], parts[1].split("?", 1)[0]
+            target = parts[1].split("?", 1)
+            method, path = parts[0], target[0]
+            query = target[1] if len(target) > 1 else ""
             # Drain headers (requests are tiny; bodies unsupported).
             while True:
                 h = await asyncio.wait_for(reader.readline(),
                                            _REQ_TIMEOUT_S)
                 if h in (b"\r\n", b"\n", b""):
                     break
-            status, ctype, body = self._route(method, path)
+            status, ctype, body = self._route(method, path, query)
             head = (f"HTTP/1.1 {status}\r\n"
                     f"Content-Type: {ctype}\r\n"
                     f"Content-Length: {len(body)}\r\n"
@@ -151,14 +157,26 @@ class MetricsHTTPServer:
             except Exception:
                 pass
 
-    def _route(self, method: str, path: str) -> tuple[str, str, bytes]:
+    def _route(self, method: str, path: str,
+               query: str = "") -> tuple[str, str, bytes]:
         if method != "GET":
             return ("405 Method Not Allowed", "text/plain; charset=utf-8",
                     b"method not allowed\n")
         if path == "/metrics":
-            body = render(self.registry).encode()
+            # Exemplars only on explicit opt-in (?exemplars=1): the
+            # advertised 0.0.4 text parser rejects any suffix after a
+            # sample value, so emitting them unasked would fail every
+            # plain Prometheus scrape the moment tracing turns on.
+            want_ex = "exemplars=1" in query
+            body = render(self.registry, exemplars=want_ex).encode()
             return ("200 OK",
                     "text/plain; version=0.0.4; charset=utf-8", body)
+        if path == "/traces":
+            from klogs_tpu.obs import trace as _trace
+
+            tracer = self.tracer if self.tracer is not None else _trace.TRACER
+            body = (json.dumps(tracer.traces_doc()) + "\n").encode()
+            return ("200 OK", "application/json", body)
         if path in ("/healthz", "/readyz"):
             if self.health is None:
                 return ("200 OK", "application/json",
@@ -169,4 +187,4 @@ class MetricsHTTPServer:
             return ("200 OK" if ok else "503 Service Unavailable",
                     "application/json", body)
         return ("404 Not Found", "text/plain; charset=utf-8",
-                b"try /metrics, /healthz, or /readyz\n")
+                b"try /metrics, /healthz, /readyz, or /traces\n")

@@ -226,6 +226,16 @@ SPECS: dict[str, dict] = {
         "prober (1 ready, 0 draining or unreachable).",
         labels=("endpoint",)),
 
+    # -- tracing / flight recorder (obs.trace) ------------------------
+    "klogs_trace_spans_total": _m(
+        "counter", "Finished sampled spans recorded by the tracer "
+        "(KLOGS_TRACE_SAMPLE head sampling; see docs/OBSERVABILITY.md "
+        "Tracing)."),
+    "klogs_flight_dumps_total": _m(
+        "counter", "Flight-recorder dumps written, by trigger reason "
+        "(breaker-open, filter-degrade, sweep-fallback, "
+        "abort-escalation).", labels=("reason",)),
+
     # -- RPC layer (filterd gRPC server) ------------------------------
     "klogs_rpc_requests_total": _m(
         "counter", "RPCs received, by method.", labels=("method",)),

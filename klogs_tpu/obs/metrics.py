@@ -27,6 +27,7 @@ Design rules:
 
 import random
 import threading
+import time
 from typing import Any, Iterable
 
 # Bounded reservoir per histogram child: constant memory over unbounded
@@ -131,16 +132,31 @@ class Histogram:
         self.sum = 0.0
         self.count = 0
         self._reservoir = _Reservoir()
+        # Last exemplar per bucket (index len(buckets) = +Inf): the
+        # trace link the OpenMetrics exposition attaches to the bucket
+        # sample, so a latency outlier points straight at its trace.
+        self._exemplars: "dict[int, tuple[dict, float, float]]" = {}
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                exemplar: "dict | None" = None) -> None:
         with self._lock:
             self.count += 1
             self.sum += value
+            hit = len(self.buckets)  # +Inf
             for i, bound in enumerate(self.buckets):
                 if value <= bound:
                     self.bucket_counts[i] += 1
+                    hit = i
                     break
+            if exemplar is not None:
+                self._exemplars[hit] = (exemplar, value, time.time())
             self._reservoir.add(value)
+
+    def exemplars(self) -> "dict[int, tuple[dict, float, float]]":
+        """bucket index -> (labels, observed value, unix ts); index
+        len(buckets) is the +Inf bucket."""
+        with self._lock:
+            return dict(self._exemplars)
 
     def percentile(self, q: float) -> float:
         with self._lock:
@@ -222,8 +238,9 @@ class Family:
     def set(self, value: float) -> None:
         self._default().set(value)
 
-    def observe(self, value: float) -> None:
-        self._default().observe(value)
+    def observe(self, value: float,
+                exemplar: "dict | None" = None) -> None:
+        self._default().observe(value, exemplar=exemplar)
 
     def percentile(self, q: float) -> float:
         return self._default().percentile(q)

@@ -141,6 +141,20 @@ def device_sweep_tables(prog: SweepProgram) -> SweepTables:
     )
 
 
+def sweep_span_attrs(st: SweepTables) -> "dict[str, int]":
+    """Bounded attribute set describing a sweep dispatch for the batch
+    trace (obs.trace ``device.sweep`` spans): table shape, never table
+    content. Host-side only — spans cannot live inside the jitted
+    ``sweep_group_candidates`` (traced-purity), so the wrapping engine
+    attaches these at the dispatch site."""
+    return {
+        "sweep_groups": int(st.n_groups),
+        "sweep_factors": int(st.fac_len.shape[0]),
+        "sweep_narrow_slots": int(st.n_slot_key.shape[-1]),
+        "sweep_wide_slots": int(st.w_slot_key.shape[-1]),
+    }
+
+
 def stack_sweep_tables(progs: "list[SweepProgram]") -> SweepTables:
     """Shape-uniform [n_shards, ...] stack of per-shard SweepPrograms
     for shard_map (parallel/mesh.py): every array leaf is padded to the

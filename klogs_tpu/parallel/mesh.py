@@ -257,32 +257,25 @@ class MeshEngine:
         # Device literal sweep (thousand-pattern fused path): per-shard
         # sweep tables stacked shape-uniform, gating each shard's
         # (tile, group) grid cells on ITS patterns' factor-index
-        # candidate mask. Same auto rule as the single-chip engine
-        # (K threshold + real accelerator; KLOGS_TPU_SWEEP=0/1
-        # overrides), and an explicit prefilter opt-in wins over the
-        # auto sweep — the kernel takes one gate.
+        # candidate mask. The sweep-vs-prefilter precedence is the ONE
+        # shared rule (cpu.device_gate_choice, same as tpu._init_sweep):
+        # auto K threshold + real accelerator, explicit prefilter
+        # opt-in beats auto sweep, forced sweep supersedes — and a
+        # working prefilter is only discarded after the tables built.
         sweep_stacked = None
         n_patterns = sum(len(ps) for ps in groups)
-        from klogs_tpu.filters.cpu import device_sweep_env, device_sweep_wanted
+        from klogs_tpu.filters.cpu import (
+            device_gate_choice,
+            note_sweep_supersedes,
+        )
 
-        if device_sweep_wanted(n_patterns, interpret=interpret):
-            from klogs_tpu.ui import term
-
-            if pf_stacked is not None and device_sweep_env() != "1":
-                # Explicit prefilter opt-in beats the auto sweep —
-                # same precedence and operator notice as _init_sweep.
-                term.info(
-                    "KLOGS_TPU_PREFILTER=1 active; device sweep stays "
-                    "off (set KLOGS_TPU_SWEEP=1 to prefer the sweep)")
-            else:
-                sweep_stacked = self._stack_sweeps(
-                    groups, ignore_case, dps, G)
-                if sweep_stacked is not None and pf_stacked is not None:
-                    term.info(
-                        "KLOGS_TPU_SWEEP=1 supersedes "
-                        "KLOGS_TPU_PREFILTER on the mesh: the "
-                        "literal sweep subsumes the pair-CNF gate")
-                    pf_stacked = None
+        if device_gate_choice(n_patterns,
+                              have_prefilter=pf_stacked is not None,
+                              interpret=interpret) == "sweep":
+            sweep_stacked = self._stack_sweeps(groups, ignore_case, dps, G)
+            if sweep_stacked is not None and pf_stacked is not None:
+                note_sweep_supersedes(mesh=True)
+                pf_stacked = None
 
         # Same chain-variant policy as the single-chip hot path
         # (tune.chain_selection: measured default mask_block=4 on
@@ -528,11 +521,16 @@ class MeshEngine:
                                  dtype=batch.dtype)])
             lengths = np.concatenate(
                 [lengths, np.zeros((Bp - B,), dtype=lengths.dtype)])
-        return self._fn_sweep(
-            self.dp, self._place_data(batch, P("data", None)),
-            self._place_data(np.ascontiguousarray(lengths,
-                                                  dtype=np.int32),
-                             P("data")))
+        from klogs_tpu.obs import trace
+
+        with trace.TRACER.span("mesh.dispatch", impl=self.impl,
+                               rows=Bp, swept=True,
+                               grid=f"{self.grid[0]}x{self.grid[1]}"):
+            return self._fn_sweep(
+                self.dp, self._place_data(batch, P("data", None)),
+                self._place_data(np.ascontiguousarray(lengths,
+                                                      dtype=np.int32),
+                                 P("data")))
 
     def match_cls(self, cls: np.ndarray, plain: bool = False):
         """Hot-path entry for pallas impls: [B, T] int8/int32 class ids
@@ -551,8 +549,13 @@ class MeshEngine:
         use_gated = not plain and self.gated
         fn = self._fn_gated if use_gated else self._fn
         cls = self._place_data(cls, P("data", None))
+        from klogs_tpu.obs import trace
+
         try:
-            return fn(self.dp, cls)
+            with trace.TRACER.span("mesh.dispatch", impl=self.impl,
+                                   rows=Bp, gated=use_gated,
+                                   grid=f"{self.grid[0]}x{self.grid[1]}"):
+                return fn(self.dp, cls)
         except Exception as e:
             # Chain-variant compile fragility is a known failure mode
             # (mask_block=8/16 fail Mosaic on v5e). A DEFAULTED variant

@@ -146,9 +146,18 @@ class CircuitBreaker:
         return _STATE_NAMES[self.state]
 
     def _set_state(self, state: int) -> None:
-        self._state = state
+        prev, self._state = self._state, state
         if self._gauge is not None:
             self._gauge.set(state)
+        if state == BREAKER_OPEN and prev != BREAKER_OPEN:
+            # Flight recorder: a breaker opening IS the "where did my
+            # batch go" moment — arm a dump carrying the batch whose
+            # failure tripped it (obs.trace; no-op when tracing is
+            # off). Also annotate whatever batch is in flight.
+            from klogs_tpu.obs.trace import TRACER, flight_trigger
+
+            TRACER.event("breaker.open", breaker=self.name)
+            flight_trigger("breaker-open", breaker=self.name)
 
     def _maybe_half_open(self) -> None:
         if (self._state == BREAKER_OPEN

@@ -22,6 +22,7 @@ from typing import Callable
 
 from klogs_tpu.cluster.backend import ClusterBackend, StreamError
 from klogs_tpu.cluster.types import LogOptions, PodInfo
+from klogs_tpu.obs import trace
 from klogs_tpu.resilience import RetryPolicy
 from klogs_tpu.runtime.sink import FileSink, Sink, SinkError
 from klogs_tpu.ui import term
@@ -216,19 +217,31 @@ class FanoutRunner:
                 got_data = False
                 stream_err: StreamError | None = None
                 sink_err: SinkError | None = None
+                # Per-chunk trace root: the first hop of a batch's
+                # life. With sampling off span() is a no-op singleton
+                # (one compare per CHUNK, never per line); sampled
+                # chunks parent whatever the write triggers downstream
+                # (sink flush -> coalescer/shard -> RPC -> device).
+                tr = trace.TRACER
                 try:
                     if m_bytes is None:
                         async for chunk in stream:
                             got_data = True
                             last_data = time.monotonic()
-                            await sink.write(chunk)
+                            with tr.span("fanout.read", pod=job.pod,
+                                         container=job.container,
+                                         bytes=len(chunk)):
+                                await sink.write(chunk)
                     else:
                         stalls = self._m["stalls"]
                         async for chunk in stream:
                             got_data = True
                             last_data = time.monotonic()
                             m_bytes.inc(len(chunk))
-                            await sink.write(chunk)
+                            with tr.span("fanout.read", pod=job.pod,
+                                         container=job.container,
+                                         bytes=len(chunk)):
+                                await sink.write(chunk)
                             # A slow write = the filter/file/console is
                             # the bottleneck, not the apiserver: the
                             # operator's signal to scale the sink side.

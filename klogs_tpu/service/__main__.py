@@ -42,6 +42,13 @@ def main() -> None:
                     "(liveness) and /readyz (readiness; unready until "
                     "the warmup batch clears the cold-start compile) on "
                     "this HTTP port (0 = ephemeral; binds 127.0.0.1)")
+    ap.add_argument("--trace-json", default=None, dest="trace_json",
+                    metavar="PATH",
+                    help="write every finished trace span as one JSON "
+                    "line to PATH (server-side batch tracing; continues "
+                    "a collector's trace when its RPC carries the "
+                    "traceparent metadata). Implies KLOGS_TRACE_SAMPLE=1 "
+                    "unless that variable is set")
     ap.add_argument("--metrics-host", default="127.0.0.1",
                     metavar="HOST",
                     help="metrics/health bind address. Cross-node "
@@ -68,7 +75,8 @@ def main() -> None:
                           auth_token_file=ns.auth_token_file,
                           exclude=ns.exclude,
                           metrics_port=ns.metrics_port,
-                          metrics_host=ns.metrics_host))
+                          metrics_host=ns.metrics_host,
+                          trace_json=ns.trace_json))
     except KeyboardInterrupt:
         pass
     except RegexSyntaxError as e:  # subclasses ValueError: catch first

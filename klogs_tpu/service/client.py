@@ -193,21 +193,35 @@ class RemoteFilterClient:
         transient failure (retries exhausted / breaker open) raises
         ``resilience.Unavailable`` — the type FilteredSink's
         --on-filter-error degrade routing catches; any other RPC error
-        gets the friendly one-line ClusterError as before."""
+        gets the friendly one-line ClusterError as before.
+
+        The whole retry tower runs under one ``rpc.client`` span; the
+        batch's trace context rides each attempt as gRPC metadata
+        (transport.trace_metadata), so server-side spans parent under
+        this one. A hedge loser's task is cancelled here mid-await and
+        its span closes status=cancelled — the flight-recorder
+        signature that distinguishes a lost race from a failure."""
+        from klogs_tpu.obs.trace import TRACER
+
         async def attempt(deadline):
+            md = tuple(self._metadata() or ()) + transport.trace_metadata()
             return await rpc(
-                request, metadata=self._metadata(),
+                request, metadata=md or None,
                 timeout=(deadline.remaining()
                          if deadline is not None else None))
 
         try:
-            return await retry_call(
-                attempt, policy=self._retry, retryable=_retryable,
-                site=self._site,
-                describe=f"filter service at {self._target}",
-                breaker=self._breaker, deadline_s=self._rpc_timeout_s,
-                fault_point=fault_point, fault_target=self._target,
-                registry=self._registry)
+            with TRACER.span("rpc.client", target=self._target,
+                             method=fault_point) as sp:
+                result = await retry_call(
+                    attempt, policy=self._retry, retryable=_retryable,
+                    site=self._site,
+                    describe=f"filter service at {self._target}",
+                    breaker=self._breaker, deadline_s=self._rpc_timeout_s,
+                    fault_point=fault_point, fault_target=self._target,
+                    registry=self._registry)
+                sp.set_attr("response_bytes", len(result))
+                return result
         except Unavailable as e:
             cause = e.__cause__
             if isinstance(cause, grpc.aio.AioRpcError):

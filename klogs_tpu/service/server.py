@@ -169,6 +169,13 @@ class FilterServer:
             obs.register_all(self.registry)
             self.registry.family("klogs_build_info").labels(
                 version=BUILD_VERSION).set(1)
+            # Trace/flight-recorder counters scrape from this server's
+            # sidecar (the tracer itself is process-global — one trace
+            # story per process; a later server instance rebinds).
+            from klogs_tpu.obs import trace as _trace
+
+            _trace.TRACER.bind_registry(self.registry)
+            _trace.RECORDER.bind_registry(self.registry)
             self._stats = FilterStats(registry=self.registry)
             self._m_rpc = {
                 "req": self.registry.family("klogs_rpc_requests_total"),
@@ -244,6 +251,8 @@ class FilterServer:
         off (no per-RPC overhead)."""
         if self._m_rpc is None:
             return handler
+        from klogs_tpu.obs.trace import TRACER
+
         m = self._m_rpc
         req = m["req"].labels(method=method)
         err = m["err"].labels(method=method)
@@ -262,7 +271,31 @@ class FilterServer:
                 err.inc()
                 raise
             finally:
-                lat.observe(time.perf_counter() - t0)
+                # Exemplar: the rpc.server span (still open — _traced
+                # wraps outside this layer) links the latency sample to
+                # its trace in the exposition.
+                lat.observe(time.perf_counter() - t0,
+                            exemplar=TRACER.exemplar())
+
+        return wrapped
+
+    def _traced(self, method: str, handler):
+        """Tracing wrapper (outermost): continue the collector's batch
+        trace across the wire — the traceparent metadata entry parents
+        this RPC's ``rpc.server`` span under the client's ``rpc.client``
+        span, so one trace covers collector sink -> shard routing ->
+        RPC -> server coalescer -> device. Without the metadata (old
+        client, tracing off) the RPC roots its own trace under local
+        sampling; when neither side records, the handler runs bare."""
+        from klogs_tpu.obs.trace import TRACER
+
+        async def wrapped(request: bytes, context) -> bytes:
+            ctx = transport.extract_trace(context.invocation_metadata())
+            if ctx is None and not TRACER.enabled:
+                return await handler(request, context)
+            with TRACER.span("rpc.server", parent=ctx, method=method,
+                             request_bytes=len(request)):
+                return await handler(request, context)
 
         return wrapped
 
@@ -341,11 +374,14 @@ class FilterServer:
             transport.SERVICE,
             {
                 "Hello": grpc.unary_unary_rpc_method_handler(
-                    self._instrumented("Hello", self._hello)),
+                    self._traced("Hello", self._instrumented(
+                        "Hello", self._hello))),
                 "Match": grpc.unary_unary_rpc_method_handler(
-                    self._instrumented("Match", self._match)),
+                    self._traced("Match", self._instrumented(
+                        "Match", self._match))),
                 "MatchFramed": grpc.unary_unary_rpc_method_handler(
-                    self._instrumented("MatchFramed", self._match_framed)),
+                    self._traced("MatchFramed", self._instrumented(
+                        "MatchFramed", self._match_framed))),
             },
         )
         # Jumbo batches (thousands of long lines) exceed gRPC's 4 MB
@@ -427,7 +463,17 @@ class FilterServer:
 
 
 async def serve(patterns: list[str], backend: str, host: str, port: int,
-                ignore_case: bool = False, **security) -> None:
+                ignore_case: bool = False,
+                trace_json: "str | None" = None, **security) -> None:
+    if trace_json is not None:
+        # Server-side batch tracing: spans root at rpc.server (or
+        # continue a collector's trace via the metadata traceparent)
+        # and land in this file as JSON lines; /traces on the metrics
+        # sidecar serves the same spans.
+        from klogs_tpu.obs import trace as _trace
+
+        _trace.TRACER.enable_default()
+        _trace.TRACER.set_json_path(trace_json)
     server = FilterServer(patterns, backend, host=host, port=port,
                           ignore_case=ignore_case, **security)
     bound = await server.start()
@@ -453,3 +499,9 @@ async def serve(patterns: list[str], backend: str, host: str, port: int,
         await server.wait()
     finally:
         await server.stop()
+        # A degrade trigger armed near shutdown may have no further
+        # local root span to ride — write it before the process exits
+        # (mirrors the collector-side teardown in app.py).
+        from klogs_tpu.obs import trace as _trace2
+
+        _trace2.RECORDER.flush()

@@ -41,6 +41,7 @@ import bisect
 import hashlib
 from typing import Any, Awaitable, Callable, Iterable, Sequence
 
+from klogs_tpu.obs import trace
 from klogs_tpu.resilience import (
     BREAKER_OPEN,
     BreakerOpen,
@@ -296,6 +297,10 @@ class ShardedFilterClient:
             if self._m_reroutes is not None:
                 self._m_reroutes.labels(endpoint=ep.target,
                                         reason=reason).inc()
+            # The batch trace records WHICH owner was skipped and why —
+            # the per-batch story the aggregate counter cannot tell.
+            trace.TRACER.event("shard.reroute", endpoint=ep.target,
+                               reason=reason)
         return healthy + [ep for ep in natural if not avail[ep.target]]
 
     def _note_endpoint_down(self, ep: _Endpoint) -> None:
@@ -321,83 +326,98 @@ class ShardedFilterClient:
         against the next sibling every ``hedge_s`` of silence, failover
         past terminal failures, first success wins. Losers are
         cancelled and awaited before returning — no orphan tasks, no
-        double-counted result."""
-        queue = list(self._route_order())
-        tasks: "dict[asyncio.Task, _Endpoint]" = {}
-        errors: "list[str]" = []
-        pending: "set[asyncio.Task]" = set()
-        try:
-            while queue or pending:
-                if not pending:
-                    ep = queue.pop(0)
-                    t = asyncio.ensure_future(op(ep.client))
-                    tasks[t] = ep
-                    pending = {t}
-                timeout = (self._hedge_s
-                           if queue and self._hedge_s is not None else None)
-                done, pending = await asyncio.wait(
-                    pending, timeout=timeout,
-                    return_when=asyncio.FIRST_COMPLETED)
-                if not done:
-                    # Hedge deadline passed with the attempt(s) still in
-                    # flight: race one more sibling.
-                    ep = queue.pop(0)
-                    if self._m_hedges is not None:
-                        self._m_hedges.labels(endpoint=ep.target).inc()
-                    t = asyncio.ensure_future(op(ep.client))
-                    tasks[t] = ep
-                    pending.add(t)
-                    continue
-                winner: "asyncio.Task | None" = None
-                fatal: "BaseException | None" = None
-                for t in done:
-                    exc = t.exception() if not t.cancelled() else None
-                    if t.cancelled():
+        double-counted result.
+
+        The whole decision runs under one ``shard.dispatch`` span;
+        routing demotions, hedges, per-endpoint failures, and the
+        winner land on it as events, and each attempt task inherits the
+        span as parent (its ``rpc.client`` span nests under it; a
+        cancelled loser's closes status=cancelled)."""
+        with trace.TRACER.span("shard.dispatch", what=what,
+                               mode=self._mode) as sp:
+            queue = list(self._route_order())
+            tasks: "dict[asyncio.Task, _Endpoint]" = {}
+            errors: "list[str]" = []
+            pending: "set[asyncio.Task]" = set()
+            try:
+                while queue or pending:
+                    if not pending:
+                        ep = queue.pop(0)
+                        sp.add_event("shard.route", endpoint=ep.target)
+                        t = asyncio.ensure_future(op(ep.client))
+                        tasks[t] = ep
+                        pending = {t}
+                    timeout = (self._hedge_s
+                               if queue and self._hedge_s is not None
+                               else None)
+                    done, pending = await asyncio.wait(
+                        pending, timeout=timeout,
+                        return_when=asyncio.FIRST_COMPLETED)
+                    if not done:
+                        # Hedge deadline passed with the attempt(s)
+                        # still in flight: race one more sibling.
+                        ep = queue.pop(0)
+                        if self._m_hedges is not None:
+                            self._m_hedges.labels(endpoint=ep.target).inc()
+                        sp.add_event("shard.hedge", endpoint=ep.target)
+                        t = asyncio.ensure_future(op(ep.client))
+                        tasks[t] = ep
+                        pending.add(t)
                         continue
-                    if exc is None:
-                        winner = winner or t
-                    elif isinstance(exc, Unavailable):
-                        ep = tasks[t]
-                        errors.append(f"{ep.target}: {exc}")
-                        if self._m_reroutes is not None:
+                    winner: "asyncio.Task | None" = None
+                    fatal: "BaseException | None" = None
+                    for t in done:
+                        exc = t.exception() if not t.cancelled() else None
+                        if t.cancelled():
+                            continue
+                        if exc is None:
+                            winner = winner or t
+                        elif isinstance(exc, Unavailable):
+                            ep = tasks[t]
+                            errors.append(f"{ep.target}: {exc}")
                             reason = ("breaker"
                                       if isinstance(exc, BreakerOpen)
                                       else "error")
-                            self._m_reroutes.labels(
-                                endpoint=ep.target, reason=reason).inc()
-                        self._note_endpoint_down(ep)
-                    else:
-                        # Non-transient (pattern mismatch, bad request,
-                        # auth): the same bug on every endpoint —
-                        # propagate, do not failover.
-                        fatal = fatal or exc
-                if winner is not None:
-                    # A valid verdict beats a loser's error — even a
-                    # non-transient one (a hedge sibling's pattern
-                    # mismatch / auth failure is per-endpoint in a
-                    # heterogeneous fleet; the next dispatch routed to
-                    # it will surface it on its own).
-                    if self._m_batches is not None:
-                        self._m_batches.labels(
-                            endpoint=tasks[winner].target).inc()
-                    return await winner  # done: resolves immediately
-                if fatal is not None:
-                    raise fatal
-            raise Unavailable(
-                f"all {len(self._endpoints)} filterd endpoint(s) "
-                f"unavailable for {what}: "
-                + ("; ".join(errors)
-                   or "no routable endpoint (unverified or quarantined "
-                      "pattern sets)"))
-        finally:
-            live = [t for t in tasks if not t.done()]
-            for t in live:
-                t.cancel()
-            for t in live:
-                try:
-                    await t
-                except (asyncio.CancelledError, Exception):  # noqa: BLE001
-                    pass  # loser teardown; its outcome is irrelevant
+                            if self._m_reroutes is not None:
+                                self._m_reroutes.labels(
+                                    endpoint=ep.target, reason=reason).inc()
+                            sp.add_event("shard.failover",
+                                         endpoint=ep.target, reason=reason,
+                                         error=str(exc))
+                            self._note_endpoint_down(ep)
+                        else:
+                            # Non-transient (pattern mismatch, bad
+                            # request, auth): the same bug on every
+                            # endpoint — propagate, do not failover.
+                            fatal = fatal or exc
+                    if winner is not None:
+                        # A valid verdict beats a loser's error — even a
+                        # non-transient one (a hedge sibling's pattern
+                        # mismatch / auth failure is per-endpoint in a
+                        # heterogeneous fleet; the next dispatch routed
+                        # to it will surface it on its own).
+                        if self._m_batches is not None:
+                            self._m_batches.labels(
+                                endpoint=tasks[winner].target).inc()
+                        sp.set_attr("winner", tasks[winner].target)
+                        return await winner  # done: resolves immediately
+                    if fatal is not None:
+                        raise fatal
+                raise Unavailable(
+                    f"all {len(self._endpoints)} filterd endpoint(s) "
+                    f"unavailable for {what}: "
+                    + ("; ".join(errors)
+                       or "no routable endpoint (unverified or "
+                          "quarantined pattern sets)"))
+            finally:
+                live = [t for t in tasks if not t.done()]
+                for t in live:
+                    t.cancel()
+                for t in live:
+                    try:
+                        await t
+                    except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                        pass  # loser teardown; its outcome is irrelevant
 
     # -- client API ---------------------------------------------------
 

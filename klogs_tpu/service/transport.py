@@ -37,6 +37,30 @@ HELLO = f"/{SERVICE}/Hello"
 MATCH = f"/{SERVICE}/Match"
 MATCH_FRAMED = f"/{SERVICE}/MatchFramed"
 
+# Trace-context propagation (obs.trace): the collector's batch trace
+# crosses this boundary as one metadata entry, W3C traceparent format
+# (00-<32hex trace>-<16hex span>-<2hex flags>), so a filterd's server
+# spans parent under the collector's RPC span. Part of the wire
+# contract like the method names above; servers without the key root
+# their own traces, clients never require it be honored.
+from klogs_tpu.obs.trace import TRACEPARENT_KEY  # noqa: E402
+
+
+def trace_metadata() -> "tuple[tuple[str, str], ...]":
+    """Metadata entries carrying the CURRENT span context (empty when
+    nothing records) — what the client appends to each RPC."""
+    from klogs_tpu.obs.trace import TRACER
+
+    return TRACER.inject()
+
+
+def extract_trace(metadata: "Any") -> "Any":
+    """Invocation metadata -> SpanContext | None — what the server
+    hands to ``tracer.span(..., parent=...)``."""
+    from klogs_tpu.obs.trace import TRACER
+
+    return TRACER.extract(metadata)
+
 
 def pack(obj: object) -> bytes:
     return msgpack.packb(obj, use_bin_type=True)

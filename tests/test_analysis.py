@@ -663,6 +663,91 @@ def test_retry_discipline_real_tree_clean():
     assert _active(REPO, "retry-discipline") == []
 
 
+# -- span-discipline ---------------------------------------------------
+
+def test_span_discipline_bare_start_span(tmp_path):
+    """A span opened without a with-block or finally .end() never
+    reports — the hop silently vanishes from every trace and dump."""
+    root = _tree(tmp_path, {"klogs_tpu/service/leaky.py": """
+        from klogs_tpu.obs import trace
+        def handle(batch):
+            sp = trace.TRACER.start_span("rpc.server", n=len(batch))
+            do_work(batch)
+            return sp
+        """})
+    found = _active(root, "span-discipline")
+    assert len(found) == 1 and "with" in found[0].message
+
+
+def test_span_discipline_task_under_open_span(tmp_path):
+    """A fire-and-forget task created under an open span inherits it
+    as parent but may outlive it — flagged unless the function awaits
+    the task."""
+    root = _tree(tmp_path, {"klogs_tpu/service/fireforget.py": """
+        import asyncio
+        from klogs_tpu.obs import trace
+        async def dispatch(op):
+            with trace.TRACER.span("shard.dispatch"):
+                asyncio.ensure_future(op())   # never awaited
+                t = asyncio.create_task(op())  # assigned, never awaited
+            return t
+        """})
+    found = _active(root, "span-discipline")
+    assert len(found) == 2
+    assert all("never awaited" in f.message for f in found)
+
+
+def test_span_discipline_allows_with_finally_and_hedge(tmp_path):
+    """The blessed shapes: with-blocks, manual span + finally .end(),
+    and the hedge pattern (tasks under a span that the function
+    awaits via asyncio.wait / await t)."""
+    root = _tree(tmp_path, {"klogs_tpu/service/ok.py": """
+        import asyncio
+        import re
+        from klogs_tpu.obs import trace
+
+        async def flush(batch):
+            with trace.TRACER.span("sink.flush", n=len(batch)):
+                await send(batch)
+
+        def manual(tracer):
+            sp = tracer.start_span("device.frame")
+            try:
+                return pack()
+            finally:
+                sp.end()
+
+        async def hedged(op, queue):
+            with trace.TRACER.span("shard.dispatch"):
+                pending = set()
+                t = asyncio.ensure_future(op())
+                pending.add(t)
+                done, pending = await asyncio.wait(pending)
+                return await t
+
+        def not_a_span(m):
+            # re.Match.span() must never false-positive
+            return m.span()
+        """})
+    assert _active(root, "span-discipline") == []
+
+
+def test_span_discipline_suppression(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/runtime/w.py": """
+        from klogs_tpu.obs import trace
+        def waived(tracer):
+            sp = tracer.span("x")  # klogs: ignore[span-discipline]
+            return sp
+        """})
+    report = run(str(tmp_path), rules=["span-discipline"])
+    assert [f for f in report.findings if not f.suppressed] == []
+    assert len([f for f in report.findings if f.suppressed]) == 1
+
+
+def test_span_discipline_real_tree_clean():
+    assert _active(REPO, "span-discipline") == []
+
+
 # -- docs parity (metrics-docs, cli-docs) ------------------------------
 
 def test_metrics_docs_shim_still_works():

@@ -618,3 +618,62 @@ def test_mesh_sweep_parity(monkeypatch):
     assert not eng.swept
     got = np.asarray(eng.match_batch(batch, lens))[: len(FUSE_LINES)]
     assert np.array_equal(got, want)
+
+
+def test_framed_entry_packs_rows_directly_no_split_frame(monkeypatch):
+    """PR 9 satellite (deferred from PR 8): with the device sweep
+    active, dispatch_framed packs width-bucketed byte batches straight
+    from the contiguous payload via the shared pack_framed_rows ragged
+    scatter — the split_frame/dispatch per-line-PyBytes detour must
+    never run. Parity against the list path and the re oracle, across
+    trailing-newline runs, empty lines, and a long row that bridges to
+    the chunked path."""
+    monkeypatch.setenv("KLOGS_TPU_SWEEP", "1")
+    from klogs_tpu.filters.tpu import NFAEngineFilter
+
+    f = NFAEngineFilter(FUSE_PATTERNS, kernel="interpret",
+                        chunk_bytes=256)
+    assert f._sweep_tables is not None
+    lines = (FUSE_LINES
+             + [b"svc-3 timeout\n", b"WARN disk\n\n", b"",
+                b"y" * 300 + b" FATAL\n", b"z" * 40 + b"\n"])
+    payload, offsets, _ = frame_lines(lines, strip_nl=False)
+
+    def boom(*a, **k):
+        raise AssertionError("framed byte entry fell back to the "
+                             "split_frame/dispatch detour")
+
+    monkeypatch.setattr(f, "dispatch", boom)
+    got = f.fetch_framed(f.dispatch_framed(payload, offsets)).tolist()
+    monkeypatch.undo()
+    want = [any(re.search(p.encode(), ln.rstrip(b"\n"))
+                for p in FUSE_PATTERNS) for ln in lines]
+    assert got == want
+    # And byte-for-byte agreement with the pre-existing list path.
+    assert got == f.match_lines(lines)
+
+
+def test_pack_framed_rows_sel_and_stripped_lens():
+    """The generalized ragged scatter: a row subset in sel order with
+    overridden (newline-stripped) lengths, zero-padded to the rows
+    bucket — plus the unchanged contiguous default."""
+    from klogs_tpu.filters.base import pack_framed_rows
+
+    lines = [b"alpha\n", b"bb", b"", b"cccc\n\n", b"dd\n"]
+    payload, offsets, _ = frame_lines(lines, strip_nl=False)
+    # Default: whole frame, raw lengths (unchanged behavior).
+    batch, lens = pack_framed_rows(payload, offsets, 8)
+    assert lens.tolist() == [6, 2, 0, 6, 3]
+    assert bytes(batch[0][:6]) == b"alpha\n"
+    # Subset with stripped lens, out-of-order sel, padded rows.
+    import numpy as np
+
+    sel = np.asarray([3, 0])
+    stripped = np.asarray([4, 5])  # cccc, alpha
+    sub, sub_lens = pack_framed_rows(payload, offsets, 8, rows=4,
+                                     sel=sel, lens=stripped)
+    assert sub.shape == (4, 8)
+    assert bytes(sub[0][:4]) == b"cccc" and not sub[0][4:].any()
+    assert bytes(sub[1][:5]) == b"alpha" and not sub[1][5:].any()
+    assert not sub[2:].any()
+    assert sub_lens.tolist() == [4, 5]

@@ -13,6 +13,7 @@ def all_passes():
     from tools.analysis.passes.lock_discipline import LockDisciplinePass
     from tools.analysis.passes.metrics_docs import MetricsDocsPass
     from tools.analysis.passes.retry_discipline import RetryDisciplinePass
+    from tools.analysis.passes.span_discipline import SpanDisciplinePass
     from tools.analysis.passes.traced_purity import TracedPurityPass
 
     return [
@@ -22,6 +23,7 @@ def all_passes():
         DispatchParityPass(),
         Int32GuardPass(),
         RetryDisciplinePass(),
+        SpanDisciplinePass(),
         MetricsDocsPass(),
         CliDocsPass(),
     ]

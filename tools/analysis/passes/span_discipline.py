@@ -1,0 +1,224 @@
+"""span-discipline: trace spans in the plumbing scope close
+deterministically and never leak into fire-and-forget tasks.
+
+The tracing subsystem (klogs_tpu/obs/trace.py) reports a span when it
+ENDS. Two bug shapes silently corrupt the per-batch story the flight
+recorder depends on:
+
+1. **Leaked spans.** A bare ``tracer.span(...)`` / ``start_span(...)``
+   call whose result is neither a ``with`` context manager nor closed
+   by ``name.end()`` in a ``finally`` never reports — the batch's hop
+   simply vanishes from every trace and dump, which is
+   indistinguishable from "this stage never ran". Rule: in the
+   plumbing scope, a span-creating call must be the context expression
+   of a ``with``/``async with`` item, or be assigned to a name whose
+   ``.end()`` is called inside a ``finally`` block of the same
+   function.
+
+2. **Spans carried across an unawaited task boundary.** An
+   ``asyncio.create_task`` / ``ensure_future`` inside an open
+   ``with <span>`` block copies the context at creation: the task's
+   child spans parent under a span that may END before the task runs,
+   producing children that outlive (and mis-time) their parent. That
+   is fine when the function awaits the task (the hedge pattern:
+   ``await asyncio.wait(pending)`` / ``await t``) — the parent
+   provably outlives its children — and a bug when the task is
+   fire-and-forget. Rule: inside a with-span block, a task-creating
+   call must have its result awaited somewhere in the same function
+   (directly, or via a name that appears under an ``await``
+   expression); a discarded or never-awaited task is a finding.
+
+Span-call detection is shape-based: an attribute call named ``span`` /
+``start_span`` whose receiver mentions a tracer (``TRACER`` /
+``tracer`` / ``_tracer`` / ``tr``) or whose first argument is a string
+literal — so ``re.Match.span()`` can never false-positive.
+"""
+
+import ast
+
+from tools.analysis.core import Finding, Pass, Project, SourceFile
+
+SCOPE = (
+    "klogs_tpu/service",
+    "klogs_tpu/runtime",
+    "klogs_tpu/filters",
+    "klogs_tpu/parallel",
+    "klogs_tpu/resilience",
+    "klogs_tpu/cluster",
+)
+
+_SPAN_NAMES = {"span", "start_span"}
+_TRACER_HINTS = {"tracer", "_tracer", "tr", "TRACER"}
+_TASK_FUNCS = {"create_task", "ensure_future"}
+
+
+def _receiver_names(node: ast.AST) -> "set[str]":
+    out: "set[str]" = set()
+    while isinstance(node, ast.Attribute):
+        out.add(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        out.add(node.id)
+    return out
+
+
+def _is_span_call(node: ast.AST) -> bool:
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _SPAN_NAMES):
+        return False
+    if _receiver_names(node.func.value) & _TRACER_HINTS:
+        return True
+    return bool(node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str))
+
+
+def _is_task_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr in _TASK_FUNCS
+    return isinstance(node.func, ast.Name) and node.func.id in _TASK_FUNCS
+
+
+def _functions(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _own_walk(fn: ast.AST):
+    """Nodes of ``fn`` excluding nested function/class bodies (they are
+    analyzed as their own functions)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+class SpanDisciplinePass(Pass):
+    rule = "span-discipline"
+    doc = ("trace spans must close via with/finally and must not leak "
+           "into fire-and-forget tasks")
+
+    def run(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for sf in project.files(*SCOPE):
+            findings.extend(self._check_file(sf))
+        return findings
+
+    def _check_file(self, sf: SourceFile) -> list[Finding]:
+        findings: list[Finding] = []
+        for fn in _functions(sf.tree):
+            findings.extend(self._check_function(sf, fn))
+        return findings
+
+    # -- rule 1: span lifecycle ---------------------------------------
+
+    def _check_function(self, sf: SourceFile, fn: ast.AST) -> list[Finding]:
+        findings: list[Finding] = []
+        nodes = list(_own_walk(fn))
+
+        # Span calls used as with-items are fine.
+        with_items: "set[int]" = set()
+        for n in nodes:
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    with_items.add(id(item.context_expr))
+
+        # Names whose .end() runs in a finally block of this function.
+        ended_in_finally: "set[str]" = set()
+        for n in nodes:
+            if isinstance(n, ast.Try):
+                for fin in n.finalbody:
+                    for sub in ast.walk(fin):
+                        if (isinstance(sub, ast.Call)
+                                and isinstance(sub.func, ast.Attribute)
+                                and sub.func.attr == "end"
+                                and isinstance(sub.func.value, ast.Name)):
+                            ended_in_finally.add(sub.func.value.id)
+
+        # Assignments name = <span call>.
+        assigned_to: "dict[int, str]" = {}
+        for n in nodes:
+            if (isinstance(n, ast.Assign) and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)):
+                assigned_to[id(n.value)] = n.targets[0].id
+
+        for n in nodes:
+            if not _is_span_call(n):
+                continue
+            if id(n) in with_items:
+                continue
+            name = assigned_to.get(id(n))
+            if name is not None and name in ended_in_finally:
+                continue
+            findings.append(self.finding(
+                sf.relpath, n.lineno,
+                "span opened without lifecycle: use `with tracer."
+                "span(...)`, or assign it and call `.end()` in a "
+                "finally — an unclosed span never reports, silently "
+                "dropping this hop from every trace and flight dump"))
+
+        findings.extend(self._check_tasks_under_spans(sf, fn, nodes,
+                                                      with_items))
+        return findings
+
+    # -- rule 2: tasks created under an open span ---------------------
+
+    def _check_tasks_under_spans(self, sf: SourceFile, fn: ast.AST,
+                                 nodes: "list[ast.AST]",
+                                 with_items: "set[int]") -> list[Finding]:
+        # Names that appear anywhere under an `await` expression in this
+        # function: awaiting the task (or a collection fed to
+        # asyncio.wait/gather) proves the span outlives it.
+        awaited_names: "set[str]" = set()
+        for n in nodes:
+            if isinstance(n, ast.Await):
+                for sub in ast.walk(n):
+                    if isinstance(sub, ast.Name):
+                        awaited_names.add(sub.id)
+
+        findings: list[Finding] = []
+        for n in nodes:
+            if not isinstance(n, (ast.With, ast.AsyncWith)):
+                continue
+            if not any(id(item.context_expr) in with_items
+                       and _is_span_call(item.context_expr)
+                       for item in n.items):
+                continue
+            # Statements inside this with-span block (nested defs are
+            # their own scope — a closure runs elsewhere).
+            body_nodes: "list[ast.AST]" = []
+            stack: "list[ast.AST]" = list(n.body)
+            while stack:
+                b = stack.pop()
+                if isinstance(b, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                    continue
+                body_nodes.append(b)
+                stack.extend(ast.iter_child_nodes(b))
+            for b in body_nodes:
+                if not isinstance(b, ast.Expr) and not isinstance(
+                        b, ast.Assign):
+                    continue
+                call = b.value
+                if not _is_task_call(call):
+                    continue
+                if isinstance(b, ast.Assign):
+                    target = b.targets[0]
+                    if (isinstance(target, ast.Name)
+                            and target.id in awaited_names):
+                        continue
+                findings.append(self.finding(
+                    sf.relpath, call.lineno,
+                    "task created under an open span and never awaited "
+                    "in this function: the task inherits the span as "
+                    "parent but the span may end before it runs — "
+                    "await the task (asyncio.wait/gather/await) inside "
+                    "the span, or create it outside the with block"))
+        return findings
