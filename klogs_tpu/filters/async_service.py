@@ -79,7 +79,9 @@ class AsyncFilterService:
                  fetch_workers: int = DEFAULT_FETCH_WORKERS,
                  coalesce_lines: int = DEFAULT_COALESCE_LINES,
                  coalesce_delay_s: float = DEFAULT_COALESCE_DELAY_S,
-                 stats: FilterStats | None = None):
+                 stats: FilterStats | None = None,
+                 executor: "ThreadPoolExecutor | None" = None,
+                 in_flight: "asyncio.Semaphore | None" = None):
         self._filter = log_filter
         # Optional split-latency recording (queue wait vs device time) so
         # --stats can tell saturation queueing from engine latency.
@@ -101,8 +103,14 @@ class AsyncFilterService:
                     "klogs_coalescer_backpressure_wait_seconds"),
                 "dispatch": r.family("klogs_coalescer_dispatch_seconds"),
             }
-        self._sem = asyncio.Semaphore(max_in_flight)
-        self._pool = ThreadPoolExecutor(
+        # The multi-tenant registry (service/tenancy.py) injects ONE
+        # shared fetch pool + ONE in-flight semaphore across every
+        # set's service: the process owns one device, so the budget is
+        # global. A service only shuts down a pool it created itself.
+        self._sem = (in_flight if in_flight is not None
+                     else asyncio.Semaphore(max_in_flight))
+        self._own_pool = executor is None
+        self._pool = executor if executor is not None else ThreadPoolExecutor(
             max_workers=fetch_workers, thread_name_prefix="klogs-fetch"
         )
         self._coalesce_lines = coalesce_lines
@@ -306,8 +314,10 @@ class AsyncFilterService:
         # All in-flight fetches were just gathered, so the join is
         # near-instant — but it still joins threads, which must not
         # happen on the event loop (every other stream's flush would
-        # stall behind it).
-        await asyncio.to_thread(self._pool.shutdown)
+        # stall behind it). An injected (shared) pool outlives this
+        # service: its owner shuts it down.
+        if self._own_pool:
+            await asyncio.to_thread(self._pool.shutdown)
         self._filter.close()
 
     def close(self) -> None:
@@ -315,5 +325,6 @@ class AsyncFilterService:
         if self._kick_handle is not None:
             self._kick_handle.cancel()
             self._kick_handle = None
-        self._pool.shutdown(wait=True)
+        if self._own_pool:
+            self._pool.shutdown(wait=True)
         self._filter.close()

@@ -226,6 +226,42 @@ SPECS: dict[str, dict] = {
         "prober (1 ready, 0 draining or unreachable).",
         labels=("endpoint",)),
 
+    # -- tenancy (multi-set registry, service/tenancy.py) -------------
+    # The `set` label is a pattern-set fingerprint: bounded by the
+    # registry capacity KLOGS_TENANT_MAX_SETS (a deployment knob), so
+    # per-set series obey the deployment-shape cardinality rule even
+    # though fingerprints derive from collector invocations.
+    "klogs_tenant_sets": _m(
+        "gauge", "Pattern sets currently registered (compiled engines "
+        "live in this process)."),
+    "klogs_tenant_registrations_total": _m(
+        "counter", "Register RPC outcomes: new (engine built) or "
+        "shared (content-addressed reuse of a live engine).",
+        labels=("outcome",)),
+    "klogs_tenant_engine_builds_total": _m(
+        "counter", "Engines compiled by the registry. Two tenants "
+        "registering the same fingerprint advance this ONCE — the "
+        "content-addressed-sharing acceptance counter."),
+    "klogs_tenant_evictions_total": _m(
+        "counter", "Registered sets evicted, by reason: idle (past "
+        "KLOGS_TENANT_IDLE_S), capacity (LRU past "
+        "KLOGS_TENANT_MAX_SETS), shutdown.", labels=("reason",)),
+    "klogs_tenant_shed_total": _m(
+        "counter", "Batches shed over the per-set pending-line quota "
+        "(KLOGS_TENANT_QUOTA_LINES); the client degrades them through "
+        "--on-filter-error — never a silent drop.", labels=("set",)),
+    "klogs_tenant_pending_lines": _m(
+        "gauge", "Lines admitted or awaiting admission per set lane "
+        "(the quota accounting the shed decision reads).",
+        labels=("set",)),
+    "klogs_tenant_lines_total": _m(
+        "counter", "Lines admitted (past quota + fair gate) per set "
+        "lane.", labels=("set",)),
+    "klogs_tenant_admission_wait_seconds": _m(
+        "histogram", "Wait for a weighted-fair admission slot before a "
+        "batch may dispatch — the fairness latency an abusive sibling "
+        "inflicts.", buckets=LATENCY_BUCKETS),
+
     # -- tracing / flight recorder (obs.trace) ------------------------
     "klogs_trace_spans_total": _m(
         "counter", "Finished sampled spans recorded by the tracer "

@@ -214,6 +214,20 @@ class Family:
                 child = self._children[key] = self._make_child()
             return child
 
+    def remove(self, **labelvalues: object) -> bool:
+        """Drop one labeled child (and its series from the exposition).
+        For label values with a bounded LIFETIME churn but bounded
+        LIVE count — e.g. the tenancy registry's per-set series, where
+        evicted fingerprints would otherwise accumulate dead series
+        forever. Returns False when the child never existed."""
+        if set(labelvalues) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name} takes labels {self.labelnames}, "
+                f"got {tuple(labelvalues)}")
+        key = tuple(str(labelvalues[n]) for n in self.labelnames)
+        with self._lock:
+            return self._children.pop(key, None) is not None
+
     def children(self) -> list:
         """Sorted (labelvalues, child) pairs — a stable exposition
         order regardless of observation order."""

@@ -20,6 +20,14 @@ def main() -> None:
                     help="regex pattern to DROP even when kept "
                     "(repeatable; alone = keep all non-matching)")
     ap.add_argument("--backend", choices=["cpu", "tpu"], default="tpu")
+    ap.add_argument("--multi-set", action="store_true", dest="multi_set",
+                    help="multi-tenant registry mode: collectors "
+                    "register their own pattern sets (content-addressed "
+                    "— identical sets share one compiled engine) and "
+                    "are admitted weighted-fair with per-set quotas; "
+                    "--match/--exclude become the optional default set "
+                    "for legacy collectors (docs/TENANCY.md; "
+                    "KLOGS_TENANT_* env knobs)")
     ap.add_argument("-I", "--ignore-case", action="store_true",
                     dest="ignore_case",
                     help="case-insensitive patterns (collectors must "
@@ -70,6 +78,7 @@ def main() -> None:
     try:
         asyncio.run(serve(ns.match, ns.backend, ns.host, ns.port,
                           ignore_case=ns.ignore_case,
+                          multi_set=ns.multi_set,
                           tls_cert=ns.tls_cert, tls_key=ns.tls_key,
                           tls_client_ca=ns.tls_client_ca,
                           auth_token_file=ns.auth_token_file,

@@ -9,6 +9,7 @@ overlap without extra machinery.
 
 import grpc
 
+from klogs_tpu.cluster.backend import ClusterError
 from klogs_tpu.resilience import (
     CircuitBreaker,
     RetryPolicy,
@@ -40,6 +41,22 @@ class PatternMismatch(RuntimeError):
     pass
 
 
+class ShedByServer(Unavailable):
+    """A multi-tenant filterd shed this batch over the set's quota
+    (RESOURCE_EXHAUSTED). Subclasses Unavailable so it rides the
+    existing --on-filter-error degrade path — a shed batch is a counted
+    degrade event, never a silent drop — and the sharded tier treats it
+    as a failover signal (a sibling may have quota headroom)."""
+
+
+class SetEvicted(ClusterError):
+    """The server no longer holds this client's registered set
+    (FAILED_PRECONDITION: cold-set eviction or a server restart). The
+    client re-registers once and retries; surfaced — as the CLI's
+    friendly one-liner — only when that is impossible (no recorded
+    expected config to re-register)."""
+
+
 class ServiceConfigError(ValueError):
     """Invalid/partial transport-security configuration or unreadable
     credential material — surfaced as one friendly fatal line by the
@@ -54,13 +71,48 @@ def _read(path: str, what: str) -> bytes:
         raise ServiceConfigError(f"cannot read {what} {path}: {e}") from e
 
 
+def tenant_weight() -> float:
+    """This collector's weighted-fair share request, sent with its
+    Register RPC against a multi-set filterd (KLOGS_TENANT_WEIGHT,
+    default 1.0 — equal shares). Highest registered weight wins for a
+    shared set, server-side. Validated here: a bad value must fail
+    naming the variable, not degrade to silent equal-share."""
+    import math
+    import os
+
+    raw = os.environ.get("KLOGS_TENANT_WEIGHT")
+    if raw is None:
+        return 1.0
+    try:
+        v = float(raw)
+        if not math.isfinite(v) or not 0 < v <= 1024:
+            raise ValueError
+    except ValueError:
+        raise ServiceConfigError(
+            f"KLOGS_TENANT_WEIGHT must be in (0, 1024], got {raw!r}"
+        ) from None
+    return v
+
+
 def check_server_config(target: str, info: dict, patterns: list[str],
                         ignore_case: bool,
-                        exclude: "list[str] | None") -> None:
-    """Compare a Hello response against the collector's invocation and
-    raise PatternMismatch naming ``target`` on any drift. Shared by the
-    single-endpoint client and the sharded tier (which verifies every
-    endpoint from ONE Hello each instead of re-dialing per check)."""
+                        exclude: "list[str] | None") -> str:
+    """Compare a Hello response against the collector's invocation.
+    Returns ``"ok"`` (verified), or ``"register"`` when the server runs
+    the multi-tenant registry and this collector's set must be (or
+    already is) registered there — a multi-set server never "drifts",
+    it registers, so the single-set PatternMismatch hard-fail only
+    applies to fixed-set servers. Raises PatternMismatch naming
+    ``target`` on single-set drift. Shared by the single-endpoint
+    client and the sharded tier (which verifies every endpoint from ONE
+    Hello each instead of re-dialing per check)."""
+    if info.get("multi_set"):
+        # Always (re-)register: it is content-addressed and idempotent
+        # (a live set is a cheap reuse that refreshes the LRU clock),
+        # and every client needs the returned set id to tag its match
+        # RPCs — even when a sibling collector registered the set
+        # first.
+        return "register"
     if list(info.get("patterns", [])) != list(patterns):
         raise PatternMismatch(
             f"filter service at {target} serves patterns "
@@ -77,6 +129,7 @@ def check_server_config(target: str, info: dict, patterns: list[str],
             f"{info.get('ignore_case', False)!r}, collector wants "
             f"{bool(ignore_case)!r}"
         )
+    return "ok"
 
 
 class RemoteFilterClient:
@@ -138,9 +191,16 @@ class RemoteFilterClient:
         self._match_framed_rpc = self._channel.unary_unary(
             transport.MATCH_FRAMED)
         self._hello_rpc = self._channel.unary_unary(transport.HELLO)
+        self._register_rpc = self._channel.unary_unary(transport.REGISTER)
         # None until the first Hello; old servers (no "framed" key)
         # route match_framed through the legacy per-line Match.
         self._server_framed: bool | None = None
+        # Multi-tenant registry state (docs/TENANCY.md): the set id the
+        # server handed back at registration, attached to every match
+        # RPC; the expected config is remembered so an evicted set can
+        # be re-registered transparently mid-stream.
+        self._set_id: str | None = None
+        self._expected_cfg: "tuple[list[str], bool, list[str]] | None" = None
         # Resilience (docs/RESILIENCE.md): every RPC runs under a
         # per-attempt Deadline + retry on UNAVAILABLE/DEADLINE_EXCEEDED
         # behind one breaker per client — consecutive failures trip it
@@ -181,8 +241,6 @@ class RemoteFilterClient:
     def _friendly(self, e: "grpc.aio.AioRpcError"):
         # One clean line instead of a grpc traceback: reuse the CLI's
         # ClusterError path (control-plane-failure UX, cli.py).
-        from klogs_tpu.cluster.backend import ClusterError
-
         return ClusterError(
             f"filter service at {self._target}: "
             f"{e.code().name}: {e.details()}")
@@ -234,11 +292,41 @@ class RemoteFilterClient:
                     f"(retries exhausted)") from cause
             raise
         except grpc.aio.AioRpcError as e:
+            if (e.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+                    and (e.details() or "").startswith(
+                        transport.OVER_QUOTA)):
+                # Multi-tenant quota shed (keyed on the wire token —
+                # gRPC's own RESOURCE_EXHAUSTED for oversize messages
+                # must stay a loud ClusterError): NOT retried (the
+                # lane is full; an instant retry only deepens it) and
+                # NOT a breaker failure — it flows to the degrade path
+                # (or the shard tier's failover: a sibling may have
+                # room).
+                raise ShedByServer(
+                    f"filter service at {self._target}: "
+                    f"{e.details()}") from e
+            if (e.code() == grpc.StatusCode.FAILED_PRECONDITION
+                    and (e.details() or "").startswith(
+                        transport.SET_NOT_REGISTERED)):
+                # The server evicted (or never had) our set: the caller
+                # re-registers once and retries. Keyed on the stable
+                # wire token, not the prose after it (version skew).
+                raise SetEvicted(
+                    f"filter service at {self._target}: "
+                    f"{e.details()}") from e
             raise self._friendly(e) from e
 
     async def hello(self) -> dict:
+        # Once an expected config is recorded (verify_patterns /
+        # ensure_registered), every Hello carries it: a multi-set
+        # server then answers against its REGISTRY for OUR fingerprint
+        # instead of its default set.
+        body = b""
+        if self._expected_cfg is not None:
+            pats, ic, excl = self._expected_cfg
+            body = transport.encode_hello_request(pats, excl, ic)
         info = transport.unpack(
-            await self._call(self._hello_rpc, b"", "rpc.hello"))
+            await self._call(self._hello_rpc, body, "rpc.hello"))
         self._server_framed = bool(info.get("framed", False))
         return info
 
@@ -247,14 +335,68 @@ class RemoteFilterClient:
                               exclude: "list[str] | None" = None) -> None:
         """Fail fast if the server filters with a different pattern set
         (case mode or exclude set) than this collector was invoked
-        with."""
+        with. Against a multi-tenant registry server there is no fixed
+        set to drift from: the collector REGISTERS its set instead
+        (content-addressed — identical sets share one engine) and tags
+        every later match RPC with the returned set id."""
+        self._expected_cfg = (list(patterns), bool(ignore_case),
+                              list(exclude or []))
         info = await self.hello()
-        check_server_config(self._target, info, patterns, ignore_case,
-                            exclude)
+        if check_server_config(self._target, info, patterns, ignore_case,
+                               exclude) == "register":
+            await self._register_set()
+
+    async def ensure_registered(self, patterns: list[str],
+                                ignore_case: bool = False,
+                                exclude: "list[str] | None" = None
+                                ) -> None:
+        """Record the expected config and register it (idempotent —
+        re-registration of a live set is a content-addressed no-op).
+        The sharded tier calls this per endpoint after its own
+        fleet-wide Hello sweep."""
+        self._expected_cfg = (list(patterns), bool(ignore_case),
+                              list(exclude or []))
+        await self._register_set()
+
+    async def _register_set(self) -> None:
+        assert self._expected_cfg is not None
+        pats, ic, excl = self._expected_cfg
+        resp = transport.decode_register_response(await self._call(
+            self._register_rpc,
+            transport.encode_register_request(
+                pats, excl, ic, weight=tenant_weight()),
+            "rpc.register"))
+        self._set_id = resp["set"]
+
+    async def _call_set(self, rpc, build, fault_point: str):
+        """One match RPC carrying the tenant set id, transparently
+        re-registering ONCE when the server evicted the set while it
+        was cold (the eviction/re-register roundtrip is part of the
+        registry contract, not an error the collector should see)."""
+        try:
+            return await self._call(rpc, build(self._set_id), fault_point)
+        except SetEvicted:
+            if self._expected_cfg is None:
+                raise
+            await self._register_set()
+            try:
+                return await self._call(rpc, build(self._set_id),
+                                        fault_point)
+            except SetEvicted as e:
+                # Evicted AGAIN before the retry landed: the registry
+                # is in capacity churn (more active tenants than
+                # KLOGS_TENANT_MAX_SETS). That is an overload
+                # condition, not a config bug — degrade/fail over like
+                # any other unavailability instead of killing the run.
+                raise Unavailable(
+                    f"filter service at {self._target}: set evicted "
+                    f"again immediately after re-registration "
+                    f"(registry capacity churn): {e}") from e
 
     async def match(self, lines: list[bytes]) -> list[bool]:
-        resp = await self._call(
-            self._match_rpc, transport.encode_match_request(lines),
+        resp = await self._call_set(
+            self._match_rpc,
+            lambda sid: transport.encode_match_request(lines, set_id=sid),
             "rpc.match")
         return transport.decode_match_response(resp)
 
@@ -272,9 +414,10 @@ class RemoteFilterClient:
 
             return np.asarray(
                 await self.match(split_frame(payload, offsets)), dtype=bool)
-        resp = await self._call(
+        resp = await self._call_set(
             self._match_framed_rpc,
-            transport.encode_framed_request(payload, offsets),
+            lambda sid: transport.encode_framed_request(payload, offsets,
+                                                        set_id=sid),
             "rpc.match")
         return transport.decode_framed_response(resp)
 

@@ -32,6 +32,7 @@ import time
 import grpc
 
 from klogs_tpu.filters.async_service import AsyncFilterService
+from klogs_tpu.obs import trace
 from klogs_tpu.service import transport
 from klogs_tpu.version import BUILD_VERSION
 
@@ -122,7 +123,11 @@ class FilterServer:
                  exclude: "list[str] | None" = None,
                  metrics_port: int | None = None,
                  metrics_host: str = "127.0.0.1",
-                 registry=None):
+                 registry=None,
+                 multi_set: bool = False,
+                 tenant_max_sets: "int | None" = None,
+                 tenant_quota_lines: "int | None" = None,
+                 tenant_idle_s: "float | None" = None):
         if bool(tls_cert) != bool(tls_key):
             raise ValueError(
                 "tls_cert and tls_key must be provided together "
@@ -133,7 +138,8 @@ class FilterServer:
             raise ValueError("pass auth_token OR auth_token_file, not both")
         self.patterns = list(patterns)
         self.exclude = list(exclude or [])
-        if not self.patterns and not self.exclude:
+        self.multi_set = multi_set
+        if not self.patterns and not self.exclude and not multi_set:
             raise ValueError("need at least one --match or --exclude pattern")
         self.backend = backend
         self.host = host
@@ -188,13 +194,57 @@ class FilterServer:
             # Liveness: the coalescer loop must still accept work —
             # a closed service means restart; a merely-cold one does not.
             self.health.add_live_check(
-                "coalescer", lambda: not self._service._closed)
-        self._filter = _make_filter(patterns, backend,
-                                    ignore_case=ignore_case,
-                                    exclude=self.exclude,
-                                    stats=self._stats)
-        self._service = AsyncFilterService(self._filter,
-                                           stats=self._stats)
+                "coalescer", lambda: self._service is None
+                or not self._service._closed)
+        # Multi-tenant registry (docs/TENANCY.md): content-addressed
+        # pattern sets behind weighted-fair admission; the startup set
+        # (when present) is adopted as a pinned default lane so legacy
+        # un-tagged RPCs compete fairly with registered tenants.
+        self.tenants = None
+        self.default_set: "str | None" = None
+        self._sweep_task: asyncio.Task | None = None
+        self._sweep_stop: "asyncio.Event | None" = None
+        if multi_set:
+            from klogs_tpu.service.tenancy import PatternSetRegistry
+
+            def factory(pats: list[str], excl: list[str],
+                        ic: bool):
+                # Tenant engines share the server's FilterStats (and
+                # registry): engine metrics, sweep-fallback counters,
+                # and flight-recorder triggers must fire for REGISTERED
+                # sets too, not just the startup default — per-set
+                # attribution rides the klogs_tenant_* families.
+                return _make_filter(pats, self.backend, ignore_case=ic,
+                                    exclude=excl, stats=self._stats)
+
+            self.tenants = PatternSetRegistry(
+                factory, stats=self._stats,
+                max_sets=tenant_max_sets,
+                quota_lines=tenant_quota_lines,
+                idle_evict_s=tenant_idle_s)
+        # The startup set compiles exactly as before (single-set path
+        # byte-identical); a registry-only multi-set server (no --match)
+        # has no default engine until the first Register RPC. In
+        # registry mode the default service rides the registry's SHARED
+        # fetch pool + in-flight budget — the process owns one device,
+        # and legacy un-tagged traffic must not double that budget.
+        self._filter = None
+        self._service = None
+        if self.patterns or self.exclude:
+            self._filter = _make_filter(patterns, backend,
+                                        ignore_case=ignore_case,
+                                        exclude=self.exclude,
+                                        stats=self._stats)
+            shared = ({} if self.tenants is None
+                      else dict(executor=self.tenants.executor,
+                                in_flight=self.tenants.in_flight))
+            self._service = AsyncFilterService(self._filter,
+                                               stats=self._stats,
+                                               **shared)
+            if self.tenants is not None:
+                self.default_set = self.tenants.adopt(
+                    self.patterns, self.exclude, self.ignore_case,
+                    self._service)
         self._server: grpc.aio.Server | None = None
 
     @property
@@ -205,8 +255,16 @@ class FilterServer:
         which servers run the fused path without scraping each
         sidecar. Computed per Hello, not cached at startup: a sweep
         that degraded mid-run (kernel failure, host fallback) must
-        stop being advertised."""
-        return _uses_device_sweep(self._filter)
+        stop being advertised. In registry mode ANY registered set's
+        engine counts — a registry-only server whose tenants run the
+        fused path must not advertise False."""
+        if self._filter is not None and _uses_device_sweep(self._filter):
+            return True
+        if self.tenants is not None:
+            return any(
+                not e.pinned and _uses_device_sweep(e.service._filter)
+                for e in self.tenants.entries())
+        return False
 
     @property
     def auth_enabled(self) -> bool:
@@ -309,6 +367,13 @@ class FilterServer:
         from klogs_tpu.filters.base import frame_lines
 
         try:
+            if self._service is None:
+                # Registry-only multi-set server: nothing compiles until
+                # the first Register RPC, so the server is ready as soon
+                # as it binds (each registration pays its own compile
+                # off the event loop).
+                self.health.mark_warm()
+                return
             payload, offsets, _ = frame_lines([b"klogs-warmup probe"])
             await self._service.match_framed(payload, offsets)
             # mark_warm, not set_ready: a drain that raced the warmup
@@ -320,6 +385,8 @@ class FilterServer:
 
     async def _hello(self, request: bytes, context) -> bytes:
         await self._check_auth(context)
+        if self.tenants is not None:
+            return await self._hello_multi(request)
         return transport.pack({
             "patterns": self.patterns,
             "exclude": self.exclude,
@@ -344,10 +411,131 @@ class FilterServer:
             "device_sweep": self.device_sweep,
         })
 
+    async def _hello_multi(self, request: bytes) -> bytes:
+        """Multi-set Hello: answer verify_patterns against the REGISTRY
+        (match-by-fingerprint), not the single startup list — a second
+        collector with a different set registers instead of hard-failing
+        PatternMismatch. A request carrying the collector's invocation
+        is echoed back when that fingerprint is registered (so the
+        legacy client-side comparison passes); the legacy empty Hello
+        gets the default (startup) set, keeping old collectors working
+        against a multi-set server unchanged."""
+        from klogs_tpu.service.shard import pattern_fingerprint
+
+        want = transport.decode_hello_request(request)
+        patterns, exclude, ignore_case = (self.patterns, self.exclude,
+                                          self.ignore_case)
+        set_id: "str | None" = self.default_set
+        registered = self.default_set is not None
+        if want is not None:
+            set_id = pattern_fingerprint(want["patterns"], want["exclude"],
+                                         want["ignore_case"])
+            entry = self.tenants.get(set_id)
+            registered = entry is not None
+            if registered:
+                patterns = entry.patterns
+                exclude = entry.exclude
+                ignore_case = entry.ignore_case
+        sp = trace.TRACER.current_span()
+        if sp is not None and set_id is not None:
+            sp.set_attr("tenant", set_id)
+        return transport.pack({
+            "patterns": patterns,
+            "exclude": exclude,
+            "ignore_case": ignore_case,
+            "backend": self.backend,
+            "version": BUILD_VERSION,
+            "framed": True,
+            "metrics_port": self.metrics_port,
+            "metrics_host": self.metrics_host,
+            "device_sweep": self.device_sweep,
+            # Registry mode: the client should Register its set (once)
+            # and tag match RPCs with the returned id. "sets" is the
+            # live registered count (banner/fleet debugging).
+            "multi_set": True,
+            "sets": self.tenants.count,
+            "set": set_id,
+            "registered": registered,
+        })
+
+    async def _register(self, request: bytes, context) -> bytes:
+        """Register-once RPC: content-addressed, so two tenants with
+        identical sets share one compiled engine (the engine-build
+        counter must NOT advance on the second registration)."""
+        await self._check_auth(context)
+        if self.tenants is None:
+            await context.abort(
+                grpc.StatusCode.UNIMPLEMENTED,
+                "this filterd runs in single-set mode (start it with "
+                "--multi-set to accept registrations)")
+        try:
+            req = transport.decode_register_request(request)
+        except (ValueError, KeyError, TypeError) as e:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                f"bad register request: {e}")
+        try:
+            set_id, shared = await self.tenants.register(
+                req["patterns"], req["exclude"], req["ignore_case"],
+                weight=req["weight"])
+        except ValueError as e:
+            # RegexSyntaxError and friends: the tenant's OWN set is
+            # broken — its registration fails, nobody else's.
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                f"cannot compile pattern set: {e}")
+        sp = trace.TRACER.current_span()
+        if sp is not None:
+            sp.set_attr("tenant", set_id)
+        return transport.encode_register_response(
+            set_id, shared, self.tenants.count)
+
+    def _route_set(self, set_id: "str | None") -> "str | None":
+        """Which registry lane serves this request: its explicit set
+        tag, else the default (startup) set."""
+        return set_id if set_id is not None else self.default_set
+
+    async def _tenant_match(self, set_id: "str | None", context, run):
+        """Route one match RPC through the registry: admission, quota
+        shed (RESOURCE_EXHAUSTED — the client degrades it through the
+        existing --on-filter-error path), unknown/evicted set
+        (FAILED_PRECONDITION — the client re-registers and retries)."""
+        from klogs_tpu.service.tenancy import OverQuota, SetNotRegistered
+
+        lane = self._route_set(set_id)
+        if lane is None:
+            await context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"{transport.SET_NOT_REGISTERED}: this multi-set "
+                "filterd has no default pattern set; register one "
+                "first")
+        sp = trace.TRACER.current_span()
+        if sp is not None:
+            sp.set_attr("tenant", lane)
+        try:
+            return await run(lane)
+        except OverQuota as e:
+            await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                                f"{transport.OVER_QUOTA}: {e}")
+        except SetNotRegistered as e:
+            await context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"{transport.SET_NOT_REGISTERED}: {e}")
+
     async def _match(self, request: bytes, context) -> bytes:
         await self._check_auth(context)
-        lines = transport.decode_match_request(request)
-        mask = await self._service.match(lines)
+        try:
+            lines, set_id = transport.decode_match_request(request)
+        except (ValueError, KeyError, TypeError) as e:
+            # Same contract as _match_framed: a malformed request fails
+            # ITS OWN RPC with a clean status, never an UNKNOWN
+            # traceback.
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                f"bad match request: {e}")
+        if self.tenants is not None:
+            mask = await self._tenant_match(
+                set_id, context,
+                lambda lane: self.tenants.match(lane, lines))
+        else:
+            mask = await self._service.match(lines)
         return transport.encode_match_response(mask)
 
     async def _match_framed(self, request: bytes, context) -> bytes:
@@ -357,14 +545,21 @@ class FilterServer:
         mask)."""
         await self._check_auth(context)
         try:
-            payload, offsets = transport.decode_framed_request(request)
+            payload, offsets, set_id = transport.decode_framed_request(
+                request)
         except (ValueError, KeyError, TypeError) as e:
             # Malformed framing fails ITS OWN RPC with a clean status —
             # decode validation guarantees it can never reach the
             # coalescer shared with other collectors.
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                                 f"bad framed request: {e}")
-        mask = await self._service.match_framed(payload, offsets)
+        if self.tenants is not None:
+            mask = await self._tenant_match(
+                set_id, context,
+                lambda lane: self.tenants.match_framed(
+                    lane, payload, offsets))
+        else:
+            mask = await self._service.match_framed(payload, offsets)
         return transport.encode_framed_response(mask)
 
     async def start(self) -> int:
@@ -382,6 +577,9 @@ class FilterServer:
                 "MatchFramed": grpc.unary_unary_rpc_method_handler(
                     self._traced("MatchFramed", self._instrumented(
                         "MatchFramed", self._match_framed))),
+                "Register": grpc.unary_unary_rpc_method_handler(
+                    self._traced("Register", self._instrumented(
+                        "Register", self._register))),
             },
         )
         # Jumbo batches (thousands of long lines) exceed gRPC's 4 MB
@@ -432,7 +630,10 @@ class FilterServer:
                 # yet) and surface the friendly ValueError path.
                 self._http = None
                 await self._server.stop(0)
-                self._service.close()
+                if self.tenants is not None:
+                    self.tenants.close()
+                if self._service is not None:
+                    self._service.close()
                 raise ValueError(
                     f"cannot bind metrics port "
                     f"{self.metrics_host}:{self.metrics_port}: {e}") from e
@@ -441,6 +642,13 @@ class FilterServer:
             # while /healthz already answers 200.
             self._warmup_task = asyncio.get_running_loop().create_task(
                 self._warmup())
+        if self.tenants is not None and self.tenants.idle_evict_s > 0:
+            # Cold-set reaper: idle compiled engines are released (and
+            # re-registerable — the on-disk DFA LRU makes that a table
+            # load, not a determinization).
+            self._sweep_stop = asyncio.Event()
+            self._sweep_task = asyncio.get_running_loop().create_task(
+                self.tenants.run_idle_sweeper(self._sweep_stop))
         return self.port
 
     async def wait(self) -> None:
@@ -454,17 +662,45 @@ class FilterServer:
             except (asyncio.CancelledError, Exception):
                 pass
             self._warmup_task = None
+        if self._sweep_task is not None:
+            if self._sweep_stop is not None:
+                self._sweep_stop.set()
+            self._sweep_task.cancel()
+            try:
+                await self._sweep_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._sweep_task = None
         if self._http is not None:
             await self._http.stop()
             self._http = None
         if self._server is not None:
             await self._server.stop(grace)
-        self._service.close()
+        if self.tenants is not None:
+            # Registered sets drain and close; the pinned startup
+            # service is the server's own, closed below.
+            await self.tenants.aclose()
+        if self._service is not None:
+            self._service.close()
+
+
+def banner_line(server: "FilterServer", where: str, mode: str) -> str:
+    """The startup 'serving ...' line: registry mode reports the LIVE
+    set count (the operating number — the fixed startup list, possibly
+    empty, is just one lane), single-set mode stays byte-identical."""
+    if server.tenants is not None:
+        return (f"klogs filterd: serving pattern-set registry "
+                f"({server.tenants.count} live set(s), cap "
+                f"{server.tenants.max_sets}) [{server.backend}] on "
+                f"{where} ({mode})")
+    return (f"klogs filterd: serving {len(server.patterns)} pattern(s) "
+            f"[{server.backend}] on {where} ({mode})")
 
 
 async def serve(patterns: list[str], backend: str, host: str, port: int,
                 ignore_case: bool = False,
-                trace_json: "str | None" = None, **security) -> None:
+                trace_json: "str | None" = None,
+                multi_set: bool = False, **security) -> None:
     if trace_json is not None:
         # Server-side batch tracing: spans root at rpc.server (or
         # continue a collector's trace via the metadata traceparent)
@@ -475,7 +711,8 @@ async def serve(patterns: list[str], backend: str, host: str, port: int,
         _trace.TRACER.enable_default()
         _trace.TRACER.set_json_path(trace_json)
     server = FilterServer(patterns, backend, host=host, port=port,
-                          ignore_case=ignore_case, **security)
+                          ignore_case=ignore_case, multi_set=multi_set,
+                          **security)
     bound = await server.start()
     mode = "TLS" if server.tls_cert else "plaintext"
     if server.tls_client_ca:
@@ -488,9 +725,7 @@ async def serve(patterns: list[str], backend: str, host: str, port: int,
                   "untrusted networks", flush=True)
     where = (server.host if server.host.startswith("unix:")
              else f"{server.host}:{bound}")
-    print(f"klogs filterd: serving {len(server.patterns)} pattern(s) "
-          f"[{server.backend}] on {where} ({mode})",
-          flush=True)
+    print(banner_line(server, where, mode), flush=True)
     if server.metrics_port is not None:
         print(f"klogs filterd: metrics on http://{server.metrics_host}:"
               f"{server.metrics_port}/metrics (health: /healthz, "

@@ -455,6 +455,7 @@ class ShardedFilterClient:
             return_exceptions=True)
         down: "list[str]" = []
         reachable = 0
+        to_register: "list[_Endpoint]" = []
         for ep, info in zip(self._endpoints, infos):
             if isinstance(info, Unavailable):
                 down.append(f"{ep.target}: {info}")
@@ -474,13 +475,40 @@ class ShardedFilterClient:
                 # sensibly start — propagate the first one.
                 raise info
             reachable += 1
-            check_server_config(ep.target, info, patterns, ignore_case,
-                                exclude)
+            if check_server_config(ep.target, info, patterns, ignore_case,
+                                   exclude) == "register":
+                # Multi-tenant registry endpoint: this collector's set
+                # must be registered there before the first batch.
+                to_register.append(ep)
             self._learn_readyz(ep, info)
         if not reachable:
             raise Unavailable(
                 "no filterd endpoint reachable at startup: "
                 + "; ".join(down))
+        if to_register:
+            # Concurrent like the hellos: each endpoint pays its own
+            # compile (content-addressed: usually a reuse), the fleet
+            # pays the MAX, not the sum. An endpoint that died between
+            # Hello and Register gets the same treatment as one down at
+            # Hello — excluded until the prober late-verifies it; only
+            # a non-transient failure (the collector's own set failing
+            # to compile) aborts startup.
+            results = await asyncio.gather(
+                *[ep.client.ensure_registered(patterns, ignore_case,
+                                              exclude=exclude)
+                  for ep in to_register],
+                return_exceptions=True)
+            for ep, res in zip(to_register, results):
+                if isinstance(res, Unavailable):
+                    ep.verified = False
+                    if self._m_ready is not None:
+                        self._m_ready.labels(endpoint=ep.target).set(0)
+                    term.warning(
+                        "filterd %s went away before registration "
+                        "completed (%s); continuing with the rest of "
+                        "the fleet", ep.target, res)
+                elif isinstance(res, BaseException):
+                    raise res
         self._ensure_prober()
 
     async def aclose(self) -> None:
@@ -612,8 +640,8 @@ class ShardedFilterClient:
         except (Unavailable, asyncio.TimeoutError):
             return  # still down; try again next probe cycle
         try:
-            check_server_config(ep.target, info, patterns, ignore_case,
-                                exclude)
+            status = check_server_config(ep.target, info, patterns,
+                                         ignore_case, exclude)
         except PatternMismatch as e:
             ep.quarantined = True
             if self._m_ready is not None:
@@ -623,6 +651,20 @@ class ShardedFilterClient:
                 "quarantining it for the rest of the run (%s)",
                 ep.target, e)
             return
+        if status == "register":
+            # A multi-set endpoint that restarted lost our
+            # registration: re-register before routing to it. Bounded,
+            # but with a compile-sized floor — a fresh registration IS
+            # a compile, unlike the instant Hello above; a node that
+            # cannot finish within the budget simply stays out until
+            # the next cycle (registration is idempotent server-side).
+            try:
+                await asyncio.wait_for(
+                    ep.client.ensure_registered(patterns, ignore_case,
+                                                exclude=exclude),
+                    timeout=max(self._probe_timeout_s, 10.0))
+            except (Unavailable, asyncio.TimeoutError):
+                return
         ep.verified = True
         if self._m_ready is not None:
             self._m_ready.labels(endpoint=ep.target).set(1 if ep.ready

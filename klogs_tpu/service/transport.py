@@ -36,6 +36,26 @@ SERVICE = "klogs.Filter"
 HELLO = f"/{SERVICE}/Hello"
 MATCH = f"/{SERVICE}/Match"
 MATCH_FRAMED = f"/{SERVICE}/MatchFramed"
+# Multi-tenant registry (docs/TENANCY.md): a collector registers its
+# pattern set once (content-addressed by fingerprint) and tags every
+# later Match/MatchFramed with the returned set id. Only servers whose
+# Hello advertises multi_set are ever sent a Register — a single-set
+# server keeps the strict pattern-comparison handshake and never sees
+# the method (its UNIMPLEMENTED answer would be a fatal config error,
+# by design).
+REGISTER = f"/{SERVICE}/Register"
+# Stable machine-readable prefixes on tenant-path error details. Part
+# of the wire contract — the client keys its behavior on THESE tokens,
+# never on the human-readable prose after them (which may be reworded
+# across versions) and never on the bare status code (gRPC itself
+# emits RESOURCE_EXHAUSTED for oversize messages, which is NOT a
+# quota shed).
+# FAILED_PRECONDITION: the registry does not hold the named set
+# (evicted or never registered) -> client re-registers and retries.
+SET_NOT_REGISTERED = "set-not-registered"
+# RESOURCE_EXHAUSTED: the set's lane is over its pending-line quota ->
+# client raises the degradeable ShedByServer.
+OVER_QUOTA = "tenant-over-quota"
 
 # Trace-context propagation (obs.trace): the collector's batch trace
 # crosses this boundary as one metadata entry, W3C traceparent format
@@ -70,12 +90,109 @@ def unpack(data: bytes) -> Any:
     return msgpack.unpackb(data, raw=False)
 
 
-def encode_match_request(lines: list[bytes]) -> bytes:
-    return pack({"lines": lines})
+def encode_match_request(lines: list[bytes],
+                         set_id: "str | None" = None) -> bytes:
+    doc: "dict[str, Any]" = {"lines": lines}
+    if set_id is not None:
+        doc["set"] = set_id
+    return pack(doc)
 
 
-def decode_match_request(data: bytes) -> list[bytes]:
-    return unpack(data)["lines"]
+def decode_match_request(data: bytes) -> "tuple[list[bytes], str | None]":
+    doc = unpack(data)
+    return doc["lines"], _set_id(doc)
+
+
+def _set_id(doc: "dict[str, Any]") -> "str | None":
+    """Optional tenant set id on a match request. Validated here: a
+    non-string set would otherwise surface as an obscure KeyError deep
+    in the registry."""
+    set_id = doc.get("set")
+    if set_id is not None and not isinstance(set_id, str):
+        raise ValueError(
+            f"match request: set id must be a string, got "
+            f"{type(set_id).__name__}")
+    return set_id
+
+
+# -- registration (multi-tenant servers) ------------------------------
+
+def encode_register_request(patterns: "list[str]",
+                            exclude: "list[str] | None" = None,
+                            ignore_case: bool = False,
+                            weight: float = 1.0) -> bytes:
+    return pack({"patterns": list(patterns),
+                 "exclude": list(exclude or []),
+                 "ignore_case": bool(ignore_case),
+                 "weight": float(weight)})
+
+
+def decode_register_request(data: bytes) -> "dict[str, Any]":
+    doc = unpack(data)
+    patterns = doc.get("patterns")
+    exclude = doc.get("exclude", [])
+    if not isinstance(patterns, list) or not all(
+            isinstance(p, str) for p in patterns):
+        raise ValueError("register request: patterns must be a list of "
+                         "strings")
+    if not isinstance(exclude, list) or not all(
+            isinstance(p, str) for p in exclude):
+        raise ValueError("register request: exclude must be a list of "
+                         "strings")
+    if not patterns and not exclude:
+        raise ValueError("register request: need at least one pattern")
+    weight = doc.get("weight", 1.0)
+    if not isinstance(weight, (int, float)) or not (0 < float(weight)
+                                                    <= 1024):
+        raise ValueError(
+            f"register request: weight must be in (0, 1024], got "
+            f"{weight!r}")
+    return {"patterns": patterns, "exclude": exclude,
+            "ignore_case": bool(doc.get("ignore_case", False)),
+            "weight": float(weight)}
+
+
+def encode_register_response(set_id: str, shared: bool,
+                             sets: int) -> bytes:
+    return pack({"set": set_id, "shared": shared, "sets": sets})
+
+
+def decode_register_response(data: bytes) -> "dict[str, Any]":
+    doc = unpack(data)
+    if not isinstance(doc.get("set"), str):
+        raise ValueError("register response: missing set id")
+    return doc
+
+
+def encode_hello_request(patterns: "list[str] | None" = None,
+                         exclude: "list[str] | None" = None,
+                         ignore_case: bool = False) -> bytes:
+    """Hello with the collector's invocation attached: a multi-set
+    server answers verify_patterns against its REGISTRY (matching the
+    request's fingerprint) instead of one fixed startup list. An empty
+    body keeps the legacy handshake; old servers ignore any body."""
+    if patterns is None and not exclude:
+        return b""
+    return pack({"patterns": list(patterns or []),
+                 "exclude": list(exclude or []),
+                 "ignore_case": bool(ignore_case)})
+
+
+def decode_hello_request(data: bytes) -> "dict[str, Any] | None":
+    """-> the collector's invocation, or None for the legacy empty
+    Hello. Malformed bodies are treated as legacy (old clients may
+    send arbitrary ignored payloads; the handshake must not break)."""
+    if not data:
+        return None
+    try:
+        doc = unpack(data)
+    except Exception:
+        return None
+    if not isinstance(doc, dict) or "patterns" not in doc:
+        return None
+    return {"patterns": [str(p) for p in doc.get("patterns") or []],
+            "exclude": [str(p) for p in doc.get("exclude") or []],
+            "ignore_case": bool(doc.get("ignore_case", False))}
 
 
 def encode_match_response(mask: list[bool]) -> bytes:
@@ -97,16 +214,22 @@ def decode_match_response(data: bytes) -> list[bool]:
 # {"framed": True}; clients fall back to Match against older servers.
 
 def encode_framed_request(payload: bytes,
-                          offsets: "numpy.ndarray") -> bytes:
+                          offsets: "numpy.ndarray",
+                          set_id: "str | None" = None) -> bytes:
     import numpy as np
 
     offs = np.ascontiguousarray(offsets, dtype=np.int32)
-    return pack({"n": len(offs) - 1, "offs": offs.tobytes(),
-                 "data": payload})
+    doc: "dict[str, Any]" = {"n": len(offs) - 1, "offs": offs.tobytes(),
+                             "data": payload}
+    if set_id is not None:
+        doc["set"] = set_id
+    return pack(doc)
 
 
-def decode_framed_request(data: bytes) -> "tuple[bytes, numpy.ndarray]":
-    """-> (payload: bytes, offsets: int32 np.ndarray[n+1]).
+def decode_framed_request(
+        data: bytes) -> "tuple[bytes, numpy.ndarray, str | None]":
+    """-> (payload: bytes, offsets: int32 np.ndarray[n+1],
+    set_id: str | None — the tenant set lane on multi-set servers).
 
     Validates the offsets array fully: the server feeds it into a
     coalescer SHARED across all connected collectors, so one client's
@@ -140,7 +263,7 @@ def decode_framed_request(data: bytes) -> "tuple[bytes, numpy.ndarray]":
             or bool((np.diff(offsets) < 0).any())):
         raise ValueError("framed request: offsets must rise from 0 to "
                          "len(payload) monotonically")
-    return payload, offsets
+    return payload, offsets, _set_id(doc)
 
 
 def encode_framed_response(mask: "numpy.ndarray") -> bytes:

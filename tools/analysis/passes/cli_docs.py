@@ -2,11 +2,13 @@
 exists (same shape as the metrics-docs check).
 
 Code side: the long option strings passed to ``add_argument`` in
-``klogs_tpu/cli.py`` (positional string args starting with ``--``;
-help text is ignored, so prose like "combine with --match" inside a
-help string never counts as a flag definition). Docs side: every
-``--flag`` token anywhere in docs/CLI.md — including prose, so a stale
-flag *mention* is flagged too, not just a stale table row.
+``klogs_tpu/cli.py`` AND the filterd daemon's
+``klogs_tpu/service/__main__.py`` (positional string args starting
+with ``--``; help text is ignored, so prose like "combine with
+--match" inside a help string never counts as a flag definition).
+Docs side: every ``--flag`` token anywhere in docs/CLI.md — including
+prose, so a stale flag *mention* is flagged too, not just a stale
+table row.
 """
 
 import ast
@@ -15,6 +17,7 @@ import re
 from tools.analysis.core import Finding, Pass, Project
 
 CLI_PATH = "klogs_tpu/cli.py"
+DAEMON_PATH = "klogs_tpu/service/__main__.py"
 DOC_PATH = "docs/CLI.md"
 
 _DOC_FLAG = re.compile(r"(?<![\w-])--[a-z][a-z0-9-]*")
@@ -48,6 +51,11 @@ class CliDocsPass(Pass):
         if sf is None or doc is None:
             return []  # fixture tree without one side
         in_code = cli_flags(sf.tree)
+        # The filterd daemon's flags count too (they live in the same
+        # CLI.md): a fleet operator reads ONE doc for both binaries.
+        daemon = project.file(DAEMON_PATH)
+        if daemon is not None:
+            in_code |= cli_flags(daemon.tree)
         in_docs = doc_flags(doc)
         findings = []
         for flag in sorted(in_code - in_docs):

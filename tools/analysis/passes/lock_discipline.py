@@ -63,6 +63,13 @@ SHARED_STATE: dict = {
         # from executor threads) may ever mutate them.
         "FanoutRunner": _decl("loop", None, "_streams", "_stopping"),
     },
+    "klogs_tpu/service/tenancy.py": {
+        # The registry maps are mutated by async Register/evict
+        # handlers on the loop but READ from sync banner/Hello paths
+        # and adopted from __init__ — every mutation goes under _mut so
+        # a registration racing an eviction can never tear the map.
+        "PatternSetRegistry": _decl("lock", "_mut", "_sets", "_building"),
+    },
 }
 
 _MUTATORS = {
