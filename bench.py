@@ -43,6 +43,8 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from klogs_tpu.cluster.fake import synthetic_line  # noqa: E402
 from klogs_tpu.filters.cpu import RegexFilter  # noqa: E402
+from klogs_tpu.utils.env import is_set as env_is_set  # noqa: E402
+from klogs_tpu.utils.env import read as env_read  # noqa: E402
 
 # 32 patterns, per the north-star config. Deliberately needle-finding:
 # a log filter's purpose is selecting RARE lines, so most patterns match
@@ -212,10 +214,10 @@ def bench_k_axis(ks=None, n_lines: "int | None" = None,
     from klogs_tpu.filters.indexed import IndexedFilter
 
     if ks is None:
-        env = os.environ.get("KLOGS_BENCH_K", "")
+        env = env_read("KLOGS_BENCH_K", "")
         ks = tuple(int(x) for x in env.split(",") if x) or BENCH_K_DEFAULT
-    n_lines = n_lines or int(os.environ.get("KLOGS_BENCH_K_LINES", "100000"))
-    repeats = repeats or int(os.environ.get("KLOGS_BENCH_REPEATS", "3"))
+    n_lines = n_lines or int(env_read("KLOGS_BENCH_K_LINES", "100000"))
+    repeats = repeats or int(env_read("KLOGS_BENCH_REPEATS", "3"))
     lines = [ln.rstrip(b"\n") for ln in make_lines(n_lines)]
     payload, offsets, _ = frame_lines(lines)
     offsets = np.asarray(offsets, dtype=np.int32)
@@ -270,8 +272,8 @@ def bench_k_axis(ks=None, n_lines: "int | None" = None,
         from klogs_tpu.filters.cpu import INDEX_MIN_K
 
         auto_is_indexed = (
-            os.environ.get("KLOGS_CPU_ENGINE", "auto") == "auto"
-            and "KLOGS_INDEX_MIN_K" not in os.environ
+            env_read("KLOGS_CPU_ENGINE", "auto") == "auto"
+            and not env_is_set("KLOGS_INDEX_MIN_K")
             and k >= INDEX_MIN_K)
         if auto_is_indexed:
             auto_kind, auto_lps = "indexed", idx_lps
@@ -389,7 +391,7 @@ def device_lps(lines, repeats: int):
         # Measured hardware default (mask_block=4) unless the env picks
         # a variant; the tune sweep below overwrites when enabled.
         kw = kernel_kwargs(on_hardware=True)
-        if os.environ.get("KLOGS_BENCH_TUNE") == "1":
+        if env_read("KLOGS_BENCH_TUNE") == "1":
             from klogs_tpu.ops.tune import tune_grouped
 
             best = tune_grouped(dp, live, acc, None, None, cls=dcls,
@@ -400,7 +402,7 @@ def device_lps(lines, repeats: int):
         # 2026-07-29 device A/B (BENCH_DEVICE.json): with classification
         # moved to the host, the NFA kernel is no longer the bottleneck
         # and the mask cannot pay for itself.
-        if os.environ.get("KLOGS_TPU_PREFILTER", "0") == "1":
+        if env_read("KLOGS_TPU_PREFILTER", "0") == "1":
             from klogs_tpu.filters.compiler.prefilter import compile_prefilter
             from klogs_tpu.ops.prefilter import class_tables
 
@@ -430,7 +432,7 @@ def device_lps(lines, repeats: int):
     # A CPU-only host runs the single-core jnp scan path: a deep pipeline
     # just multiplies wall time without amortizing anything (no async
     # device, no tunnel), so keep it shallow there.
-    n_flight = int(os.environ.get("KLOGS_BENCH_N_FLIGHT",
+    n_flight = int(env_read("KLOGS_BENCH_N_FLIGHT",
                                   "2" if not use_kernel else "64"))
     pipelined = measure_pipelined(run, n_rows, n_flight, repeats)
 
@@ -485,8 +487,8 @@ def _device_subprocess(timeout_s: float):
     import selectors
     import tempfile
 
-    attach_s = float(os.environ.get("KLOGS_BENCH_DEVICE_ATTACH_S", "120"))
-    retry_pause_s = float(os.environ.get("KLOGS_BENCH_DEVICE_RETRY_PAUSE_S", "45"))
+    attach_s = float(env_read("KLOGS_BENCH_DEVICE_ATTACH_S", "120"))
+    retry_pause_s = float(env_read("KLOGS_BENCH_DEVICE_RETRY_PAUSE_S", "45"))
     deadline = time.monotonic() + timeout_s
     attempt = 0
     while attempt == 0 or deadline - time.monotonic() > 5:
@@ -556,7 +558,7 @@ def main() -> None:
     if "--k-axis" in sys.argv[1:]:
         sweep_rows: list = []
         payload = bench_k_axis(sweep_rows=sweep_rows)
-        out_path = os.environ.get("KLOGS_BENCH_K_OUT") or os.path.join(
+        out_path = env_read("KLOGS_BENCH_K_OUT") or os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "BENCH_K.json")
         with open(out_path, "w") as f:
             json.dump(payload, f, indent=1)
@@ -569,7 +571,7 @@ def main() -> None:
             "corpus": payload["corpus"],
             "rows": sweep_rows,
         }
-        sweep_out = os.environ.get("KLOGS_BENCH_SWEEP_OUT") or \
+        sweep_out = env_read("KLOGS_BENCH_SWEEP_OUT") or \
             os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "BENCH_SWEEP.json")
         with open(sweep_out, "w") as f:
@@ -577,10 +579,10 @@ def main() -> None:
             f.write("\n")
         print(json.dumps(payload))
         return
-    n_lines = int(os.environ.get("KLOGS_BENCH_LINES", "300000"))
-    n_cpu = int(os.environ.get("KLOGS_BENCH_CPU_LINES", "30000"))
-    repeats = int(os.environ.get("KLOGS_BENCH_REPEATS", "3"))
-    timeout_s = float(os.environ.get("KLOGS_BENCH_DEVICE_TIMEOUT_S", "900"))
+    n_lines = int(env_read("KLOGS_BENCH_LINES", "300000"))
+    n_cpu = int(env_read("KLOGS_BENCH_CPU_LINES", "30000"))
+    repeats = int(env_read("KLOGS_BENCH_REPEATS", "3"))
+    timeout_s = float(env_read("KLOGS_BENCH_DEVICE_TIMEOUT_S", "900"))
 
     lines = make_lines(n_lines)
     cpu = cpu_lps(lines[:n_cpu], repeats)

@@ -18,6 +18,7 @@ from klogs_tpu.cluster.backend import ClusterBackend
 from klogs_tpu.cluster.types import LogOptions, PodInfo
 from klogs_tpu.runtime.fanout import FanoutRunner, StreamJob, plan_jobs
 from klogs_tpu.ui import interactive, term, widgets
+from klogs_tpu.utils.env import read as env_read
 from klogs_tpu.utils import convert_bytes, parse_duration, split_log_file_name
 from klogs_tpu.utils.duration import DurationError
 
@@ -26,9 +27,9 @@ def make_backend(opts: Options) -> ClusterBackend:
     if opts.cluster == "fake":
         from klogs_tpu.cluster.fake import FakeCluster
 
-        n_pods = int(os.environ.get("KLOGS_FAKE_PODS", "6"))
-        n_containers = int(os.environ.get("KLOGS_FAKE_CONTAINERS", "2"))
-        n_lines = int(os.environ.get("KLOGS_FAKE_LINES", "300"))
+        n_pods = int(env_read("KLOGS_FAKE_PODS", "6"))
+        n_containers = int(env_read("KLOGS_FAKE_CONTAINERS", "2"))
+        n_lines = int(env_read("KLOGS_FAKE_LINES", "300"))
         fc = FakeCluster.synthetic(
             n_pods=n_pods, n_containers=n_containers, lines_per_container=n_lines
         )
@@ -361,7 +362,7 @@ async def _run_async_inner(
     # mystery retries in production.
     from klogs_tpu.resilience import FAULTS, FaultSpecError
 
-    fault_spec = os.environ.get("KLOGS_FAULTS")
+    fault_spec = env_read("KLOGS_FAULTS")
     if fault_spec:
         try:
             FAULTS.load_spec(fault_spec)
@@ -590,7 +591,7 @@ async def _run_async_inner(
                 try:
                     interval = 5.0
                     if plan_new is not None:  # knob is irrelevant otherwise
-                        raw = os.environ.get("KLOGS_WATCH_INTERVAL_S", "5")
+                        raw = env_read("KLOGS_WATCH_INTERVAL_S", "5")
                         try:
                             # Floor of 0.2s: a zero/negative value would
                             # busy-poll the apiserver all session.

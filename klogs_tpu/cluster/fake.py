@@ -98,10 +98,17 @@ class FakeLogStream(LogStream):
         self._opts = opts
         self._clock = clock
         self._chunk_size = chunk_size
-        self._closed = asyncio.Event()
+        # Lazy: on Py3.10 asyncio primitives bind the loop alive at
+        # construction, and streams may be built before the run loop.
+        self._closed: "asyncio.Event | None" = None
+
+    def _closed_ev(self) -> asyncio.Event:
+        if self._closed is None:
+            self._closed = asyncio.Event()
+        return self._closed
 
     async def close(self) -> None:
-        self._closed.set()
+        self._closed_ev().set()
 
     def _since_time_cutoff(self) -> float | None:
         """PodLogOptions.SinceTime as an epoch cutoff (RFC3339 input;
@@ -165,7 +172,7 @@ class FakeLogStream(LogStream):
             emitted += 1
             async for chunk in flush_full():
                 yield chunk
-                if self._closed.is_set():
+                if self._closed_ev().is_set():
                     return
 
         if buf:
@@ -176,10 +183,10 @@ class FakeLogStream(LogStream):
             return  # a terminated prior instance cannot produce new lines
 
         # Follow mode: generate lines until the stream is closed.
-        while not self._closed.is_set():
+        while not self._closed_ev().is_set():
             try:
                 await asyncio.wait_for(
-                    self._closed.wait(), timeout=self._c.follow_interval_s
+                    self._closed_ev().wait(), timeout=self._c.follow_interval_s
                 )
                 return
             except asyncio.TimeoutError:

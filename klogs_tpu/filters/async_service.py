@@ -30,7 +30,6 @@ dispatch has ms-scale fixed cost.
 """
 
 import asyncio
-import os
 import time
 from concurrent.futures import ThreadPoolExecutor
 
@@ -40,24 +39,10 @@ from klogs_tpu.obs import trace
 # Each in-flight fetch blocks one worker thread for a full host<->device
 # round trip, so sustained batches/s caps at workers / RTT. On a remote
 # attach (~74ms RTT) that cap binds well before the engine does; both
-# knobs are env-tunable for such deployments.
-def _env_int(name: str, default: int) -> int:
-    """Positive-int env knob; malformed values warn and fall back
-    rather than crashing module import with a bare ValueError."""
-    raw = os.environ.get(name)
-    if raw is None:
-        return default
-    try:
-        val = int(raw)
-    except ValueError:
-        val = 0
-    if val < 1:
-        import sys
-
-        print(f"klogs: ignoring invalid {name}={raw!r} (want a positive "
-              f"integer); using {default}", file=sys.stderr)
-        return default
-    return val
+# knobs are env-tunable for such deployments. Malformed values warn and
+# fall back rather than crashing module import (the shared
+# warn-and-default dialect in klogs_tpu.utils.env).
+from klogs_tpu.utils.env import warn_positive_int as _env_int
 
 
 DEFAULT_MAX_IN_FLIGHT = _env_int("KLOGS_MAX_IN_FLIGHT", 16)
@@ -107,8 +92,11 @@ class AsyncFilterService:
         # shared fetch pool + ONE in-flight semaphore across every
         # set's service: the process owns one device, so the budget is
         # global. A service only shuts down a pool it created itself.
-        self._sem = (in_flight if in_flight is not None
-                     else asyncio.Semaphore(max_in_flight))
+        # An owned semaphore is created lazily at first dispatch: on
+        # Py3.10 it binds the loop alive at CONSTRUCTION, and services
+        # are built by make_pipeline before asyncio.run() starts.
+        self._sem: "asyncio.Semaphore | None" = in_flight
+        self._max_in_flight = max_in_flight
         self._own_pool = executor is None
         self._pool = executor if executor is not None else ThreadPoolExecutor(
             max_workers=fetch_workers, thread_name_prefix="klogs-fetch"
@@ -253,6 +241,8 @@ class AsyncFilterService:
                                  span_id=f"{ctx.span_id:016x}")
             try:
                 t_sem = time.perf_counter()
+                if self._sem is None:
+                    self._sem = asyncio.Semaphore(self._max_in_flight)
                 async with self._sem:
                     t_dispatch = time.perf_counter()
                     if self._stats is not None:

@@ -186,11 +186,12 @@ DEFAULT_CACHE_MB = 512
 
 def _cache_cap_bytes() -> int:
     import math
-    import os
+
+    from klogs_tpu.utils.env import read as env_read
 
     try:
-        mb = float(os.environ.get("KLOGS_DFA_CACHE_MB",
-                                  str(DEFAULT_CACHE_MB)))
+        mb = float(env_read("KLOGS_DFA_CACHE_MB",
+                            str(DEFAULT_CACHE_MB)))
     except ValueError:
         return DEFAULT_CACHE_MB * 1048576
     if not math.isfinite(mb) or mb <= 0:

@@ -123,9 +123,9 @@ GROUP_REF_TOKENS = (r"\\[1-9]", r"\(\?P=", r"\(\?\(")
 def max_positions_cap() -> int:
     """Effective position cap (env override or MAX_POSITIONS). Read
     once per parse/build — not per leaf — by the callers."""
-    import os
+    from klogs_tpu.utils.env import read as env_read
 
-    s = os.environ.get("KLOGS_MAX_PATTERN_POSITIONS")
+    s = env_read("KLOGS_MAX_PATTERN_POSITIONS")
     if s is None:
         return MAX_POSITIONS
     try:

@@ -152,10 +152,10 @@ def index_min_k() -> int:
     default INDEX_MIN_K). One reading shared by best_host_filter's
     indexed-engine choice and the TPU engine's device-sweep auto rule,
     so the host and device paths flip to index mode at the same K."""
-    import os
+    from klogs_tpu.utils.env import read as env_read
 
     try:
-        return int(os.environ.get("KLOGS_INDEX_MIN_K", str(INDEX_MIN_K)))
+        return int(env_read("KLOGS_INDEX_MIN_K", str(INDEX_MIN_K)))
     except ValueError:
         return INDEX_MIN_K
 
@@ -166,9 +166,9 @@ def device_sweep_env() -> str:
     an unexplained ~10x at thousand-pattern K. One reading shared by
     the single-chip engine and the mesh so the contract cannot
     diverge."""
-    import os
+    from klogs_tpu.utils.env import read as env_read
 
-    env = os.environ.get("KLOGS_TPU_SWEEP", "auto")
+    env = env_read("KLOGS_TPU_SWEEP", "auto")
     if env not in ("auto", "0", "1"):
         raise ValueError(
             f"KLOGS_TPU_SWEEP={env!r}: expected auto, 0 or 1")
@@ -250,9 +250,9 @@ def best_host_filter(patterns: list[str], ignore_case: bool = False,
     KLOGS_CPU_ENGINE={auto,indexed,dfa,combined,re} forces a specific
     engine (re = the reference-parity K-sequential baseline);
     KLOGS_INDEX_MIN_K moves the auto-mode indexed threshold."""
-    import os
+    from klogs_tpu.utils.env import read as env_read
 
-    choice = os.environ.get("KLOGS_CPU_ENGINE", "auto")
+    choice = env_read("KLOGS_CPU_ENGINE", "auto")
     if choice == "re":
         return RegexFilter(patterns, ignore_case=ignore_case), "re"
     if choice == "combined":

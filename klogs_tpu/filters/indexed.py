@@ -20,7 +20,6 @@ index's win honestly (same tables, same engines, only the narrowing
 differs).
 """
 
-import os
 import time
 from typing import Any
 
@@ -398,17 +397,11 @@ class IndexedFilter(LogFilter):
 
 def _env_float(name: str, default: float) -> float:
     """Env override parsed strictly: a malformed value raises (silent
-    misconfiguration of a degrade knob hides real regressions)."""
-    raw = os.environ.get(name)
-    if raw is None:
-        return default
-    try:
-        v = float(raw)
-    except ValueError:
-        raise ValueError(f"{name}={raw!r}: expected a number") from None
-    if not np.isfinite(v) or v < 0:
-        raise ValueError(f"{name}={raw!r}: expected a finite value >= 0")
-    return v
+    misconfiguration of a degrade knob hides real regressions). The
+    shared strict dialect from klogs_tpu.utils.env."""
+    from klogs_tpu.utils.env import nonneg_float
+
+    return nonneg_float(name, default)
 
 
 def _gather_frame(arr: np.ndarray, offsets: np.ndarray, lens: np.ndarray,

@@ -74,8 +74,11 @@ class FilteredSink(Sink):
         self._pending: list[bytes] = []
         # Held across match+write so concurrent flushes (write vs the
         # deadline flusher) cannot reorder this file's lines while a
-        # batch is in flight on the async service.
-        self._flush_lock = asyncio.Lock()
+        # batch is in flight on the async service. Created lazily on
+        # first flush: on Py3.10 an asyncio primitive binds the loop
+        # that exists at CONSTRUCTION, and sinks are built by
+        # make_pipeline before asyncio.run() starts the real one.
+        self._flush_lock: "asyncio.Lock | None" = None
 
     def _pending_count(self) -> int:
         if self._batcher is not None:
@@ -107,6 +110,8 @@ class FilteredSink(Sink):
         # is active (deadline flusher, close), otherwise a child of the
         # chunk's fanout.read span — either way the root of everything
         # downstream (coalescer/shard/RPC/device/write).
+        if self._flush_lock is None:
+            self._flush_lock = asyncio.Lock()
         with trace.TRACER.span("sink.flush",
                                pending=self._pending_count()):
             async with self._flush_lock:
@@ -459,28 +464,14 @@ def _build_filter(patterns: list[str], backend: str, stats,
 
 
 def _env_positive_float(name: str, default: float) -> float:
-    """Env-tunable positive float; zero/negative/garbage is rejected
-    naming the variable (a bad knob must not surface as a mystery
-    timeout/latency downstream)."""
-    import math
-    import os
+    """Env-tunable positive float; zero/negative/nan/inf/garbage is
+    rejected as ServiceConfigError naming the variable (a bad knob must
+    not surface as a mystery timeout/latency downstream). The
+    validation itself is the shared one in klogs_tpu.utils.env."""
+    from klogs_tpu.service.client import ServiceConfigError
+    from klogs_tpu.utils.env import positive_float
 
-    raw = os.environ.get(name)
-    if raw is None:
-        return default
-    try:
-        value = float(raw)
-        # nan compares False against everything (it would flow into a
-        # timeout unchecked) and inf is no deadline at all — both are
-        # garbage for a knob documented as a positive number of seconds.
-        if not math.isfinite(value) or value <= 0:
-            raise ValueError("must be positive and finite")
-    except ValueError as e:
-        from klogs_tpu.service.client import ServiceConfigError
-
-        raise ServiceConfigError(
-            f"{name} must be a positive number, got {raw!r}") from e
-    return value
+    return positive_float(name, default, exc=ServiceConfigError)
 
 
 def make_pipeline(patterns: list[str], backend: str,
@@ -499,8 +490,6 @@ def make_pipeline(patterns: list[str], backend: str,
     service = None
     exclude = exclude or []
     if remote is not None:
-        import os
-
         from klogs_tpu.service.client import RemoteFilterClient
         from klogs_tpu.service.shard import (
             DEFAULT_HEDGE_S,
@@ -536,11 +525,13 @@ def make_pipeline(patterns: list[str], backend: str,
                 "KLOGS_FAULTS targets %s not in the --remote list %s — "
                 "those clauses will never fire",
                 ", ".join(sorted(stray)), ",".join(targets))
+        from klogs_tpu.utils.env import read as env_read
+
         common = dict(
-            tls_ca=os.environ.get("KLOGS_REMOTE_TLS_CA"),
-            tls_cert=os.environ.get("KLOGS_REMOTE_TLS_CERT"),
-            tls_key=os.environ.get("KLOGS_REMOTE_TLS_KEY"),
-            auth_token_file=os.environ.get("KLOGS_REMOTE_TOKEN_FILE"),
+            tls_ca=env_read("KLOGS_REMOTE_TLS_CA"),
+            tls_cert=env_read("KLOGS_REMOTE_TLS_CERT"),
+            tls_key=env_read("KLOGS_REMOTE_TLS_KEY"),
+            auth_token_file=env_read("KLOGS_REMOTE_TOKEN_FILE"),
             rpc_timeout_s=rpc_timeout_s,
             registry=registry)
         if len(targets) == 1:

@@ -17,13 +17,13 @@ Trailing-newline handling matches RegexFilter: trailing "\\n" bytes are
 stripped before matching, so ``$`` sees the logical end of line.
 """
 
-import os
 import threading
 
 import numpy as np
 
 from klogs_tpu.filters.base import LogFilter
 from klogs_tpu.filters.compiler.glushkov import compile_patterns
+from klogs_tpu.utils.env import read as env_read
 
 # Smallest pad width; also the bucket floor. 128 matches the TPU lane.
 MIN_BUCKET = 128
@@ -187,7 +187,7 @@ class NFAEngineFilter(LogFilter):
         # the jnp/lax.scan path elsewhere (identical semantics; the
         # kernel's Mosaic lowering needs TPU hardware). "interpret"
         # exercises the kernel code hermetically (tests).
-        kernel = kernel or os.environ.get("KLOGS_TPU_KERNEL", "auto")
+        kernel = kernel or env_read("KLOGS_TPU_KERNEL", "auto")
         if kernel == "auto":
             kernel = "pallas" if jax.default_backend() not in ("cpu",) else "jnp"
         self._kernel = kernel
@@ -240,7 +240,7 @@ class NFAEngineFilter(LogFilter):
             # loss (413k gated vs 641k plain). KLOGS_TPU_PREFILTER=1
             # opts in; requires every pattern to yield clauses.
             self._pf_tables = None
-            if os.environ.get("KLOGS_TPU_PREFILTER", "0") == "1":
+            if env_read("KLOGS_TPU_PREFILTER", "0") == "1":
                 from klogs_tpu.filters.compiler.prefilter import compile_prefilter
                 from klogs_tpu.ops.prefilter import class_tables, device_tables
 

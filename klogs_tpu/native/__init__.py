@@ -109,9 +109,18 @@ def _ensure_so() -> "str | None":
 
 def _load():
     global hostops
-    if os.environ.get("KLOGS_NO_NATIVE"):
+    from klogs_tpu.utils.env import read as _env_read
+
+    if _env_read("KLOGS_NO_NATIVE"):
         return
-    so = _ensure_so()
+    # KLOGS_NATIVE_SO pins the exact extension binary to load — the
+    # sanitizer harness (tools/build_native_asan.py, docs/NATIVE.md)
+    # uses it to run the parity tests against an ASan/UBSan build. A
+    # pinned path that fails to load raises instead of silently
+    # falling back: a sanitizer run that quietly tested the pure-
+    # Python path would green-light memory bugs.
+    forced = _env_read("KLOGS_NATIVE_SO")
+    so = forced if forced else _ensure_so()
     if so is None:
         return
     try:
@@ -122,8 +131,12 @@ def _load():
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
         hostops = mod
-    except Exception:
+    except Exception as e:
         hostops = None
+        if forced:
+            raise RuntimeError(
+                f"KLOGS_NATIVE_SO={forced!r} could not be loaded: {e}"
+            ) from e
 
 
 _load()

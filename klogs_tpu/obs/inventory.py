@@ -34,12 +34,23 @@ RATIO_BUCKETS = (0.001, 0.01, 0.05, 0.1, 0.25, 0.5, 0.75, 1.0)
 
 
 def _m(mtype: str, help: str, labels: tuple = (),
-       buckets: "tuple | None" = None) -> dict:
+       buckets: "tuple | None" = None,
+       bounds: "dict | None" = None) -> dict:
+    """``bounds`` declares, per label, how its value domain is bounded
+    (the metric-cardinality pass enforces one entry per label):
+    ``enum`` — values are code-chosen literals; ``config`` — values
+    come from deployment shape (flags, fleet membership, pod set);
+    ``evictable:<KLOGS_KNOB>`` — values derive from runtime input,
+    live-series count capped by the knob, and the family must have a
+    remove path for evicted entities. docs/OBSERVABILITY.md "Label
+    cardinality rules" documents every non-enum label."""
     spec = {"type": mtype, "help": help}
     if labels:
         spec["labels"] = tuple(labels)
     if buckets is not None:
         spec["buckets"] = tuple(buckets)
+    if bounds is not None:
+        spec["bounds"] = dict(bounds)
     return spec
 
 
@@ -47,7 +58,7 @@ SPECS: dict[str, dict] = {
     # -- process ------------------------------------------------------
     "klogs_build_info": _m(
         "gauge", "Constant 1, labeled with the build version.",
-        labels=("version",)),
+        labels=("version",), bounds={"version": "config"}),
 
     # -- sink layer (FilteredSink / FilterStats view) -----------------
     "klogs_sink_lines_total": _m(
@@ -144,23 +155,25 @@ SPECS: dict[str, dict] = {
         "counter", "On-disk DFA table cache outcomes during index "
         "compiles: hit (table loaded), miss (determinized fresh), "
         "evict (LRU removal past KLOGS_DFA_CACHE_MB).",
-        labels=("event",)),
+        labels=("event",), bounds={"event": "enum"}),
 
     # -- literal sweep (device/host narrowing stage) ------------------
     "klogs_sweep_batches_total": _m(
         "counter", "Batches narrowed by the literal sweep, by which "
         "stage ran: device (fused on-device sweep, ops/sweep.py) or "
-        "host (host factor sweep).", labels=("path",)),
+        "host (host factor sweep).", labels=("path",),
+        bounds={"path": "enum"}),
     "klogs_sweep_lines_total": _m(
         "counter", "Lines swept by the literal sweep, by stage.",
-        labels=("path",)),
+        labels=("path",), bounds={"path": "enum"}),
     "klogs_sweep_candidate_lines_total": _m(
         "counter", "Lines the sweep could NOT rule out (at least one "
         "candidate group), by stage. candidate/swept is the live "
-        "narrowing ratio.", labels=("path",)),
+        "narrowing ratio.", labels=("path",), bounds={"path": "enum"}),
     "klogs_sweep_seconds": _m(
         "histogram", "Sweep-stage latency per batch, by stage.",
-        labels=("path",), buckets=LATENCY_BUCKETS),
+        labels=("path",), buckets=LATENCY_BUCKETS,
+        bounds={"path": "enum"}),
     "klogs_sweep_fallback_total": _m(
         "counter", "Device-sweep degrades: build or kernel failures "
         "that dropped a batch (and every later one) to the fallback "
@@ -175,10 +188,12 @@ SPECS: dict[str, dict] = {
         "gauge", "Log streams currently open."),
     "klogs_fanout_stream_bytes_total": _m(
         "counter", "Bytes received per container stream.",
-        labels=("pod", "container")),
+        labels=("pod", "container"),
+        bounds={"pod": "config", "container": "config"}),
     "klogs_fanout_reconnects_total": _m(
         "counter", "Follow-mode stream reconnect attempts.",
-        labels=("pod", "container")),
+        labels=("pod", "container"),
+        bounds={"pod": "config", "container": "config"}),
     "klogs_fanout_stream_errors_total": _m(
         "counter", "Streams that ended with a terminal error."),
     "klogs_fanout_backpressure_stalls_total": _m(
@@ -190,21 +205,22 @@ SPECS: dict[str, dict] = {
         "counter", "Retries performed by the shared resilience policy, "
         "by call site (kube, fanout, rpc@endpoint — RPC sites carry "
         "the endpoint so a sharded fleet's servers stay "
-        "distinguishable).", labels=("site",)),
+        "distinguishable).", labels=("site",), bounds={"site": "config"}),
     "klogs_breaker_state": _m(
         "gauge", "Circuit-breaker state: 0=closed, 1=open, 2=half-open.",
-        labels=("breaker",)),
+        labels=("breaker",), bounds={"breaker": "config"}),
     "klogs_faults_injected_total": _m(
         "counter", "Chaos faults fired, by registered fault point "
-        "(test API or KLOGS_FAULTS).", labels=("point",)),
+        "(test API or KLOGS_FAULTS).", labels=("point",),
+        bounds={"point": "config"}),
     "klogs_filter_degraded_batches_total": _m(
         "counter", "Sink flushes degraded because the filter service "
         "was unavailable, by --on-filter-error action.",
-        labels=("action",)),
+        labels=("action",), bounds={"action": "enum"}),
     "klogs_filter_degraded_lines_total": _m(
         "counter", "Lines written unfiltered (action=pass) or dropped "
         "(action=drop) while the filter service was unavailable.",
-        labels=("action",)),
+        labels=("action",), bounds={"action": "enum"}),
 
     # -- shard tier (ShardedFilterClient over N filterds) -------------
     # Endpoint labels are the --remote fleet: deployment shape (a
@@ -212,19 +228,22 @@ SPECS: dict[str, dict] = {
     "klogs_shard_batches_total": _m(
         "counter", "Batches resolved by each filterd endpoint (the "
         "winning attempt only — hedge losers are cancelled, never "
-        "counted).", labels=("endpoint",)),
+        "counted).", labels=("endpoint",),
+        bounds={"endpoint": "config"}),
     "klogs_shard_hedges_total": _m(
         "counter", "Hedged duplicate dispatches launched against a "
         "sibling after the primary exceeded the hedge deadline, by "
-        "sibling endpoint.", labels=("endpoint",)),
+        "sibling endpoint.", labels=("endpoint",),
+        bounds={"endpoint": "config"}),
     "klogs_shard_reroutes_total": _m(
         "counter", "Batches routed away from an endpoint: skipped as "
         "primary (breaker open / not ready) or failed over after a "
-        "terminal attempt error.", labels=("endpoint", "reason")),
+        "terminal attempt error.", labels=("endpoint", "reason"),
+        bounds={"endpoint": "config", "reason": "enum"}),
     "klogs_shard_endpoint_ready": _m(
         "gauge", "Endpoint readiness as last observed by the /readyz "
         "prober (1 ready, 0 draining or unreachable).",
-        labels=("endpoint",)),
+        labels=("endpoint",), bounds={"endpoint": "config"}),
 
     # -- tenancy (multi-set registry, service/tenancy.py) -------------
     # The `set` label is a pattern-set fingerprint: bounded by the
@@ -237,7 +256,7 @@ SPECS: dict[str, dict] = {
     "klogs_tenant_registrations_total": _m(
         "counter", "Register RPC outcomes: new (engine built) or "
         "shared (content-addressed reuse of a live engine).",
-        labels=("outcome",)),
+        labels=("outcome",), bounds={"outcome": "enum"}),
     "klogs_tenant_engine_builds_total": _m(
         "counter", "Engines compiled by the registry. Two tenants "
         "registering the same fingerprint advance this ONCE — the "
@@ -245,18 +264,22 @@ SPECS: dict[str, dict] = {
     "klogs_tenant_evictions_total": _m(
         "counter", "Registered sets evicted, by reason: idle (past "
         "KLOGS_TENANT_IDLE_S), capacity (LRU past "
-        "KLOGS_TENANT_MAX_SETS), shutdown.", labels=("reason",)),
+        "KLOGS_TENANT_MAX_SETS), shutdown.", labels=("reason",),
+        bounds={"reason": "enum"}),
     "klogs_tenant_shed_total": _m(
         "counter", "Batches shed over the per-set pending-line quota "
         "(KLOGS_TENANT_QUOTA_LINES); the client degrades them through "
-        "--on-filter-error — never a silent drop.", labels=("set",)),
+        "--on-filter-error — never a silent drop.", labels=("set",),
+        bounds={"set": "evictable:KLOGS_TENANT_MAX_SETS"}),
     "klogs_tenant_pending_lines": _m(
         "gauge", "Lines admitted or awaiting admission per set lane "
         "(the quota accounting the shed decision reads).",
-        labels=("set",)),
+        labels=("set",),
+        bounds={"set": "evictable:KLOGS_TENANT_MAX_SETS"}),
     "klogs_tenant_lines_total": _m(
         "counter", "Lines admitted (past quota + fair gate) per set "
-        "lane.", labels=("set",)),
+        "lane.", labels=("set",),
+        bounds={"set": "evictable:KLOGS_TENANT_MAX_SETS"}),
     "klogs_tenant_admission_wait_seconds": _m(
         "histogram", "Wait for a weighted-fair admission slot before a "
         "batch may dispatch — the fairness latency an abusive sibling "
@@ -270,20 +293,23 @@ SPECS: dict[str, dict] = {
     "klogs_flight_dumps_total": _m(
         "counter", "Flight-recorder dumps written, by trigger reason "
         "(breaker-open, filter-degrade, sweep-fallback, "
-        "abort-escalation).", labels=("reason",)),
+        "abort-escalation).", labels=("reason",), bounds={"reason": "enum"}),
 
     # -- RPC layer (filterd gRPC server) ------------------------------
     "klogs_rpc_requests_total": _m(
-        "counter", "RPCs received, by method.", labels=("method",)),
+        "counter", "RPCs received, by method.", labels=("method",),
+        bounds={"method": "enum"}),
     "klogs_rpc_errors_total": _m(
         "counter", "RPCs that failed (including aborts), by method.",
-        labels=("method",)),
+        labels=("method",), bounds={"method": "enum"}),
     "klogs_rpc_request_seconds": _m(
         "histogram", "Server-side RPC handling latency, by method.",
-        labels=("method",), buckets=LATENCY_BUCKETS),
+        labels=("method",), buckets=LATENCY_BUCKETS,
+        bounds={"method": "enum"}),
     "klogs_rpc_client_requests_total": _m(
         "counter", "RPCs per client HOST (peer address normalized to "
-        "drop the per-connection port).", labels=("client",)),
+        "drop the per-connection port).", labels=("client",),
+        bounds={"client": "config"}),
 }
 
 

@@ -74,7 +74,9 @@ def _sample_from_env() -> float:
     """KLOGS_TRACE_SAMPLE: fraction of traces to record (0..1).
     Malformed values raise naming the variable — a typo'd knob
     silently tracing nothing (or everything) is undebuggable."""
-    raw = os.environ.get("KLOGS_TRACE_SAMPLE")
+    from klogs_tpu.utils.env import read as env_read
+
+    raw = env_read("KLOGS_TRACE_SAMPLE")
     if raw is None:
         return 0.0
     try:
@@ -326,7 +328,9 @@ class Tracer:
         """Turn sampling fully on UNLESS KLOGS_TRACE_SAMPLE is set —
         the --trace-json ergonomics: asking for a trace file means you
         want traces, but an explicit rate (including 0) is respected."""
-        if os.environ.get("KLOGS_TRACE_SAMPLE") is None:
+        from klogs_tpu.utils.env import is_set
+
+        if not is_set("KLOGS_TRACE_SAMPLE"):
             self._sample = 1.0
 
     def bind_registry(self, registry: "Registry | None") -> None:
@@ -543,7 +547,9 @@ class FlightRecorder:
     def _dump_dir(self) -> str:
         if self._dir is not None:
             return self._dir
-        env = os.environ.get("KLOGS_FLIGHT_DIR")
+        from klogs_tpu.utils.env import read as env_read
+
+        env = env_read("KLOGS_FLIGHT_DIR")
         if env:
             return env
         import tempfile

@@ -178,15 +178,17 @@ def env_overrides() -> dict:
     """KLOGS_TPU_TILE / KLOGS_TPU_INTERLEAVE / KLOGS_TPU_FUSED_GROUPS /
     KLOGS_TPU_MASK_BLOCK, when set. Callers pass the result straight
     into match_cls_grouped_pallas / match_batch_grouped_pallas kwargs."""
+    from klogs_tpu.utils.env import read as env_read
+
     out = {}
-    if os.environ.get("KLOGS_TPU_TILE"):
-        out["tile_b"] = int(os.environ["KLOGS_TPU_TILE"])
-    if os.environ.get("KLOGS_TPU_INTERLEAVE"):
-        out["interleave"] = int(os.environ["KLOGS_TPU_INTERLEAVE"])
-    if os.environ.get("KLOGS_TPU_FUSED_GROUPS") == "1":
+    if env_read("KLOGS_TPU_TILE"):
+        out["tile_b"] = int(env_read("KLOGS_TPU_TILE"))
+    if env_read("KLOGS_TPU_INTERLEAVE"):
+        out["interleave"] = int(env_read("KLOGS_TPU_INTERLEAVE"))
+    if env_read("KLOGS_TPU_FUSED_GROUPS") == "1":
         out["fused"] = True
-    if os.environ.get("KLOGS_TPU_MASK_BLOCK"):
-        out["mask_block"] = int(os.environ["KLOGS_TPU_MASK_BLOCK"])
+    if env_read("KLOGS_TPU_MASK_BLOCK"):
+        out["mask_block"] = int(env_read("KLOGS_TPU_MASK_BLOCK"))
     return out
 
 

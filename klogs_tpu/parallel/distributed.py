@@ -31,9 +31,9 @@ process_count() at 1 after an apparently-successful handshake
 Validated live by tests/test_distributed.py's two-controller run.
 """
 
-import os
-
 import jax
+
+from klogs_tpu.utils.env import read as _env_read
 
 
 def initialize(coordinator: str | None = None,
@@ -41,7 +41,7 @@ def initialize(coordinator: str | None = None,
                process_id: int | None = None) -> None:
     """Idempotent jax.distributed bring-up. No-ops when the environment
     describes a single process."""
-    coordinator = coordinator or os.environ.get("KLOGS_COORDINATOR")
+    coordinator = coordinator or _env_read("KLOGS_COORDINATOR")
     num_processes = num_processes or _int_env("KLOGS_NUM_PROCESSES")
     process_id = process_id if process_id is not None else _int_env("KLOGS_PROCESS_ID")
     if num_processes in (None, 1):
@@ -54,5 +54,5 @@ def initialize(coordinator: str | None = None,
 
 
 def _int_env(name: str) -> int | None:
-    v = os.environ.get(name)
+    v = _env_read(name)
     return int(v) if v else None

@@ -186,9 +186,9 @@ class MeshEngine:
         candidate mask (all-or-nothing across shards, matching the
         single-chip usability rule)."""
         import dataclasses
-        import os
 
         from klogs_tpu.ops.nfa import _pad_to
+        from klogs_tpu.utils.env import read as env_read
         from klogs_tpu.ops.pallas_nfa import (
             match_batch_grouped_pallas,
             match_cls_grouped_pallas,
@@ -250,7 +250,7 @@ class MeshEngine:
         interpret = impl == "pallas_interpret"
 
         pf_stacked = None
-        if os.environ.get("KLOGS_TPU_PREFILTER", "0") == "1" \
+        if env_read("KLOGS_TPU_PREFILTER", "0") == "1" \
                 and self.cls_table is not None:
             pf_stacked = self._stack_prefilters(groups, ignore_case, glob, C)
 

@@ -130,10 +130,16 @@ class FanoutRunner:
         self.namespace = namespace
         self.log_opts = log_opts
         self.sink_factory = sink_factory or (lambda job: FileSink(job.path))
-        self._open_sem = asyncio.Semaphore(open_burst)
+        # asyncio primitives are created lazily inside run(): on Py3.10
+        # they bind the loop that exists at CONSTRUCTION, and runners
+        # are built before asyncio.run() starts the real one (the
+        # full-suite-order-only failure class; see docs/
+        # STATIC_ANALYSIS.md task-lifecycle).
+        self._open_burst = open_burst
+        self._open_sem: "asyncio.Semaphore | None" = None
         self._streams: list = []
         self._stopping = False
-        self._stop_event = asyncio.Event()
+        self._stop_event: "asyncio.Event | None" = None
         self.max_reconnects = max_reconnects
         # Reconnect policy override; None = the default built from
         # max_reconnects + the module backoff knobs at decision time
@@ -158,6 +164,19 @@ class FanoutRunner:
                 "retries": registry.family(
                     "klogs_retry_attempts_total").labels(site="fanout"),
             }
+
+    # Lazy asyncio-primitive accessors: every caller below runs on the
+    # event loop, so first use binds the RUNNING loop (never the
+    # default loop a pre-run construction would capture on Py3.10).
+    def _stop_ev(self) -> asyncio.Event:
+        if self._stop_event is None:
+            self._stop_event = asyncio.Event()
+        return self._stop_event
+
+    def _open_gate(self) -> asyncio.Semaphore:
+        if self._open_sem is None:
+            self._open_sem = asyncio.Semaphore(self._open_burst)
+        return self._open_sem
 
     async def _worker(self, job: StreamJob) -> StreamResult:
         result = StreamResult(job=job)
@@ -185,7 +204,7 @@ class FanoutRunner:
         try:
             while True:
                 try:
-                    async with self._open_sem:
+                    async with self._open_gate():
                         stream = await self.backend.open_log_stream(
                             self.namespace, job.pod, opts
                         )
@@ -379,7 +398,7 @@ class FanoutRunner:
             job.pod, job.container, err if err else "EOF", delay,
             attempt + 1, policy.max_attempts - 1,
         )
-        if not await policy.wait(delay, self._stop_event):
+        if not await policy.wait(delay, self._stop_ev()):
             return False  # stop fired during backoff
         if not self._stopping and self._m is not None:
             self._m["reconnects"].labels(
@@ -417,7 +436,7 @@ class FanoutRunner:
         are transient apiserver weather: warn and keep polling."""
         while not self._stopping:
             try:
-                await asyncio.wait_for(self._stop_event.wait(),
+                await asyncio.wait_for(self._stop_ev().wait(),
                                        timeout=interval_s)
                 return  # stop fired
             except asyncio.TimeoutError:
@@ -510,7 +529,7 @@ class FanoutRunner:
                     poller = None
         finally:
             if poller is not None:
-                self._stop_event.set()
+                self._stop_ev().set()
                 try:
                     await poller
                 except Exception as e:
@@ -533,6 +552,6 @@ class FanoutRunner:
         """Explicit teardown: close all live streams; workers then drain
         and flush their sinks."""
         self._stopping = True
-        self._stop_event.set()
+        self._stop_ev().set()
         for s in list(self._streams):
             await s.close()

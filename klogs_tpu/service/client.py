@@ -78,9 +78,10 @@ def tenant_weight() -> float:
     shared set, server-side. Validated here: a bad value must fail
     naming the variable, not degrade to silent equal-share."""
     import math
-    import os
 
-    raw = os.environ.get("KLOGS_TENANT_WEIGHT")
+    from klogs_tpu.utils.env import read as env_read
+
+    raw = env_read("KLOGS_TENANT_WEIGHT")
     if raw is None:
         return 1.0
     try:
@@ -434,6 +435,9 @@ class RemoteFilterClient:
 
         try:
             loop = asyncio.get_running_loop()
-            loop.create_task(self._channel.close())
+            # Stored on self so the close isn't an untracked
+            # fire-and-forget task (task-lifecycle invariant) and a
+            # caller that DOES have a loop can await/inspect it.
+            self._close_task = loop.create_task(self._channel.close())
         except RuntimeError:
             pass

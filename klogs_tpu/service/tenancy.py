@@ -37,7 +37,6 @@ FAILED_PRECONDITION and the client re-registers transparently.
 """
 
 import asyncio
-import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -69,24 +68,8 @@ DEFAULT_SLOTS = 32
 # (_env_int, imported above); this float knob differs from the strict
 # raising parser in filters/indexed.py on purpose — a bad KLOGS_TENANT
 # value should degrade to the default loudly, not kill the server.
-def _env_float(name: str, default: float) -> float:
-    """Non-negative float knob (0 disables idle eviction)."""
-    import math
-
-    raw = os.environ.get(name)
-    if raw is None:
-        return default
-    try:
-        val = float(raw)
-        if not math.isfinite(val) or val < 0:
-            raise ValueError
-    except ValueError:
-        import sys
-
-        print(f"klogs: ignoring invalid {name}={raw!r} (want a "
-              f"non-negative number); using {default}", file=sys.stderr)
-        return default
-    return val
+# (0 disables idle eviction.)
+from klogs_tpu.utils.env import warn_nonneg_float as _env_float  # noqa: E402
 
 
 class _BuildCancelled(Exception):
@@ -294,7 +277,10 @@ class PatternSetRegistry:
         self._pool = ThreadPoolExecutor(
             max_workers=DEFAULT_FETCH_WORKERS,
             thread_name_prefix="klogs-tenant-fetch")
-        self._sem = asyncio.Semaphore(DEFAULT_MAX_IN_FLIGHT)
+        # Lazy (first Register runs on the loop): a Py3.10 asyncio
+        # primitive binds the loop alive at construction, and the
+        # registry may be built before serve() starts the real one.
+        self._sem: "asyncio.Semaphore | None" = None
         self._mut = threading.Lock()
         self._sets: dict[str, SetEntry] = {}
         self._building: dict[str, asyncio.Future] = {}
@@ -325,7 +311,10 @@ class PatternSetRegistry:
 
     @property
     def in_flight(self) -> asyncio.Semaphore:
-        """The shared in-flight dispatch budget (see ``executor``)."""
+        """The shared in-flight dispatch budget (see ``executor``),
+        created on first use from the running loop."""
+        if self._sem is None:
+            self._sem = asyncio.Semaphore(DEFAULT_MAX_IN_FLIGHT)
         return self._sem
 
     @property
@@ -408,7 +397,7 @@ class PatternSetRegistry:
             self._builds += 1
             service = AsyncFilterService(
                 filt, stats=self._stats, executor=self._pool,
-                in_flight=self._sem)
+                in_flight=self.in_flight)
             lane = _Lane(fp, weight, self.quota_lines,
                          registry=self._registry)
             entry = SetEntry(fp, pats, excl, ignore_case, service, lane)

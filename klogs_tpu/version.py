@@ -6,6 +6,6 @@ Reference parity: klogs stamps ``cmd.BuildVersion`` at link time via
 The Python analog is an environment override at import time.
 """
 
-import os
+from klogs_tpu.utils.env import read as _env_read
 
-BUILD_VERSION = os.environ.get("KLOGS_BUILD_VERSION", "development")
+BUILD_VERSION = _env_read("KLOGS_BUILD_VERSION", "development")

@@ -121,7 +121,8 @@ def test_mypy_gate():
         pytest.skip("mypy not installed in this environment")
     proc = subprocess.run(
         ["mypy", "klogs_tpu/obs", "klogs_tpu/filters/compiler",
-         "klogs_tpu/ops/sweep.py", "klogs_tpu/service/transport.py"],
+         "klogs_tpu/ops/sweep.py", "klogs_tpu/service/transport.py",
+         "klogs_tpu/utils/env.py", "tools/analysis"],
         cwd=REPO, capture_output=True, text=True, timeout=300)
     assert proc.returncode == 0, proc.stdout + proc.stderr
 
@@ -805,3 +806,591 @@ def test_cli_docs_both_directions(tmp_path):
 
 def test_cli_docs_real_tree_clean():
     assert _active(REPO, "cli-docs") == []
+
+
+# -- second-generation suite (core dataflow + fleet-era passes) --------
+
+def test_pass_count_floor():
+    """The suite advertises >= 14 registered rules (acceptance gate);
+    keep the floor explicit so a dropped registration fails loudly."""
+    assert len(all_passes()) >= 14
+
+
+def test_reaching_defs_basic_and_branches():
+    import ast as _ast
+
+    from tools.analysis.core import ReachingDefs
+
+    fn = _ast.parse(textwrap.dedent("""
+        def f(cond):
+            t = make()
+            if cond:
+                u = t
+            else:
+                t = other()
+            return t
+        """)).body[0]
+    rd = ReachingDefs(fn)
+    first, second = [s for s in _ast.walk(fn)
+                     if isinstance(s, _ast.Assign)
+                     and isinstance(s.targets[0], _ast.Name)
+                     and s.targets[0].id == "t"]
+    # the first def reaches the `u = t` load and (via the then-branch)
+    # the return; the else-branch redefinition reaches only the return.
+    assert len(rd.uses_of(first)) == 2
+    assert len(rd.uses_of(second)) == 1
+
+
+def test_reaching_defs_no_use_and_closure_capture():
+    import ast as _ast
+
+    from tools.analysis.core import ReachingDefs
+
+    fn = _ast.parse(textwrap.dedent("""
+        def f():
+            dead = make()
+            live = make()
+            def inner():
+                return live
+            return inner
+        """)).body[0]
+    rd = ReachingDefs(fn)
+    dead, live = [s for s in _ast.walk(fn) if isinstance(s, _ast.Assign)]
+    assert rd.uses_of(dead) == []
+    assert len(rd.uses_of(live)) == 1  # captured by the closure
+
+
+def test_call_graph_one_level_propagation():
+    import ast as _ast
+
+    from tools.analysis.core import CallGraph, ModuleIndex
+
+    idx = ModuleIndex(_ast.parse(textwrap.dedent("""
+        class S:
+            def helper(self):
+                return 1
+            async def entry(self):
+                self.helper()
+                await self.other()
+        """)))
+    graph = CallGraph(idx)
+    hits = list(graph.propagate({"helper": "H", "other": "O"},
+                                callers=idx.async_functions))
+    # helper() propagates; the awaited other() is skipped.
+    assert len(hits) == 1
+    caller, call, callee, val = hits[0]
+    assert caller.name == "entry" and callee == "helper" and val == "H"
+
+
+def test_module_index_is_cached(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/x.py": "async def f():\n    pass\n"})
+    from tools.analysis.core import Project
+
+    sf = Project(root).file("klogs_tpu/x.py")
+    assert sf.index is sf.index  # one build, shared by every pass
+    assert [f.name for f in sf.index.async_functions] == ["f"]
+
+
+# -- env-discipline ----------------------------------------------------
+
+def test_env_discipline_raw_reads_flagged(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/service/cfg.py": """
+        import os
+        A = os.environ.get("KLOGS_FOO")
+        B = os.environ["KLOGS_BAR"]
+        C = os.getenv("KLOGS_BAZ", "1")
+        """})
+    found = _active(root, "env-discipline")
+    assert len(found) == 3
+    assert all("klogs_tpu.utils.env" in f.message for f in found)
+
+
+def test_env_discipline_validator_module_and_writes_allowed(tmp_path):
+    root = _tree(tmp_path, {
+        # THE validator module may read raw.
+        "klogs_tpu/utils/env.py": """
+            import os
+            def read(name, default=None):
+                return os.environ.get(name, default)
+            """,
+        # Writes/pops are harness idioms, not reads.
+        "klogs_tpu/service/harness.py": """
+            import os
+            os.environ["KLOGS_FAULTS"] = "x"
+            os.environ.pop("KLOGS_FAULTS", None)
+            """,
+        # Non-KLOGS reads are out of scope.
+        "klogs_tpu/cluster/kcfg.py": """
+            import os
+            K = os.environ.get("KUBECONFIG")
+            """,
+    })
+    assert _active(root, "env-discipline") == []
+
+
+def test_env_discipline_docs_parity_both_directions(tmp_path):
+    root = _tree(tmp_path, {
+        "klogs_tpu/m.py": """
+            from klogs_tpu.utils.env import read
+            V = read("KLOGS_DOCED")
+            W = read("KLOGS_UNDOC")
+            X = read("KLOGS_WILD_THING")
+            """,
+        "README.md": ("| `KLOGS_DOCED` | on | documented |\n"
+                      "| `KLOGS_STALE` | off | gone |\n"
+                      "| `KLOGS_WILD_*` | - | family |\n"
+                      "| `KLOGS_GHOST_*` | - | empty family |\n"),
+    })
+    found = _active(root, "env-discipline")
+    msgs = "\n".join(f.format() for f in found)
+    assert "KLOGS_UNDOC" in msgs and "documented nowhere" in msgs
+    assert "KLOGS_STALE" in msgs and "stale documentation" in msgs
+    assert "KLOGS_GHOST_*" in msgs  # wildcard matching no read
+    assert "KLOGS_WILD_THING" not in msgs  # wildcard-covered
+    assert "KLOGS_DOCED" not in msgs
+    assert len(found) == 3
+
+
+def test_env_discipline_suppression(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/w.py": """
+        import os
+        A = os.environ.get("KLOGS_X")  # klogs: ignore[env-discipline]
+        """})
+    report = run(root, rules=["env-discipline"])
+    assert report.active == [] and len(report.suppressed) == 1
+
+
+# -- task-lifecycle ----------------------------------------------------
+
+def test_task_lifecycle_leaked_tasks(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/service/leak.py": """
+        import asyncio
+        async def fire_and_forget(op):
+            asyncio.create_task(op())
+        async def assigned_never_used(op, loop):
+            t = loop.create_task(op())
+        """})
+    found = _active(root, "task-lifecycle")
+    assert len(found) == 2
+    msgs = "\n".join(f.message for f in found)
+    assert "discards" in msgs and "never uses" in msgs
+
+
+def test_task_lifecycle_tracked_shapes_are_clean(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/service/ok.py": """
+        import asyncio
+        class S:
+            def __init__(self):
+                import threading
+                self._lock = threading.Lock()  # not an asyncio primitive
+                self._task = None
+            async def start(self, op):
+                self._task = asyncio.create_task(op())   # stored field
+            async def hedge(self, op):
+                pending = set()
+                t = asyncio.ensure_future(op())
+                pending.add(t)                            # used
+                await asyncio.wait(pending)
+            async def direct(self, op):
+                await asyncio.create_task(op())           # awaited
+            async def consumer(self, op, tasks):
+                tasks.append(asyncio.create_task(op()))   # flows in
+                return asyncio.ensure_future(op())        # returned
+        """})
+    assert _active(root, "task-lifecycle") == []
+
+
+def test_task_lifecycle_eager_primitive_in_init(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/runtime/r.py": """
+        import asyncio
+        class Runner:
+            def __init__(self, n):
+                self._sem = asyncio.Semaphore(n)
+                self._stop = asyncio.Event()
+            async def run(self):
+                if self._stop is None:
+                    self._stop = asyncio.Event()  # lazy: fine
+        """})
+    found = _active(root, "task-lifecycle")
+    assert len(found) == 2
+    assert all("Py3.10" in f.message or "binds the loop" in f.message
+               for f in found)
+
+
+def test_task_lifecycle_suppression(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/w.py": """
+        import asyncio
+        async def f(op):
+            asyncio.create_task(op())  # klogs: ignore[task-lifecycle]
+        """})
+    report = run(root, rules=["task-lifecycle"])
+    assert report.active == [] and len(report.suppressed) == 1
+
+
+# -- wire-token --------------------------------------------------------
+
+_TRANSPORT_FIXTURE = (
+    'SET_NOT_REGISTERED = "set-not-registered"\n'
+    'OVER_QUOTA = "tenant-over-quota"\n')
+_TRACE_FIXTURE = 'TRACEPARENT_KEY = "klogs-traceparent"\n'
+
+
+def test_wire_token_retyped_literal(tmp_path):
+    root = _tree(tmp_path, {
+        "klogs_tpu/service/transport.py": _TRANSPORT_FIXTURE,
+        "klogs_tpu/obs/trace.py": _TRACE_FIXTURE,
+        "klogs_tpu/service/client.py": """
+            def is_shed(detail):
+                return detail.startswith("tenant-over-quota")
+            """,
+    })
+    found = _active(root, "wire-token")
+    assert len(found) == 1
+    assert "OVER_QUOTA" in found[0].message
+
+
+def test_wire_token_stale_table_and_clean_reference(tmp_path):
+    root = _tree(tmp_path, {
+        # OVER_QUOTA renamed away: the gate must fail loudly.
+        "klogs_tpu/service/transport.py":
+            'SET_NOT_REGISTERED = "set-not-registered"\n',
+        "klogs_tpu/obs/trace.py": _TRACE_FIXTURE,
+        "klogs_tpu/service/client.py": """
+            from klogs_tpu.service.transport import SET_NOT_REGISTERED
+            def is_evicted(detail):
+                return detail.startswith(SET_NOT_REGISTERED)
+            """,
+    })
+    found = _active(root, "wire-token")
+    assert len(found) == 1 and "stale" in found[0].message
+    assert "OVER_QUOTA" in found[0].message
+
+
+def test_wire_token_suppression(tmp_path):
+    root = _tree(tmp_path, {
+        "klogs_tpu/service/transport.py": _TRANSPORT_FIXTURE,
+        "klogs_tpu/obs/trace.py": _TRACE_FIXTURE,
+        "klogs_tpu/w.py": (
+            'X = "set-not-registered"'
+            '  # klogs: ignore[wire-token]\n'),
+    })
+    report = run(root, rules=["wire-token"])
+    assert report.active == [] and len(report.suppressed) == 1
+
+
+def test_wire_token_real_tree_clean():
+    assert _active(REPO, "wire-token") == []
+
+
+# -- metric-cardinality ------------------------------------------------
+
+_OBS_DOC_FIXTURE = """
+## Label cardinality rules
+
+- endpoint labels come from the --remote fleet; set labels are capped
+  by the registry.
+"""
+
+
+def test_metric_cardinality_missing_and_invalid_bounds(tmp_path):
+    root = _tree(tmp_path, {
+        "klogs_tpu/obs/inventory.py": """
+            def _m(mtype, help, labels=(), buckets=None, bounds=None):
+                return {}
+            SPECS: dict = {
+                "klogs_a_total": _m("counter", "a", labels=("x",)),
+                "klogs_b_total": _m("counter", "b", labels=("y",),
+                                    bounds={"y": "vibes"}),
+                "klogs_c_total": _m("counter", "c",
+                                    bounds={"z": "enum"}),
+            }
+            """,
+        "docs/OBSERVABILITY.md": _OBS_DOC_FIXTURE,
+    })
+    msgs = "\n".join(f.message for f in _active(root, "metric-cardinality"))
+    assert "declares no bound" in msgs          # a: x unbounded
+    assert "'vibes'" in msgs                    # b: invalid kind
+    assert "no labels" in msgs                  # c: bounds w/o labels
+
+
+def test_metric_cardinality_evictable_needs_remove_and_docs(tmp_path):
+    root = _tree(tmp_path, {
+        "klogs_tpu/obs/inventory.py": """
+            def _m(mtype, help, labels=(), buckets=None, bounds=None):
+                return {}
+            SPECS: dict = {
+                "klogs_tenant_x_total": _m(
+                    "counter", "x", labels=("set",),
+                    bounds={"set": "evictable:KLOGS_CAP"}),
+                "klogs_shard_y_total": _m(
+                    "counter", "y", labels=("endpoint",),
+                    bounds={"endpoint": "config"}),
+                "klogs_hidden_total": _m(
+                    "counter", "h", labels=("secret",),
+                    bounds={"secret": "config"}),
+            }
+            """,
+        "klogs_tpu/service/t.py": "CAP = 'KLOGS_CAP'\n",
+        "docs/OBSERVABILITY.md": _OBS_DOC_FIXTURE,
+    })
+    msgs = "\n".join(f.message for f in _active(root, "metric-cardinality"))
+    # evictable with no .remove( anywhere:
+    assert "klogs_tenant_x_total" in msgs and ".remove(" in msgs
+    # config label absent from the documented section:
+    assert "'secret'" in msgs and "not" in msgs
+    # documented config label passes:
+    assert "klogs_shard_y_total" not in msgs
+
+
+def test_metric_cardinality_clean_and_suppressed(tmp_path):
+    clean_inv = """
+        def _m(mtype, help, labels=(), buckets=None, bounds=None):
+            return {}
+        SPECS: dict = {
+            "klogs_ok_total": _m("counter", "ok", labels=("reason",),
+                                 bounds={"reason": "enum"}),
+        }
+        """
+    root = _tree(tmp_path, {
+        "klogs_tpu/obs/inventory.py": clean_inv,
+        "docs/OBSERVABILITY.md": _OBS_DOC_FIXTURE,
+    })
+    assert _active(root, "metric-cardinality") == []
+    root2 = _tree(tmp_path / "s", {
+        "klogs_tpu/obs/inventory.py": (
+            'def _m(mtype, help, labels=(), bounds=None):\n'
+            '    return {}\n'
+            'SPECS: dict = {\n'
+            '    # klogs: ignore[metric-cardinality]\n'
+            '    "klogs_w_total": _m("counter", "w", labels=("x",)),\n'
+            '}\n'),
+        "docs/OBSERVABILITY.md": _OBS_DOC_FIXTURE,
+    })
+    report = run(root2, rules=["metric-cardinality"])
+    assert report.active == [] and len(report.suppressed) == 1
+
+
+# -- native-tier -------------------------------------------------------
+
+_C_LEAKY = """
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+static PyObject *
+leaky(PyObject *self, PyObject *args)
+{
+    Py_buffer data;
+    if (!PyArg_ParseTuple(args, "y*", &data))
+        return NULL;
+    char *scratch = PyMem_Malloc(64);
+    scratch[0] = 0;
+    Py_BEGIN_ALLOW_THREADS
+    PyErr_Clear();
+    Py_END_ALLOW_THREADS
+    if (data.len > 1000000) {
+        return NULL;
+    }
+    return PyBytes_FromStringAndSize((const char *)data.buf, data.len);
+}
+"""
+
+_C_CLEAN = """
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+static PyObject *
+tidy(PyObject *self, PyObject *args)
+{
+    Py_buffer data;
+    if (!PyArg_ParseTuple(args, "y*", &data))
+        return NULL;
+    char *scratch = PyMem_Malloc(64);
+    if (!scratch) {
+        PyBuffer_Release(&data);
+        return NULL;
+    }
+    Py_BEGIN_ALLOW_THREADS
+    scratch[0] = 1;
+    Py_END_ALLOW_THREADS
+    PyMem_Free(scratch);
+    PyObject *out = PyBytes_FromStringAndSize(
+        (const char *)data.buf, data.len);
+    PyBuffer_Release(&data);
+    return out;
+}
+"""
+
+
+def test_native_tier_seeded_violations(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/native/bad.c": _C_LEAKY})
+    found = _active(root, "native-tier")
+    msgs = "\n".join(f.message for f in found)
+    assert "never PyBuffer_Release'd" in msgs          # total leak
+    assert "not NULL-checked" in msgs                  # raw malloc
+    assert "'PyErr_Clear'" in msgs and "GIL-released" in msgs
+
+
+def test_native_tier_clean_fixture(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/native/good.c": _C_CLEAN})
+    assert _active(root, "native-tier") == []
+
+
+def test_native_tier_real_tree_clean():
+    assert _active(REPO, "native-tier") == []
+
+
+# -- suppression-audit -------------------------------------------------
+
+def test_suppression_audit_stale_and_unknown(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/service/s.py": """
+        import time
+        async def busy():
+            time.sleep(1)  # klogs: ignore[async-blocking]
+        def quiet():
+            pass  # klogs: ignore[async-blocking]
+        def typo():
+            pass  # klogs: ignore[async-bloking]
+        """})
+    report = run(root)  # full run: the audit executes
+    audit = [f for f in report.findings if f.rule == "suppression-audit"]
+    msgs = "\n".join(f.message for f in audit)
+    assert len(audit) == 2
+    assert "suppresses nothing" in msgs     # quiet(): rule clean there
+    assert "unknown rule" in msgs           # typo'd id never matched
+    # busy()'s waiver is load-bearing: not flagged, still visible.
+    assert any(f.rule == "async-blocking" and f.suppressed
+               for f in report.findings)
+
+
+def test_suppression_audit_ignores_docstring_grammar(tmp_path):
+    """A docstring QUOTING the ignore[...] grammar is not a waiver
+    (comment-token scanning, not raw line regex)."""
+    root = _tree(tmp_path, {"klogs_tpu/doc.py": '''
+        """Suppress with ``# klogs: ignore[async-blocking]`` inline."""
+        X = 1
+        '''})
+    report = run(root)
+    assert [f for f in report.findings
+            if f.rule == "suppression-audit"] == []
+
+
+def test_suppression_audit_skips_unexecuted_rules(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/s.py": """
+        X = 1  # klogs: ignore[async-blocking]
+        """})
+    # async-blocking did not run, so the audit has no verdict on it.
+    report = run(root, rules=["suppression-audit"])
+    assert report.active == []
+
+
+# -- SARIF output ------------------------------------------------------
+
+def test_sarif_output_and_cli(tmp_path):
+    import json as _json
+
+    root = _tree(tmp_path, {"klogs_tpu/service/s.py": """
+        import time
+        async def a():
+            time.sleep(1)
+        async def b():
+            time.sleep(1)  # klogs: ignore[async-blocking]
+        """})
+    report = run(root, rules=["async-blocking"])
+    doc = _json.loads(report.to_sarif(all_passes()))
+    assert doc["version"] == "2.1.0"
+    results = doc["runs"][0]["results"]
+    assert len(results) == 2
+    active = [r for r in results if "suppressions" not in r]
+    waived = [r for r in results if "suppressions" in r]
+    assert len(active) == 1 and len(waived) == 1
+    loc = active[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "klogs_tpu/service/s.py"
+    assert loc["region"]["startLine"] == 4
+    assert active[0]["ruleId"] == "async-blocking"
+    rule_ids = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+    assert "async-blocking" in rule_ids and "env-discipline" in rule_ids
+
+    # CLI: --sarif writes the file; exit semantics unchanged (1 on the
+    # seeded finding).
+    out = tmp_path / "findings.sarif"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analysis", "--root", root,
+         "--rules", "async-blocking", "--sarif", str(out)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    on_disk = _json.loads(out.read_text())
+    assert on_disk["runs"][0]["results"]
+
+
+# -- sanitizer gate ----------------------------------------------------
+
+def test_native_asan_gate():
+    """tools/build_native_asan.py builds _hostops.c under ASan/UBSan
+    and re-runs the native parity tests against that binary. Skips
+    loudly where no sanitizer-capable toolchain exists (exit 2)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.build_native_asan"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    if proc.returncode == 2:
+        pytest.skip(f"sanitizer toolchain unavailable: "
+                    f"{proc.stdout.strip().splitlines()[-1]}")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK: native parity tests passed" in proc.stdout
+
+
+# -- review-hardening regressions --------------------------------------
+
+def test_suppression_audit_wildcard_cannot_self_suppress(tmp_path):
+    """An unused ignore[*] must FAIL the run: a line-anchored audit
+    finding would be swallowed by the very comment it flags."""
+    root = _tree(tmp_path, {"klogs_tpu/w.py": """
+        X = 1  # klogs: ignore[*]
+        """})
+    report = run(root)
+    audit = [f for f in report.active if f.rule == "suppression-audit"]
+    assert len(audit) == 1 and report.exit_code == 1
+    assert audit[0].line == 0 and "line 2" in audit[0].message
+
+
+def test_reaching_defs_match_statement_bindings(tmp_path):
+    """Py3.10 match/case: bindings inside case bodies flow — a task
+    assigned and awaited inside a case is not a leak."""
+    root = _tree(tmp_path, {"klogs_tpu/service/m.py": """
+        import asyncio
+        async def f(x, op):
+            match x:
+                case 1:
+                    t = asyncio.create_task(op())
+                    await t
+                case _:
+                    pass
+        """})
+    assert _active(root, "task-lifecycle") == []
+
+
+def test_async_blocking_direct_hit_not_double_flagged(tmp_path):
+    """A call that is itself a blocking primitive AND names a seeded
+    sync helper is ONE finding, as before the core migration."""
+    root = _tree(tmp_path, {"klogs_tpu/service/d.py": """
+        import time
+        class C:
+            def acquire(self):
+                time.sleep(1)
+            async def go(self):
+                self.acquire()
+        """})
+    found = _active(root, "async-blocking")
+    assert len(found) == 1
+
+
+def test_async_blocking_lambda_and_class_body_in_async(tmp_path):
+    """Lambdas and class bodies inside an async def run on the loop —
+    the pre-migration pass saw them and the core must too."""
+    root = _tree(tmp_path, {"klogs_tpu/service/lam.py": """
+        import time
+        async def a():
+            cb = lambda: time.sleep(1)
+            return cb()
+        """})
+    found = _active(root, "async-blocking")
+    assert len(found) == 1 and "time.sleep" in found[0].message

@@ -29,6 +29,10 @@ def main(argv: "list[str] | None" = None) -> int:
                     help="run only these rule ids")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable findings on stdout")
+    ap.add_argument("--sarif", default=None, metavar="PATH",
+                    help="also write findings as SARIF 2.1.0 to PATH "
+                         "(CI inline annotations); exit-code semantics "
+                         "unchanged")
     ap.add_argument("--list", action="store_true", dest="list_rules",
                     help="list registered rules and exit")
     ns = ap.parse_args(argv)
@@ -48,6 +52,9 @@ def main(argv: "list[str] | None" = None) -> int:
                   f"(see --list)", file=sys.stderr)
             return 2
     report = run(ns.root, rules=rules, passes=passes)
+    if ns.sarif:
+        with open(ns.sarif, "w", encoding="utf-8") as f:
+            f.write(report.to_sarif(passes))
     if ns.as_json:
         print(report.to_json())
     else:

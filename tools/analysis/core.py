@@ -3,19 +3,37 @@
 Generic linters see syntax; every correctness bug PR 3 fixed was a
 *cross-layer invariant* (engine-dispatch drift, int32 offset wrap, a
 blocking payload path into the shared coalescer) that only a checker
-with project knowledge can state. This module is the small machinery
-those checkers share:
+with project knowledge can state. This module is the machinery those
+checkers share:
 
 - ``Project``: a source tree rooted anywhere (the real repo in tier-1,
   a fixture tree in tests), with lazily parsed ASTs per file.
+- ``SourceFile.index`` -> :class:`ModuleIndex`: the cached per-file
+  symbol table (functions with enclosing class, awaited-call set,
+  loops, classes) so fourteen-plus passes stop re-walking the same
+  tree N times.
+- :class:`CallGraph`: name-keyed intra-module call edges with
+  one-level propagation — the generalization of the async-blocking
+  pass's "a sync helper containing a blocking call taints its async
+  call sites" hack, now shared by any pass that needs "callers of X
+  inherit property P".
+- :class:`ReachingDefs`: an intraprocedural reaching-definitions
+  dataflow walk (branch-merging, loop-approximating, closure-aware)
+  answering "which loads can this assignment's value reach?" — what
+  the task-lifecycle pass uses to prove a ``create_task`` result is
+  awaited/cancelled/stored rather than leaked.
 - ``Pass``: one named rule (``rule`` id, ``doc`` rationale) producing
   ``Finding``s. Passes are registered in ``tools.analysis.passes``.
 - Suppressions: ``# klogs: ignore[rule-id]`` on the flagged line or the
   line above waives that rule there (``ignore[*]`` waives all). A
   suppressed finding is still reported — as suppressed — so waivers
-  stay visible instead of rotting silently.
+  stay visible instead of rotting silently. ``run`` records which
+  suppression comments actually matched a finding, and the
+  suppression-audit pass flags the ones that no longer do (a stale
+  waiver is a hole the next regression walks through).
 - ``run``: execute passes, apply suppressions, return an exit code
-  (non-zero iff any unsuppressed finding), with human or JSON output.
+  (non-zero iff any unsuppressed finding), with human, JSON, or SARIF
+  output.
 
 Passes must stay import-light (ast/re + pure-CPU project modules, never
 jax): the whole suite runs inside tier-1's budget as one short test.
@@ -28,6 +46,7 @@ import json
 import os
 import re
 from dataclasses import asdict, dataclass, field
+from typing import Any, Iterable, Iterator
 
 
 @dataclass
@@ -51,17 +70,367 @@ class Finding:
 _SUPPRESS_RE = re.compile(r"#\s*klogs:\s*ignore\[([a-z0-9*,-]+)\]")
 
 
+def dotted(node: ast.AST) -> str:
+    """'a.b.c' for Attribute/Name chains, '' otherwise. The shared
+    spelling every pass used to redefine privately."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+
+
+def own_nodes(fn: ast.AST, *,
+              include_nested_sync: bool = False) -> list[ast.AST]:
+    """Nodes of ``fn`` excluding nested function/class bodies (they run
+    in their own context and are analyzed as their own functions).
+    ``include_nested_sync=True`` prunes ONLY nested ``async def``
+    subtrees — the async-blocking semantics, where sync helpers,
+    lambdas, and class bodies defined inside an ``async def`` all run
+    on the loop (when called / at definition time)."""
+    out: list[ast.AST] = []
+    stack: list[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        if include_nested_sync:
+            if isinstance(n, ast.AsyncFunctionDef):
+                continue
+        elif isinstance(n, _DEFS):
+            continue
+        out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+@dataclass
+class FuncInfo:
+    """One function/method with its enclosing-class context."""
+
+    node: "ast.FunctionDef | ast.AsyncFunctionDef"
+    name: str
+    cls: "str | None"  # enclosing class name, None for module level
+    is_async: bool
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+
+class ModuleIndex:
+    """The per-file symbol table passes share (``SourceFile.index``):
+    every function def with its enclosing class, the set of awaited
+    call nodes, top-level classes, and loop statements — computed in
+    ONE walk and cached on the file."""
+
+    def __init__(self, tree: ast.AST):
+        self.functions: list[FuncInfo] = []
+        self.classes: list[ast.ClassDef] = []
+        self.loops: "list[ast.For | ast.AsyncFor | ast.While]" = []
+        self.awaited: set[int] = set()
+        # (node, enclosing_class) DFS; a method's class is the nearest
+        # enclosing ClassDef, functions nested in functions keep it.
+        stack: list[tuple[ast.AST, "str | None"]] = [(tree, None)]
+        while stack:
+            node, cls = stack.pop()
+            if isinstance(node, ast.ClassDef):
+                self.classes.append(node)
+                cls = node.name
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions.append(FuncInfo(
+                    node, node.name, cls,
+                    isinstance(node, ast.AsyncFunctionDef)))
+            elif isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                self.loops.append(node)
+            elif (isinstance(node, ast.Await)
+                    and isinstance(node.value, ast.Call)):
+                self.awaited.add(id(node.value))
+            stack.extend((c, cls) for c in ast.iter_child_nodes(node))
+        self.functions.sort(key=lambda f: f.node.lineno)
+        self._by_name: dict[str, list[FuncInfo]] = {}
+        for f in self.functions:
+            self._by_name.setdefault(f.name, []).append(f)
+
+    def functions_named(self, name: str) -> list[FuncInfo]:
+        return self._by_name.get(name, [])
+
+    @property
+    def async_functions(self) -> list[FuncInfo]:
+        return [f for f in self.functions if f.is_async]
+
+    @property
+    def sync_functions(self) -> list[FuncInfo]:
+        return [f for f in self.functions if not f.is_async]
+
+    @staticmethod
+    def callee_name(call: ast.Call) -> "str | None":
+        """Intra-module callee key: ``helper(...)`` -> ``helper``,
+        ``self.helper(...)`` -> ``helper`` (methods dispatch on the
+        same class in practice), anything else -> None."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            return func.id
+        if (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "self"):
+            return func.attr
+        return None
+
+
+class CallGraph:
+    """Name-keyed call edges within one module, with ONE level of
+    propagation: a property proven about a function's own body
+    (``seeds``) taints its direct call sites. One level is the honest
+    scope — deeper transitive closure over dynamic dispatch would
+    claim precision the name-keyed edges don't have."""
+
+    def __init__(self, index: ModuleIndex):
+        self.index = index
+
+    def calls_in(self, fn: ast.AST, *,
+                 include_nested_sync: bool = False) -> list[ast.Call]:
+        return [n for n in own_nodes(
+            fn, include_nested_sync=include_nested_sync)
+            if isinstance(n, ast.Call)]
+
+    def propagate(self, seeds: dict[str, Any], *,
+                  callers: "Iterable[FuncInfo] | None" = None,
+                  include_nested_sync: bool = False,
+                  skip_awaited: bool = True,
+                  ) -> "Iterator[tuple[FuncInfo, ast.Call, str, Any]]":
+        """Yield ``(caller, call_node, callee_name, seed_value)`` for
+        every call site in ``callers`` (default: every function) whose
+        callee name is seeded. ``skip_awaited`` drops awaited calls
+        (an awaited helper isn't the blocking/fire-and-forget shape)."""
+        pool = self.index.functions if callers is None else callers
+        for caller in pool:
+            for call in self.calls_in(
+                    caller.node, include_nested_sync=include_nested_sync):
+                if skip_awaited and id(call) in self.index.awaited:
+                    continue
+                name = self.index.callee_name(call)
+                if name is not None and name in seeds:
+                    yield caller, call, name, seeds[name]
+
+
+class ReachingDefs:
+    """Intraprocedural reaching definitions for one function.
+
+    Statements are walked in order with an environment mapping each
+    local name to the set of assignments that may currently bind it;
+    branches fork and merge the environment, loop bodies run twice (the
+    one-iteration fixpoint approximation), and loads inside nested
+    defs/lambdas count as uses of EVERY definition of that name in the
+    function (closures capture by reference — the final binding is
+    what they see, and for lint purposes any capture is a use).
+
+    Query with :meth:`uses_of`: the Name-load nodes a given assignment
+    statement's value can reach. An empty answer for a
+    ``t = create_task(...)`` statement is exactly the hedge-loser leak
+    shape the task-lifecycle pass hunts."""
+
+    def __init__(self, fn: "ast.FunctionDef | ast.AsyncFunctionDef"):
+        self._uses: dict[int, list[ast.Name]] = {}
+        self._defs_by_name: dict[str, list[int]] = {}
+        self._nested_loads: set[str] = set()
+        env: dict[str, set[int]] = {}
+        for arg in self._arg_names(fn):
+            env[arg] = set()
+        self._walk_block(fn.body, env)
+        # Closure captures: a load of `name` inside a nested def uses
+        # every def of that name in this function.
+        for name in self._nested_loads:
+            for d in self._defs_by_name.get(name, []):
+                self._uses.setdefault(d, []).append(
+                    ast.Name(id=name, ctx=ast.Load()))
+
+    @staticmethod
+    def _arg_names(fn: "ast.FunctionDef | ast.AsyncFunctionDef") -> list[str]:
+        a = fn.args
+        names = [x.arg for x in (
+            list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs))]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return names
+
+    def uses_of(self, stmt: ast.AST) -> list[ast.Name]:
+        """Name loads reached by the bindings ``stmt`` created."""
+        return self._uses.get(id(stmt), [])
+
+    # -- the walk -----------------------------------------------------
+
+    def _bind(self, name: str, stmt: ast.AST,
+              env: dict[str, set[int]]) -> None:
+        env[name] = {id(stmt)}
+        self._defs_by_name.setdefault(name, []).append(id(stmt))
+
+    def _load(self, node: ast.Name, env: dict[str, set[int]]) -> None:
+        for d in env.get(node.id, ()):
+            self._uses.setdefault(d, []).append(node)
+
+    def _visit_expr(self, node: "ast.AST | None",
+                    env: dict[str, set[int]]) -> None:
+        if node is None:
+            return
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                self._load(n, env)
+                continue
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                body = n.body if isinstance(n.body, list) else [n.body]
+                for sub in body:
+                    for x in ast.walk(sub):
+                        if (isinstance(x, ast.Name)
+                                and isinstance(x.ctx, ast.Load)):
+                            self._nested_loads.add(x.id)
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _bind_target(self, target: ast.AST, stmt: ast.AST,
+                     env: dict[str, set[int]]) -> None:
+        if isinstance(target, ast.Name):
+            self._bind(target.id, stmt, env)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._bind_target(el, stmt, env)
+        elif isinstance(target, ast.Starred):
+            self._bind_target(target.value, stmt, env)
+        else:
+            # self.x = v / d[k] = v: the target expression READS names.
+            self._visit_expr(target, env)
+
+    @staticmethod
+    def _merge(a: dict[str, set[int]],
+               b: dict[str, set[int]]) -> dict[str, set[int]]:
+        out = {k: set(v) for k, v in a.items()}
+        for k, v in b.items():
+            out.setdefault(k, set()).update(v)
+        return out
+
+    def _walk_block(self, stmts: list[ast.stmt],
+                    env: dict[str, set[int]]) -> dict[str, set[int]]:
+        for stmt in stmts:
+            env = self._walk_stmt(stmt, env)
+        return env
+
+    def _walk_stmt(self, stmt: ast.stmt,
+                   env: dict[str, set[int]]) -> dict[str, set[int]]:
+        if isinstance(stmt, ast.Assign):
+            self._visit_expr(stmt.value, env)
+            for t in stmt.targets:
+                self._bind_target(t, stmt, env)
+        elif isinstance(stmt, ast.AnnAssign):
+            self._visit_expr(stmt.value, env)
+            if stmt.value is not None:
+                self._bind_target(stmt.target, stmt, env)
+        elif isinstance(stmt, ast.AugAssign):
+            self._visit_expr(stmt.value, env)
+            if isinstance(stmt.target, ast.Name):
+                # x += v reads x (a use of prior defs), then rebinds it.
+                for d in env.get(stmt.target.id, ()):
+                    self._uses.setdefault(d, []).append(stmt.target)
+                self._bind(stmt.target.id, stmt, env)
+            else:
+                self._visit_expr(stmt.target, env)
+        elif isinstance(stmt, (ast.If,)):
+            self._visit_expr(stmt.test, env)
+            env_then = self._walk_block(stmt.body,
+                                        {k: set(v) for k, v in env.items()})
+            env_else = self._walk_block(stmt.orelse,
+                                        {k: set(v) for k, v in env.items()})
+            env = self._merge(env_then, env_else)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._visit_expr(stmt.iter, env)
+            self._bind_target(stmt.target, stmt, env)
+            once = self._walk_block(stmt.body,
+                                    {k: set(v) for k, v in env.items()})
+            merged = self._merge(env, once)
+            again = self._walk_block(stmt.body, merged)
+            env = self._merge(merged, again)
+            env = self._walk_block(stmt.orelse, env)
+        elif isinstance(stmt, ast.While):
+            self._visit_expr(stmt.test, env)
+            once = self._walk_block(stmt.body,
+                                    {k: set(v) for k, v in env.items()})
+            merged = self._merge(env, once)
+            self._visit_expr(stmt.test, merged)
+            again = self._walk_block(stmt.body, merged)
+            env = self._merge(merged, again)
+            env = self._walk_block(stmt.orelse, env)
+        elif isinstance(stmt, ast.Try):
+            env_body = self._walk_block(stmt.body,
+                                        {k: set(v) for k, v in env.items()})
+            merged = self._merge(env, env_body)
+            for h in stmt.handlers:
+                henv = {k: set(v) for k, v in merged.items()}
+                if h.name:
+                    self._bind(h.name, h, henv)
+                merged = self._merge(merged, self._walk_block(h.body, henv))
+            merged = self._walk_block(stmt.orelse, merged)
+            env = self._walk_block(stmt.finalbody, merged)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._visit_expr(item.context_expr, env)
+                if item.optional_vars is not None:
+                    self._bind_target(item.optional_vars, stmt, env)
+            env = self._walk_block(stmt.body, env)
+        elif isinstance(stmt, ast.Match):
+            # match/case: each case body forks the env; capture names
+            # in the pattern (MatchAs/MatchStar/MatchMapping.rest) bind
+            # there. Merged with the fall-through env (no case may
+            # match).
+            self._visit_expr(stmt.subject, env)
+            merged = {k: set(v) for k, v in env.items()}
+            for case in stmt.cases:
+                cenv = {k: set(v) for k, v in env.items()}
+                for p in ast.walk(case.pattern):
+                    name = getattr(p, "name", None) or getattr(
+                        p, "rest", None)
+                    if isinstance(name, str):
+                        self._bind(name, case, cenv)
+                self._visit_expr(case.guard, cenv)
+                merged = self._merge(merged,
+                                     self._walk_block(case.body, cenv))
+            env = merged
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            self._visit_expr(stmt, env)  # nested scope: capture scan
+        elif isinstance(stmt, (ast.Return, ast.Expr, ast.Raise,
+                               ast.Assert, ast.Delete, ast.Await)):
+            for child in ast.iter_child_nodes(stmt):
+                self._visit_expr(child, env)
+        elif isinstance(stmt, (ast.Global, ast.Nonlocal, ast.Pass,
+                               ast.Break, ast.Continue, ast.Import,
+                               ast.ImportFrom)):
+            pass
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                self._visit_expr(child, env)
+        return env
+
+
 class SourceFile:
-    """One parsed source file: text, AST (lazy), and the per-line
-    suppression table."""
+    """One parsed source file: text, AST (lazy), the cached
+    :class:`ModuleIndex`, and the per-line suppression table."""
 
     def __init__(self, root: str, relpath: str):
         self.relpath = relpath
         self.path = os.path.join(root, *relpath.split("/"))
         with open(self.path, encoding="utf-8") as f:
             self.text = f.read()
-        self._tree: ast.AST | None = None
-        self._suppress: dict[int, set[str]] | None = None
+        self._tree: "ast.AST | None" = None
+        self._index: "ModuleIndex | None" = None
+        self._suppress: "dict[int, set[str]] | None" = None
 
     @property
     def tree(self) -> ast.AST:
@@ -71,25 +440,65 @@ class SourceFile:
             self._tree = ast.parse(self.text, filename=self.path)
         return self._tree
 
-    def _suppressions(self) -> dict[int, set[str]]:
+    @property
+    def index(self) -> ModuleIndex:
+        """The cached symbol table — built once, shared by every pass
+        that looks at this file."""
+        if self._index is None:
+            self._index = ModuleIndex(self.tree)
+        return self._index
+
+    def suppressions(self) -> dict[int, set[str]]:
+        """Per-line ignore table, from COMMENT tokens only — a
+        docstring quoting the ``# klogs: ignore[...]`` grammar must not
+        register as a waiver (it bit this module's own docstring).
+        Non-Python files (the C sources some passes read) fall back to
+        the raw line scan, where strings can't embed ``#`` comments."""
         if self._suppress is None:
             table: dict[int, set[str]] = {}
-            for i, line in enumerate(self.text.splitlines(), start=1):
-                m = _SUPPRESS_RE.search(line)
-                if m:
-                    table[i] = {r.strip() for r in m.group(1).split(",")}
+            try:
+                import io
+                import tokenize
+
+                for tok in tokenize.generate_tokens(
+                        io.StringIO(self.text).readline):
+                    if tok.type != tokenize.COMMENT:
+                        continue
+                    m = _SUPPRESS_RE.search(tok.string)
+                    if m:
+                        table[tok.start[0]] = {
+                            r.strip() for r in m.group(1).split(",")}
+            except (SyntaxError, tokenize.TokenError, ValueError):
+                table = {}
+                for i, line in enumerate(self.text.splitlines(), start=1):
+                    m = _SUPPRESS_RE.search(line)
+                    if m:
+                        table[i] = {r.strip()
+                                    for r in m.group(1).split(",")}
             self._suppress = table
         return self._suppress
 
     def is_suppressed(self, rule: str, line: int) -> bool:
         """True when the flagged line (or the line above, for comments
         that would overlong the flagged one) waives ``rule``."""
-        table = self._suppressions()
+        return self.matching_suppression(rule, line) is not None
+
+    def matching_suppression(self, rule: str,
+                             line: int) -> "tuple[int, str] | None":
+        """The (comment line, matched token) that waives ``rule`` at
+        ``line``, or None — the token is the rule id or ``*``. Exposed
+        so ``run`` can record which waivers are actually load-bearing
+        (the suppression-audit pass flags the rest)."""
+        table = self.suppressions()
         for ln in (line, line - 1):
             rules = table.get(ln)
-            if rules and (rule in rules or "*" in rules):
-                return True
-        return False
+            if not rules:
+                continue
+            if rule in rules:
+                return ln, rule
+            if "*" in rules:
+                return ln, "*"
+        return None
 
 
 class Project:
@@ -99,15 +508,36 @@ class Project:
 
     def __init__(self, root: str):
         self.root = os.path.abspath(root)
-        self._cache: dict[str, SourceFile | None] = {}
+        self._cache: dict[str, "SourceFile | None"] = {}
+        self._walk_cache: dict[str, list[str]] = {}
 
-    def file(self, relpath: str) -> SourceFile | None:
+    def file(self, relpath: str) -> "SourceFile | None":
         if relpath not in self._cache:
             try:
                 self._cache[relpath] = SourceFile(self.root, relpath)
             except OSError:
                 self._cache[relpath] = None
         return self._cache[relpath]
+
+    def loaded_files(self) -> list[SourceFile]:
+        """Every file any pass has touched this run (the
+        suppression-audit working set)."""
+        return [sf for sf in self._cache.values() if sf is not None]
+
+    def _walk(self, prefix: str) -> list[str]:
+        if prefix not in self._walk_cache:
+            full = os.path.join(self.root, *prefix.split("/"))
+            rels: list[str] = []
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d != "__pycache__")
+                rel_dir = os.path.relpath(dirpath, self.root).replace(
+                    os.sep, "/")
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        rels.append(f"{rel_dir}/{fn}")
+            self._walk_cache[prefix] = rels
+        return self._walk_cache[prefix]
 
     def files(self, *prefixes: str) -> list[SourceFile]:
         """Every .py file under the given repo-relative prefixes (a
@@ -120,21 +550,15 @@ class Project:
                 if sf is not None:
                     out.append(sf)
                 continue
-            for dirpath, dirnames, filenames in os.walk(full):
-                dirnames[:] = sorted(
-                    d for d in dirnames if d != "__pycache__")
-                rel_dir = os.path.relpath(dirpath, self.root).replace(
-                    os.sep, "/")
-                for fn in sorted(filenames):
-                    if not fn.endswith(".py"):
-                        continue
-                    sf = self.file(f"{rel_dir}/{fn}")
-                    if sf is not None:
-                        out.append(sf)
+            for rel in self._walk(prefix):
+                sf = self.file(rel)
+                if sf is not None:
+                    out.append(sf)
         return out
 
-    def read_text(self, relpath: str) -> str | None:
-        """Non-Python project files (docs) — no AST, no suppression."""
+    def read_text(self, relpath: str) -> "str | None":
+        """Non-Python project files (docs, C sources) — no AST; C files
+        get their own regex-level checks (native-tier)."""
         try:
             with open(os.path.join(self.root, *relpath.split("/")),
                       encoding="utf-8") as f:
@@ -146,13 +570,24 @@ class Project:
 class Pass:
     """One named invariant. Subclasses set ``rule`` (the id that
     appears in output and ``ignore[...]`` comments) and ``doc`` (one
-    line of rationale, shown by --list), and implement ``run``."""
+    line of rationale, shown by --list), and implement ``run``.
+
+    A pass that needs the whole run's outcome (the suppression audit)
+    implements ``run_post(project, report, executed, used)`` instead
+    and leaves ``run`` returning []."""
 
     rule = "base"
     doc = ""
 
     def run(self, project: Project) -> list[Finding]:
         raise NotImplementedError
+
+    def run_post(self, project: Project, report: "Report",
+                 executed: set, used: set) -> list[Finding]:
+        """Post-run hook: ``executed`` is the rule-id set that actually
+        ran, ``used`` the (path, comment-line, token) triples whose
+        suppression matched a finding. Default: nothing."""
+        return []
 
     def finding(self, path: str, line: int, message: str) -> Finding:
         return Finding(self.rule, path, line, message)
@@ -188,6 +623,52 @@ class Report:
             indent=1,
         )
 
+    def to_sarif(self, passes: "list[Pass]") -> str:
+        """SARIF 2.1.0 — what CI annotation surfaces consume. Exit-code
+        semantics live in ``exit_code``; this is serialization only.
+        Suppressed findings carry an inSource suppression object so
+        they render as waived, not failing."""
+        rules = [{
+            "id": p.rule,
+            "shortDescription": {"text": p.doc or p.rule},
+            "helpUri": "docs/STATIC_ANALYSIS.md",
+        } for p in passes]
+        results = []
+        for f in self.findings:
+            res: dict[str, Any] = {
+                "ruleId": f.rule,
+                "level": "error",
+                "message": {"text": f.message},
+                "locations": [{
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.path,
+                            "uriBaseId": "SRCROOT",
+                        },
+                        "region": {"startLine": max(f.line, 1)},
+                    },
+                }],
+            }
+            if f.suppressed:
+                res["suppressions"] = [{"kind": "inSource"}]
+            results.append(res)
+        doc = {
+            "version": "2.1.0",
+            "$schema": ("https://json.schemastore.org/sarif-2.1.0.json"),
+            "runs": [{
+                "tool": {"driver": {
+                    "name": "klogs-tools-analysis",
+                    "informationUri": "docs/STATIC_ANALYSIS.md",
+                    "rules": rules,
+                }},
+                "results": results,
+                "invocations": [{
+                    "executionSuccessful": self.exit_code == 0,
+                }],
+            }],
+        }
+        return json.dumps(doc, indent=1)
+
 
 def run(root: str, rules: "list[str] | None" = None,
         passes: "list[Pass] | None" = None) -> Report:
@@ -209,18 +690,40 @@ def run(root: str, rules: "list[str] | None" = None,
             if r not in known:
                 report.errors.append(f"unknown rule {r!r} "
                                      f"(known: {', '.join(sorted(known))})")
+
+    executed: set = set()
+    used: set = set()  # (path, comment line, matched token)
+
+    def _fold(found: list[Finding]) -> None:
+        for f in found:
+            sf = project.file(f.path) if f.line else None
+            if sf is not None:
+                hit = sf.matching_suppression(f.rule, f.line)
+                if hit is not None:
+                    f.suppressed = True
+                    used.add((f.path, hit[0], hit[1]))
+            report.findings.append(f)
+
+    post: list[Pass] = []
     for p in passes:
         if rules is not None and p.rule not in rules:
+            continue
+        executed.add(p.rule)
+        if type(p).run_post is not Pass.run_post:
+            post.append(p)
             continue
         try:
             found = p.run(project)
         except Exception as e:  # noqa: BLE001 - analyzer must not lie
             report.errors.append(f"pass {p.rule} crashed: {e!r}")
             continue
-        for f in found:
-            sf = project.file(f.path) if f.line else None
-            if sf is not None and sf.is_suppressed(f.rule, f.line):
-                f.suppressed = True
-            report.findings.append(f)
+        _fold(found)
+    for p in post:
+        try:
+            found = p.run_post(project, report, executed, used)
+        except Exception as e:  # noqa: BLE001
+            report.errors.append(f"pass {p.rule} crashed: {e!r}")
+            continue
+        _fold(found)
     report.findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return report

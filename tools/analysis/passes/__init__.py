@@ -1,7 +1,8 @@
 """Pass registry: one instance of every registered invariant.
 
 Order is the report order for project-level (line-0) findings; keep
-the five core invariants first, docs parity last.
+the core invariants first, docs parity and the post-run suppression
+audit last.
 """
 
 
@@ -9,12 +10,22 @@ def all_passes():
     from tools.analysis.passes.async_blocking import AsyncBlockingPass
     from tools.analysis.passes.cli_docs import CliDocsPass
     from tools.analysis.passes.dispatch_parity import DispatchParityPass
+    from tools.analysis.passes.env_discipline import EnvDisciplinePass
     from tools.analysis.passes.int32_guard import Int32GuardPass
     from tools.analysis.passes.lock_discipline import LockDisciplinePass
+    from tools.analysis.passes.metric_cardinality import (
+        MetricCardinalityPass,
+    )
     from tools.analysis.passes.metrics_docs import MetricsDocsPass
+    from tools.analysis.passes.native_tier import NativeTierPass
     from tools.analysis.passes.retry_discipline import RetryDisciplinePass
     from tools.analysis.passes.span_discipline import SpanDisciplinePass
+    from tools.analysis.passes.suppression_audit import (
+        SuppressionAuditPass,
+    )
+    from tools.analysis.passes.task_lifecycle import TaskLifecyclePass
     from tools.analysis.passes.traced_purity import TracedPurityPass
+    from tools.analysis.passes.wire_tokens import WireTokensPass
 
     return [
         AsyncBlockingPass(),
@@ -24,6 +35,12 @@ def all_passes():
         Int32GuardPass(),
         RetryDisciplinePass(),
         SpanDisciplinePass(),
+        EnvDisciplinePass(),
+        TaskLifecyclePass(),
+        WireTokensPass(),
+        MetricCardinalityPass(),
+        NativeTierPass(),
         MetricsDocsPass(),
         CliDocsPass(),
+        SuppressionAuditPass(),
     ]

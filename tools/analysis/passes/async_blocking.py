@@ -12,15 +12,23 @@ primitives: ``time.sleep``, bare ``open()``, non-awaited
 ``sep.join(parts)`` always has an argument), ``Executor.shutdown(wait=
 True)``, ``subprocess.*`` and ``os.system``.
 
-One level of propagation: a *sync* method containing a blocking
-primitive is itself flagged at any call site inside an async def of
-the same module (e.g. an async RPC handler calling a helper that does
-``open()`` per request).
+One level of propagation (the shared ``core.CallGraph``): a *sync*
+method containing a blocking primitive is itself flagged at any call
+site inside an async def of the same module (e.g. an async RPC handler
+calling a helper that does ``open()`` per request).
 """
 
 import ast
 
-from tools.analysis.core import Finding, Pass, Project, SourceFile
+from tools.analysis.core import (
+    CallGraph,
+    Finding,
+    Pass,
+    Project,
+    SourceFile,
+    dotted,
+    own_nodes,
+)
 
 SCOPE = (
     "klogs_tpu/service",
@@ -35,19 +43,7 @@ _SUBPROCESS_FNS = {"run", "call", "check_call", "check_output", "Popen"}
 _BLOCKING_METHODS = {"acquire", "result"}
 
 
-def _dotted(node: ast.AST) -> str:
-    """'a.b.c' for Attribute/Name chains, '' otherwise."""
-    parts: list[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return ""
-
-
-def _blocking_kind(call: ast.Call, awaited: bool) -> str | None:
+def _blocking_kind(call: ast.Call, awaited: bool) -> "str | None":
     """Why this call blocks the loop, or None."""
     func = call.func
     if isinstance(func, ast.Name):
@@ -56,14 +52,14 @@ def _blocking_kind(call: ast.Call, awaited: bool) -> str | None:
         return None
     if not isinstance(func, ast.Attribute):
         return None
-    dotted = _dotted(func)
-    if dotted == "time.sleep":
+    name = dotted(func)
+    if name == "time.sleep":
         return "time.sleep blocks the event loop (use asyncio.sleep)"
-    if dotted == "os.system" or dotted == "socket.create_connection":
-        return f"{dotted} blocks the event loop"
-    if (dotted.startswith("subprocess.")
+    if name == "os.system" or name == "socket.create_connection":
+        return f"{name} blocks the event loop"
+    if (name.startswith("subprocess.")
             and func.attr in _SUBPROCESS_FNS):
-        return f"{dotted} blocks the event loop"
+        return f"{name} blocks the event loop"
     if awaited:
         return None
     if func.attr in _BLOCKING_METHODS:
@@ -90,42 +86,6 @@ def _blocking_kind(call: ast.Call, awaited: bool) -> str | None:
     return None
 
 
-class _FuncIndex(ast.NodeVisitor):
-    """Collects every function def with its enclosing-async context."""
-
-    def __init__(self) -> None:
-        self.async_defs: list[ast.AsyncFunctionDef] = []
-        self.sync_defs: list[ast.FunctionDef] = []
-
-    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
-        self.async_defs.append(node)
-        self.generic_visit(node)
-
-    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
-        self.sync_defs.append(node)
-        self.generic_visit(node)
-
-
-def _awaited_calls(root: ast.AST) -> set[int]:
-    return {id(n.value) for n in ast.walk(root)
-            if isinstance(n, ast.Await) and isinstance(n.value, ast.Call)}
-
-
-def _own_nodes(fn: ast.AST) -> list[ast.AST]:
-    """Nodes of ``fn`` including nested *sync* defs (they run on the
-    loop when called) but excluding nested async defs (their bodies are
-    separate loop entries, visited on their own)."""
-    out: list[ast.AST] = []
-    stack: list[ast.AST] = list(ast.iter_child_nodes(fn))
-    while stack:
-        n = stack.pop()
-        if isinstance(n, ast.AsyncFunctionDef):
-            continue
-        out.append(n)
-        stack.extend(ast.iter_child_nodes(n))
-    return out
-
-
 class AsyncBlockingPass(Pass):
     rule = "async-blocking"
     doc = ("no blocking primitives inside async bodies in the "
@@ -138,47 +98,51 @@ class AsyncBlockingPass(Pass):
         return findings
 
     def _check_file(self, sf: SourceFile) -> list[Finding]:
-        idx = _FuncIndex()
-        idx.visit(sf.tree)
-        awaited = _awaited_calls(sf.tree)
+        idx = sf.index
+        graph = CallGraph(idx)
         findings: list[Finding] = []
 
-        # Sync functions/methods that contain a blocking primitive
-        # directly — call sites in async defs get the propagated flag.
+        # Sync functions/methods whose OWN body contains a blocking
+        # primitive — call sites in async defs get the propagated flag.
+        # Functions nested inside an async def are covered as part of
+        # that async body below (include_nested_sync), so they are not
+        # separately seeded.
         nested_in_async = {
-            id(d) for a in idx.async_defs for d in _own_nodes(a)
+            id(d) for a in idx.async_functions
+            for d in own_nodes(a.node, include_nested_sync=True)
             if isinstance(d, ast.FunctionDef)}
-        blocking_sync: dict[str, str] = {}
-        for fn in idx.sync_defs:
-            if id(fn) in nested_in_async:
-                continue  # already covered as part of the async body
-            for node in _own_nodes(fn):
+        seeds: dict[str, str] = {}
+        for fn in idx.sync_functions:
+            if id(fn.node) in nested_in_async:
+                continue
+            for node in own_nodes(fn.node, include_nested_sync=True):
                 if isinstance(node, ast.Call):
-                    kind = _blocking_kind(node, id(node) in awaited)
+                    kind = _blocking_kind(node, id(node) in idx.awaited)
                     if kind:
-                        blocking_sync[fn.name] = kind
+                        seeds.setdefault(fn.name, kind)
                         break
 
-        for adef in idx.async_defs:
-            for node in _own_nodes(adef):
+        direct: set = set()
+        for adef in idx.async_functions:
+            for node in own_nodes(adef.node, include_nested_sync=True):
                 if not isinstance(node, ast.Call):
                     continue
-                kind = _blocking_kind(node, id(node) in awaited)
+                kind = _blocking_kind(node, id(node) in idx.awaited)
                 if kind:
+                    direct.add(id(node))
                     findings.append(self.finding(
                         sf.relpath, node.lineno,
                         f"{kind} inside async def {adef.name}()"))
-                    continue
-                callee = None
-                if (isinstance(node.func, ast.Attribute)
-                        and isinstance(node.func.value, ast.Name)
-                        and node.func.value.id == "self"):
-                    callee = node.func.attr
-                elif isinstance(node.func, ast.Name):
-                    callee = node.func.id
-                if callee in blocking_sync and id(node) not in awaited:
-                    findings.append(self.finding(
-                        sf.relpath, node.lineno,
-                        f"async def {adef.name}() calls {callee}(), "
-                        f"which does {blocking_sync[callee]}"))
+
+        # One-level propagation over the shared call graph. A call
+        # already flagged directly is one finding, not two.
+        for caller, call, callee, kind in graph.propagate(
+                seeds, callers=idx.async_functions,
+                include_nested_sync=True):
+            if id(call) in direct:
+                continue
+            findings.append(self.finding(
+                sf.relpath, call.lineno,
+                f"async def {caller.name}() calls {callee}(), "
+                f"which does {kind}"))
         return findings

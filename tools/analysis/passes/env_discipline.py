@@ -1,0 +1,207 @@
+"""env-discipline: every ``KLOGS_*`` knob read flows through the
+shared validator module, and every knob is documented.
+
+The PR 5-10 review-bug class this encodes: raw ``os.environ`` reads of
+tuning knobs accepting garbage — ``KLOGS_HEDGE_S=nan`` reaching
+``asyncio.wait(timeout=nan)``, a negative ``KLOGS_DFA_CACHE_MB``
+evicting every table on every write, a zero RPC timeout failing every
+attempt with an error that never named the variable. Each was fixed by
+moving the read behind a validating helper; this pass pins the funnel
+shut:
+
+1. **No raw reads.** ``os.environ.get("KLOGS_X")`` /
+   ``os.environ["KLOGS_X"]`` / ``os.getenv("KLOGS_X")`` anywhere in
+   ``klogs_tpu/`` or ``tools/`` (the analysis suite self-analyzes)
+   except inside ``klogs_tpu/utils/env.py`` — the one module that owns
+   the raw read — is a finding. Writes (``os.environ[k] = v``,
+   ``.pop``, ``.setdefault``) stay legal: test harnesses and the chaos
+   fuzzer legitimately SET knobs.
+2. **Docs parity, both directions.** Every knob name read in code
+   (including ``getenv("KLOGS_...")`` in the C extension) must appear
+   in the README env table or a docs/ page; every exact ``KLOGS_*``
+   token in those documents must be read somewhere. Wildcard doc rows
+   (``KLOGS_BENCH_*``) whitelist a prefix in both directions.
+
+Knob names are collected from string literals in call arguments — the
+shape every validator call and raw read uses — so prose mentions in
+docstrings don't count as reads.
+"""
+
+import ast
+import os
+import re
+
+from tools.analysis.core import Finding, Pass, Project, SourceFile
+
+SCOPE = ("klogs_tpu", "tools", "bench.py")
+# THE module allowed to touch os.environ for KLOGS keys.
+VALIDATOR_MODULE = "klogs_tpu/utils/env.py"
+
+_KNOB_RE = re.compile(r"^KLOGS_[A-Z0-9_]+$")
+# Doc tokens: exact knobs or prefix wildcards (KLOGS_BENCH_*); a bare
+# "KLOGS_" or "KLOGS_*" in prose names the family, not a knob.
+_DOC_TOKEN_RE = re.compile(r"KLOGS_[A-Z0-9][A-Z0-9_]*\*?")
+_C_GETENV_RE = re.compile(r'getenv\s*\(\s*"(KLOGS_[A-Z0-9_]+)"')
+
+# Docs scanned for knob tokens (the canonical table is README's).
+DOC_FILES = ("README.md",)
+DOCS_DIR = "docs"
+
+
+def _is_environ(node: ast.AST) -> bool:
+    """``os.environ`` (or bare ``environ`` from ``from os import
+    environ``)."""
+    if isinstance(node, ast.Attribute) and node.attr == "environ":
+        return isinstance(node.value, ast.Name) and node.value.id == "os"
+    return isinstance(node, ast.Name) and node.id == "environ"
+
+
+def _klogs_const(node: ast.AST) -> "str | None":
+    if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+            and _KNOB_RE.match(node.value)):
+        return node.value
+    return None
+
+
+class EnvDisciplinePass(Pass):
+    rule = "env-discipline"
+    doc = ("KLOGS_* env reads flow through klogs_tpu/utils/env.py and "
+           "every knob is documented (both directions)")
+
+    def run(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        read_names: dict[str, tuple[str, int]] = {}  # knob -> first site
+
+        for sf in project.files(*SCOPE):
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                # Collect knob names: any KLOGS literal in a call's
+                # positional args (validators and raw reads alike).
+                for arg in node.args:
+                    name = _klogs_const(arg)
+                    if name is not None:
+                        read_names.setdefault(name,
+                                              (sf.relpath, node.lineno))
+                findings.extend(self._raw_read_call(sf, node))
+            findings.extend(self._raw_subscripts(sf))
+
+        # The C extension reads knobs via getenv(); those count as read
+        # sites for docs parity (they cannot route through Python).
+        for crel in self._c_files(project):
+            text = project.read_text(crel)
+            if text:
+                for i, line in enumerate(text.splitlines(), start=1):
+                    for m in _C_GETENV_RE.finditer(line):
+                        read_names.setdefault(m.group(1), (crel, i))
+
+        findings.extend(self._docs_parity(project, read_names))
+        return findings
+
+    # -- rule 1: raw reads --------------------------------------------
+
+    def _raw_read_call(self, sf: SourceFile,
+                       node: ast.Call) -> list[Finding]:
+        if sf.relpath == VALIDATOR_MODULE:
+            return []
+        func = node.func
+        key = None
+        if isinstance(func, ast.Attribute):
+            if func.attr == "get" and _is_environ(func.value):
+                key = node.args[0] if node.args else None
+            elif (func.attr == "getenv" and isinstance(func.value, ast.Name)
+                    and func.value.id == "os"):
+                key = node.args[0] if node.args else None
+        if key is None:
+            return []
+        name = _klogs_const(key)
+        if name is None:
+            return []
+        return [self.finding(
+            sf.relpath, node.lineno,
+            f"raw environment read of {name}: route it through "
+            "klogs_tpu.utils.env (read/is_set or a shared validator) "
+            "so the knob is validated once and visible to this pass")]
+
+    def _raw_subscripts(self, sf: SourceFile) -> list[Finding]:
+        if sf.relpath == VALIDATOR_MODULE:
+            return []
+        findings = []
+        for node in ast.walk(sf.tree):
+            if (isinstance(node, ast.Subscript)
+                    and isinstance(node.ctx, ast.Load)
+                    and _is_environ(node.value)):
+                name = _klogs_const(node.slice)
+                if name is not None:
+                    findings.append(self.finding(
+                        sf.relpath, node.lineno,
+                        f"raw os.environ[{name!r}] read: route it "
+                        "through klogs_tpu.utils.env"))
+        return findings
+
+    # -- rule 2: docs parity ------------------------------------------
+
+    @staticmethod
+    def _c_files(project: Project) -> list[str]:
+        native = os.path.join(project.root, "klogs_tpu", "native")
+        out = []
+        if os.path.isdir(native):
+            for fn in sorted(os.listdir(native)):
+                if fn.endswith(".c"):
+                    out.append(f"klogs_tpu/native/{fn}")
+        return out
+
+    @staticmethod
+    def _doc_tokens(project: Project) -> "dict[str, str] | None":
+        """token -> doc file; None when no docs exist (fixture tree:
+        parity has nothing to say)."""
+        files = list(DOC_FILES)
+        docs = os.path.join(project.root, DOCS_DIR)
+        if os.path.isdir(docs):
+            files += [f"{DOCS_DIR}/{fn}" for fn in sorted(os.listdir(docs))
+                      if fn.endswith(".md")]
+        tokens: dict[str, str] = {}
+        any_doc = False
+        for rel in files:
+            text = project.read_text(rel)
+            if text is None:
+                continue
+            any_doc = True
+            for m in _DOC_TOKEN_RE.finditer(text):
+                tokens.setdefault(m.group(0), rel)
+        return tokens if any_doc else None
+
+    def _docs_parity(self, project: Project,
+                     read_names: dict) -> list[Finding]:
+        tokens = self._doc_tokens(project)
+        if tokens is None or not read_names:
+            return []
+        exact = {t for t in tokens if not t.endswith("*")}
+        prefixes = {t[:-1] for t in tokens if t.endswith("*")}
+        findings = []
+        for name, (rel, line) in sorted(read_names.items()):
+            if name in exact or any(name.startswith(p) for p in prefixes):
+                continue
+            findings.append(self.finding(
+                rel, line,
+                f"env knob {name} is read here but documented nowhere "
+                "(README env table / docs/) — an operator cannot "
+                "discover it"))
+        covered_prefixes = {p for p in prefixes
+                            if any(n.startswith(p) for n in read_names)}
+        for token in sorted(tokens):
+            doc = tokens[token]
+            if token.endswith("*"):
+                if token[:-1] not in covered_prefixes:
+                    findings.append(self.finding(
+                        doc, 0,
+                        f"documented knob family {token} matches no env "
+                        "read in the tree — stale documentation"))
+            elif token not in read_names:
+                findings.append(self.finding(
+                    doc, 0,
+                    f"documented knob {token} is read nowhere in the "
+                    "tree — stale documentation (or the read bypasses "
+                    "the validator module and this pass cannot see "
+                    "it)"))
+        return findings

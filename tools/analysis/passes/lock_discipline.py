@@ -141,8 +141,9 @@ class LockDisciplinePass(Pass):
             if sf is None:
                 continue
             seen = set()
-            for node in sf.tree.body:
-                if isinstance(node, ast.ClassDef) and node.name in classes:
+            # The cached ModuleIndex already collected every ClassDef.
+            for node in sf.index.classes:
+                if node.name in classes:
                     seen.add(node.name)
                     self._check_class(sf, node, classes[node.name],
                                       findings)

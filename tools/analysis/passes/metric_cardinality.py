@@ -1,0 +1,162 @@
+"""metric-cardinality: every labeled metric family declares how each
+label is bounded, runtime-fed labels are documented, and evictable
+label values have a remove path.
+
+The PR 10 review class: per-set metric children were minted from
+runtime traffic (pattern-set fingerprints) and originally never
+removed at eviction — a long-lived registry cycling fingerprints grows
+dead series forever, which is exactly the unbounded-cardinality
+failure Prometheus deployments die of. The fix was threefold (cap the
+label domain by a deployment knob, document the bounding rule, remove
+children at eviction) and this pass keeps all three from rotting:
+
+- every family in ``obs/inventory.py`` with ``labels=...`` declares
+  ``bounds={label: kind}`` for exactly those labels, where kind is
+  ``enum`` (values are code-chosen literals: action, path, reason),
+  ``config`` (values come from deployment shape: endpoints, pods,
+  breaker names), or ``evictable:<KLOGS_KNOB>`` (values derive from
+  runtime input, capped by the knob, entities can go away);
+- ``config``/``evictable`` label names must appear in the "Label
+  cardinality rules" section of docs/OBSERVABILITY.md — the documented
+  bounded-rule table an operator audits;
+- an ``evictable`` family must have a matching remove path: some
+  module must both name the family and call ``.remove(`` (the
+  eviction hook that deletes its children), or dead series accumulate.
+"""
+
+import ast
+import re
+from typing import Iterator
+
+from tools.analysis.core import Finding, Pass, Project
+
+INVENTORY = "klogs_tpu/obs/inventory.py"
+OBS_DOC = "docs/OBSERVABILITY.md"
+_SECTION = "## Label cardinality rules"
+_KINDS = ("enum", "config")
+_EVICTABLE_RE = re.compile(r"^evictable:(KLOGS_[A-Z0-9_]+)$")
+
+
+def _specs_entries(
+    tree: ast.AST,
+) -> "Iterator[tuple[str, ast.Call, list, dict, int]]":
+    """(family name, call node, labels, bounds, lineno) per SPECS row
+    built with _m(...)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        if not any(isinstance(t, ast.Name) and t.id == "SPECS"
+                   for t in targets):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            return
+        for key, val in zip(node.value.keys, node.value.values):
+            if not (isinstance(key, ast.Constant)
+                    and isinstance(key.value, str)
+                    and isinstance(val, ast.Call)):
+                continue
+            labels: list = []
+            bounds: dict = {}
+            for kw in val.keywords:
+                if kw.arg == "labels" and isinstance(
+                        kw.value, (ast.Tuple, ast.List)):
+                    labels = [el.value for el in kw.value.elts
+                              if isinstance(el, ast.Constant)]
+                elif kw.arg == "bounds" and isinstance(kw.value, ast.Dict):
+                    for bk, bv in zip(kw.value.keys, kw.value.values):
+                        if (isinstance(bk, ast.Constant)
+                                and isinstance(bv, ast.Constant)):
+                            bounds[bk.value] = bv.value
+            yield key.value, val, labels, bounds, key.lineno
+
+
+class MetricCardinalityPass(Pass):
+    rule = "metric-cardinality"
+    doc = ("labeled metric families declare a bound per label; "
+           "runtime-fed labels are documented and evictable ones have "
+           "a remove path")
+
+    def run(self, project: Project) -> list[Finding]:
+        sf = project.file(INVENTORY)
+        if sf is None:
+            return []
+        findings: list[Finding] = []
+
+        doc_text = project.read_text(OBS_DOC)
+        section = None
+        if doc_text is not None and _SECTION in doc_text:
+            tail = doc_text.split(_SECTION, 1)[1]
+            section = tail.split("\n## ", 1)[0]
+        elif doc_text is not None:
+            findings.append(self.finding(
+                OBS_DOC, 0,
+                f"missing section {_SECTION!r}: the documented "
+                "bounded-rule table this pass checks runtime-fed "
+                "labels against"))
+
+        documented: "set[str]" = set()
+        if section is not None:
+            documented = set(re.findall(r"[a-z_]+", section))
+
+        # For the remove-path check: files that call Family.remove.
+        removers = [f for f in project.files("klogs_tpu")
+                    if ".remove(" in f.text]
+
+        for name, call, labels, bounds, lineno in _specs_entries(sf.tree):
+            if not labels and bounds:
+                findings.append(self.finding(
+                    sf.relpath, lineno,
+                    f"{name}: bounds declared but the family has no "
+                    "labels"))
+                continue
+            for label in labels:
+                kind = bounds.get(label)
+                if kind is None:
+                    findings.append(self.finding(
+                        sf.relpath, lineno,
+                        f"{name}: label {label!r} declares no bound — "
+                        "state how its value domain is bounded "
+                        "(enum | config | evictable:<KLOGS_KNOB>)"))
+                    continue
+                ev = _EVICTABLE_RE.match(kind)
+                if kind not in _KINDS and not ev:
+                    findings.append(self.finding(
+                        sf.relpath, lineno,
+                        f"{name}: label {label!r} bound {kind!r} is not "
+                        "enum | config | evictable:<KLOGS_KNOB>"))
+                    continue
+                if (kind != "enum" and section is not None
+                        and label not in documented):
+                    findings.append(self.finding(
+                        sf.relpath, lineno,
+                        f"{name}: runtime-fed label {label!r} is not "
+                        f"mentioned in the {_SECTION!r} section of "
+                        f"{OBS_DOC} — document how deployment shape "
+                        "bounds it"))
+                if ev:
+                    knob = ev.group(1)
+                    if not any(knob in f.text for f in
+                               project.files("klogs_tpu")
+                               if f.relpath != sf.relpath):
+                        findings.append(self.finding(
+                            sf.relpath, lineno,
+                            f"{name}: evictable bound knob {knob} "
+                            "appears nowhere in klogs_tpu — the cap "
+                            "it claims does not exist"))
+                    if not any(name in f.text for f in removers):
+                        findings.append(self.finding(
+                            sf.relpath, lineno,
+                            f"{name}: label {label!r} is evictable but "
+                            "no module both names this family and "
+                            "calls .remove( — evicted entities leave "
+                            "dead series behind (the PR 10 orphaned-"
+                            "children class)"))
+            for label in bounds:
+                if label not in labels:
+                    findings.append(self.finding(
+                        sf.relpath, lineno,
+                        f"{name}: bound declared for {label!r} which is "
+                        "not one of the family's labels"))
+        return findings

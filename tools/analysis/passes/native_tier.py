@@ -1,0 +1,238 @@
+"""native-tier: C-extension hygiene lint over ``klogs_tpu/native/*.c``.
+
+ROADMAP item 2 ports the two-tier hash sweep into hand-written SIMD C
+in ``_hostops.c`` — Hyperscan-class scanner code, which is exactly the
+shape where a refcount/buffer slip becomes a use-after-free that only
+a fuzzer (or production) finds. Before that port starts, the native
+code gets its own analysis tier: these regex-level checks run in every
+tier-1, and ``tools/build_native_asan.py`` (docs/NATIVE.md) compiles
+the extension under ASan/UBSan and re-runs the parity tests.
+
+This is a LINT, not a prover: it reasons about lexical windows, not
+control flow. Three rules, each encoding a CPython-API contract:
+
+1. **Buffer release pairing.** A ``Py_buffer`` filled by
+   ``PyArg_ParseTuple(... "y*" ...)`` / ``PyObject_GetBuffer`` must be
+   released on every exit: each ``return`` after the acquisition must
+   have a ``PyBuffer_Release(&buf)`` for every acquired buffer within
+   the preceding cleanup window (25 lines), except returns adjacent to
+   the acquisition itself (a failed converter releases what it
+   acquired).
+2. **Checked allocation.** Every ``malloc``/``PyMem_Malloc`` result is
+   NULL-checked within the next 10 lines (the degrade-to-fused-path
+   idiom) — an unchecked allocation is a segfault under memory
+   pressure, precisely when a log pipeline is least debuggable.
+3. **No CPython API with the GIL released.** The text between
+   ``Py_BEGIN_ALLOW_THREADS`` and ``Py_END_ALLOW_THREADS`` must not
+   call into the interpreter (``Py*``/``Py_*`` identifiers): the
+   row-parallel workers run concurrently with other Python threads.
+
+Findings in .c files cannot be suppressed inline (the ``# klogs:``
+comment grammar is Python's); fix the code or adjust the rule.
+"""
+
+import os
+import re
+from typing import Iterator
+
+from tools.analysis.core import Finding, Pass, Project
+
+NATIVE_DIR = "klogs_tpu/native"
+_RELEASE_WINDOW = 25
+_NULLCHECK_WINDOW = 10
+
+_ACQ_PARSE_RE = re.compile(r"PyArg_ParseTuple\w*\s*\(")
+_GETBUF_RE = re.compile(r"PyObject_GetBuffer\s*\(\s*\w+\s*,\s*&(\w+)")
+_AMP_RE = re.compile(r"&(\w+)")
+_BUFDECL_RE = re.compile(r"^\s*Py_buffer\s+([\w\s,={}]+);")
+_RELEASE_RE = re.compile(r"PyBuffer_Release\s*\(\s*&(\w+)\s*\)")
+_RETURN_RE = re.compile(r"^\s*return\b")
+_MALLOC_RE = re.compile(r"(\w+)\s*=\s*(?:PyMem_Malloc|malloc|calloc|"
+                        r"PyMem_Calloc|realloc)\s*\(")
+_GIL_API_RE = re.compile(r"\bPy_?[A-Z]\w*")
+_COMMENT_RE = re.compile(r"/\*.*?\*/", re.S)
+
+
+def _strip_comments(text: str) -> str:
+    """Blank out comments preserving line structure (so line numbers
+    in findings stay true)."""
+    def blank(m: "re.Match[str]") -> str:
+        return re.sub(r"[^\n]", " ", m.group(0))
+
+    text = _COMMENT_RE.sub(blank, text)
+    return re.sub(r"//[^\n]*", "", text)
+
+
+def _functions(lines: "list[str]") -> "Iterator[tuple[str, int, int]]":
+    """(name, start line idx, end line idx) for each top-level C
+    function — a body is delimited by a ``{`` at column 0 and its
+    matching ``}`` at column 0."""
+    i = 0
+    while i < len(lines):
+        if lines[i].startswith("{"):
+            name = "?"
+            for j in range(i - 1, max(i - 4, -1), -1):
+                m = re.match(r"^(\w+)\s*\(", lines[j])
+                if m:
+                    name = m.group(1)
+                    break
+            end = i + 1
+            while end < len(lines) and not lines[end].startswith("}"):
+                end += 1
+            yield name, i, min(end, len(lines) - 1)
+            i = end
+        i += 1
+
+
+class NativeTierPass(Pass):
+    rule = "native-tier"
+    doc = ("C extension hygiene: buffer acquire/release pairing, "
+           "NULL-checked allocations, no CPython API in GIL-released "
+           "blocks (lint, not a prover)")
+
+    def run(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        native = os.path.join(project.root, *NATIVE_DIR.split("/"))
+        if not os.path.isdir(native):
+            return []
+        for fn in sorted(os.listdir(native)):
+            if not fn.endswith(".c"):
+                continue
+            rel = f"{NATIVE_DIR}/{fn}"
+            text = project.read_text(rel)
+            if text is None:
+                continue
+            findings.extend(self._check_c(rel, _strip_comments(text)))
+        return findings
+
+    def _check_c(self, rel: str, text: str) -> list[Finding]:
+        findings: list[Finding] = []
+        lines = text.splitlines()
+        findings.extend(self._check_gil_blocks(rel, lines))
+        for name, start, end in _functions(lines):
+            findings.extend(
+                self._check_function(rel, name, lines, start, end))
+        return findings
+
+    # -- rule 1 + 2: per function -------------------------------------
+
+    def _check_function(self, rel: str, name: str, lines: "list[str]",
+                        start: int, end: int) -> list[Finding]:
+        findings: list[Finding] = []
+        body = lines[start:end + 1]
+
+        # Declared Py_buffer names in this function.
+        declared: "set[str]" = set()
+        for ln in body:
+            m = _BUFDECL_RE.match(ln)
+            if m:
+                for piece in m.group(1).split(","):
+                    declared.add(piece.split("=")[0].strip())
+
+        # Acquisitions: (buffer name, absolute line idx).
+        acquired: "list[tuple[str, int]]" = []
+        for i, ln in enumerate(body):
+            if _ACQ_PARSE_RE.search(ln):
+                # The parse call may span lines; its & args that name
+                # declared Py_buffers are acquisitions.
+                span = " ".join(body[i:i + 6])
+                for buf in _AMP_RE.findall(span.split(";")[0]):
+                    if buf in declared:
+                        acquired.append((buf, start + i))
+            m = _GETBUF_RE.search(ln)
+            if m and m.group(1) in declared:
+                acquired.append((m.group(1), start + i))
+        if not acquired:
+            # Still check allocations in buffer-free functions.
+            findings.extend(self._check_allocs(rel, lines, start, end))
+            return findings
+        first_acq = min(i for _, i in acquired)
+
+        released_anywhere: "set[str]" = set()
+        for ln in body:
+            released_anywhere.update(_RELEASE_RE.findall(ln))
+        for buf, i in acquired:
+            if buf not in released_anywhere:
+                findings.append(self.finding(
+                    rel, i + 1,
+                    f"{name}(): Py_buffer {buf!r} is acquired but "
+                    "never PyBuffer_Release'd anywhere in the "
+                    "function — a guaranteed reference/buffer leak"))
+
+        for i in range(first_acq, end + 1):
+            if not _RETURN_RE.match(lines[i]):
+                continue
+            lo = max(start, i - _RELEASE_WINDOW)
+            window = lines[lo:i + 1]
+            wtext = "\n".join(window)
+            released = set(_RELEASE_RE.findall(wtext))
+            for buf, acq_line in acquired:
+                if acq_line > i:
+                    continue  # acquired after this return
+                if buf in released:
+                    continue
+                # A return adjacent to the acquisition (parse/GetBuffer
+                # failure) is exempt for the buffers of THAT call:
+                # CPython released them (or never filled them).
+                if i - acq_line <= 6:
+                    continue
+                if buf not in released_anywhere:
+                    continue  # already reported above, once
+                findings.append(self.finding(
+                    rel, i + 1,
+                    f"{name}(): return without PyBuffer_Release(&"
+                    f"{buf}) in the preceding cleanup window — every "
+                    "exit path after acquisition must release (leak "
+                    "on this path)"))
+        findings.extend(self._check_allocs(rel, lines, start, end))
+        return findings
+
+    def _check_allocs(self, rel: str, lines: "list[str]", start: int,
+                      end: int) -> list[Finding]:
+        findings: list[Finding] = []
+        for i in range(start, end + 1):
+            m = _MALLOC_RE.search(lines[i])
+            if not m:
+                continue
+            var = m.group(1)
+            window = "\n".join(lines[i:i + _NULLCHECK_WINDOW + 1])
+            if (re.search(rf"if\s*\([^)]*![ (]*{re.escape(var)}\b",
+                          window)
+                    or re.search(rf"!\s*{re.escape(var)}\b", window)
+                    or re.search(rf"{re.escape(var)}\s*==\s*NULL",
+                                 window)):
+                continue
+            findings.append(self.finding(
+                rel, i + 1,
+                f"allocation result {var!r} is not NULL-checked within "
+                f"{_NULLCHECK_WINDOW} lines — an unchecked allocation "
+                "is a segfault under memory pressure"))
+        return findings
+
+    # -- rule 3: GIL-released blocks ----------------------------------
+
+    def _check_gil_blocks(self, rel: str,
+                          lines: "list[str]") -> list[Finding]:
+        findings: list[Finding] = []
+        inside = False
+        for i, ln in enumerate(lines):
+            if "Py_BEGIN_ALLOW_THREADS" in ln:
+                inside = True
+                continue
+            if "Py_END_ALLOW_THREADS" in ln:
+                inside = False
+                continue
+            if not inside:
+                continue
+            for m in _GIL_API_RE.finditer(ln):
+                tok = m.group(0)
+                if tok in ("Py_BEGIN_ALLOW_THREADS",
+                           "Py_END_ALLOW_THREADS"):
+                    continue
+                findings.append(self.finding(
+                    rel, i + 1,
+                    f"CPython API {tok!r} called inside a GIL-released "
+                    "block (Py_BEGIN/END_ALLOW_THREADS): interpreter "
+                    "state may be touched concurrently by other "
+                    "threads"))
+        return findings

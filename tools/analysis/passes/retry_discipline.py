@@ -33,7 +33,14 @@ skipped, as in the async-blocking pass.
 
 import ast
 
-from tools.analysis.core import Finding, Pass, Project, SourceFile
+from tools.analysis.core import (
+    Finding,
+    Pass,
+    Project,
+    SourceFile,
+    dotted,
+    own_nodes,
+)
 
 SCOPE = (
     "klogs_tpu/cluster",
@@ -43,33 +50,6 @@ SCOPE = (
     "klogs_tpu/filters/sink.py",
     "klogs_tpu/filters/async_service.py",
 )
-
-
-def _dotted(node: ast.AST) -> str:
-    parts: list[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return ""
-
-
-def _own_nodes(loop: ast.AST) -> list[ast.AST]:
-    """Loop-body nodes excluding nested function/class defs (their
-    bodies run elsewhere) — nested loops' contents stay included (the
-    sleep of a retry loop often hides one level down)."""
-    out: list[ast.AST] = []
-    stack: list[ast.AST] = list(ast.iter_child_nodes(loop))
-    while stack:
-        n = stack.pop()
-        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
-                          ast.Lambda, ast.ClassDef)):
-            continue
-        out.append(n)
-        stack.extend(ast.iter_child_nodes(n))
-    return out
 
 
 class RetryDisciplinePass(Pass):
@@ -85,23 +65,25 @@ class RetryDisciplinePass(Pass):
 
     def _check_file(self, sf: SourceFile) -> list[Finding]:
         findings: list[Finding] = []
-        for node in ast.walk(sf.tree):
-            if not isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
-                continue
-            own = _own_nodes(node)
+        # The cached ModuleIndex already collected every loop; nested
+        # loops' contents stay included via own_nodes (the sleep of a
+        # retry loop often hides one level down), nested defs excluded
+        # (their bodies run elsewhere).
+        for node in sf.index.loops:
+            own = own_nodes(node)
             has_except = any(isinstance(n, ast.ExceptHandler) for n in own)
             for n in own:
                 if not isinstance(n, ast.Call):
                     continue
-                dotted = _dotted(n.func)
-                if dotted == "time.sleep":
+                name = dotted(n.func)
+                if name == "time.sleep":
                     findings.append(self.finding(
                         sf.relpath, n.lineno,
                         "time.sleep inside a loop: a sync backoff can "
                         "never be stop-aware (and blocks the shared "
                         "event loop) — use the resilience RetryPolicy "
                         "from async code, or restructure"))
-                elif dotted == "asyncio.sleep" and has_except:
+                elif name == "asyncio.sleep" and has_except:
                     findings.append(self.finding(
                         sf.relpath, n.lineno,
                         "hand-rolled retry backoff: asyncio.sleep in a "

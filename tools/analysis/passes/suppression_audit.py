@@ -1,0 +1,73 @@
+"""suppression-audit: ``# klogs: ignore[...]`` comments must still
+suppress something.
+
+A suppression is a standing exception to an invariant; the baseline
+rots in two ways this pass catches. (1) The code drifts — the flagged
+line moves or the violation is fixed — and the comment survives,
+silently waiving the NEXT violation that lands on that line. (2) The
+rule id is typoed or renamed, so the comment never matched anything
+and the author believes a waiver exists that doesn't. Either way the
+waiver table lies, which defeats the reason suppressed findings are
+printed at all.
+
+Runs as a post-pass over the whole run's outcome: ``core.run`` records
+exactly which (file, line, token) suppression comments matched a
+finding; every ``ignore`` token that names an executed rule (or ``*``)
+and matched nothing is a finding, and a token naming an UNKNOWN rule
+is always a finding. Tokens naming a known rule that was filtered out
+of this run are skipped — the pass cannot judge what didn't execute.
+The audit walks ``klogs_tpu/`` and ``tools/`` (not ``tests/``, whose
+fixture sources legitimately embed ignore comments as test data).
+"""
+
+from tools.analysis.core import Finding, Pass, Project, Report
+
+SCOPE = ("klogs_tpu", "tools")
+
+
+class SuppressionAuditPass(Pass):
+    rule = "suppression-audit"
+    doc = ("ignore[...] comments that no longer suppress anything (or "
+           "name unknown rules) are themselves findings")
+
+    def run(self, project: Project) -> list:
+        return []
+
+    def run_post(self, project: Project, report: Report,
+                 executed: set, used: set) -> list:
+        from tools.analysis.passes import all_passes
+
+        known = {p.rule for p in all_passes()}
+        findings: list[Finding] = []
+        for sf in project.files(*SCOPE):
+            for line, tokens in sorted(sf.suppressions().items()):
+                for tok in sorted(tokens):
+                    if tok == "*":
+                        if (sf.relpath, line, "*") not in used:
+                            # Reported at line 0 (project level): a
+                            # line-anchored finding would be swallowed
+                            # by the very ignore[*] it flags, making
+                            # the wildcard branch dead enforcement.
+                            findings.append(self.finding(
+                                sf.relpath, 0,
+                                f"ignore[*] at line {line} suppresses "
+                                "nothing — remove it, or the next "
+                                "violation on that line is silently "
+                                "waived"))
+                        continue
+                    if tok not in known:
+                        findings.append(self.finding(
+                            sf.relpath, line,
+                            f"ignore[{tok}] names an unknown rule "
+                            "(typo or renamed rule): this comment has "
+                            "never suppressed anything"))
+                        continue
+                    if tok not in executed:
+                        continue  # filtered out of this run: no verdict
+                    if (sf.relpath, line, tok) not in used:
+                        findings.append(self.finding(
+                            sf.relpath, line,
+                            f"ignore[{tok}] suppresses nothing here "
+                            "(rule is clean on this line or the code "
+                            "drifted) — remove the stale waiver"))
+        return findings

@@ -1,0 +1,155 @@
+"""task-lifecycle: background tasks are tracked and asyncio primitives
+are never constructed eagerly in ``__init__``.
+
+Two PR 5-10 review-bug classes, both invisible to generic linters:
+
+1. **Leaked tasks.** PR 6's hedged dispatch originally dropped its
+   loser tasks on the floor — ``asyncio.ensure_future(op(...))`` whose
+   result was never awaited, cancelled, or stored leaks a running task
+   that outlives the request (and, under a span, mis-parents every
+   child trace). Rule: the result of ``create_task`` /
+   ``ensure_future`` must be awaited, stored on an attribute, returned
+   into a consumer expression, or assigned to a name that is USED
+   afterwards (awaited, ``.cancel()``-ed, added to a tracked set,
+   passed to ``asyncio.wait`` — any reached load counts; proven by the
+   core's :class:`ReachingDefs` dataflow). A bare-expression call or
+   an assignment whose bindings reach no load is a finding.
+
+2. **Eager asyncio primitives in constructors.** On Python 3.10 an
+   ``asyncio.Event/Lock/Semaphore/Queue/Condition`` binds the event
+   loop alive at CONSTRUCTION time; objects built before
+   ``asyncio.run()`` starts the real loop then fail only when some
+   other test/process has touched the default loop first — the
+   full-suite-order-only failure class that bit PR 6 (and three
+   stragglers fixed alongside this pass). Rule: no asyncio primitive
+   construction inside a sync ``__init__`` body in the plumbing scope;
+   create them lazily in the first on-loop use instead.
+"""
+
+import ast
+
+from tools.analysis.core import (
+    Finding,
+    FuncInfo,
+    Pass,
+    Project,
+    ReachingDefs,
+    SourceFile,
+    dotted,
+    own_nodes,
+)
+
+SCOPE = ("klogs_tpu",)
+
+_TASK_FUNCS = {"create_task", "ensure_future"}
+_PRIMITIVES = {"Event", "Lock", "Semaphore", "BoundedSemaphore",
+               "Queue", "LifoQueue", "PriorityQueue", "Condition"}
+
+
+def _is_task_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr in _TASK_FUNCS
+    return isinstance(node.func, ast.Name) and node.func.id in _TASK_FUNCS
+
+
+def _eager_primitive(node: ast.AST,
+                     asyncio_names: "set[str]") -> "str | None":
+    """'asyncio.Event'-style dotted name when ``node`` constructs an
+    asyncio synchronization primitive, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted(node.func)
+    if name.startswith("asyncio.") and name[8:] in _PRIMITIVES:
+        return name
+    if (isinstance(node.func, ast.Name) and node.func.id in _PRIMITIVES
+            and node.func.id in asyncio_names):
+        return f"asyncio.{node.func.id}"
+    return None
+
+
+class TaskLifecyclePass(Pass):
+    rule = "task-lifecycle"
+    doc = ("create_task/ensure_future results are awaited/cancelled/"
+           "stored; no eager asyncio primitives in __init__ (Py3.10)")
+
+    def run(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for sf in project.files(*SCOPE):
+            findings.extend(self._check_file(sf))
+        return findings
+
+    def _check_file(self, sf: SourceFile) -> list[Finding]:
+        idx = sf.index
+        findings: list[Finding] = []
+
+        # Names imported via `from asyncio import Event, ...` (rare but
+        # would otherwise dodge the dotted check).
+        asyncio_names = {
+            alias.asname or alias.name
+            for node in ast.walk(sf.tree)
+            if isinstance(node, ast.ImportFrom) and node.module == "asyncio"
+            for alias in node.names}
+
+        for fn in idx.functions:
+            findings.extend(self._check_tasks(sf, fn))
+            if fn.name == "__init__" and fn.cls and not fn.is_async:
+                for node in own_nodes(fn.node):
+                    prim = _eager_primitive(node, asyncio_names)
+                    if prim is not None:
+                        findings.append(self.finding(
+                            sf.relpath, node.lineno,
+                            f"{prim}() constructed in {fn.cls}.__init__: "
+                            "on Py3.10 it binds the loop alive at "
+                            "construction, failing suite-order-"
+                            "dependently when the object is built "
+                            "before asyncio.run() — create it lazily "
+                            "on first use from the running loop"))
+        return findings
+
+    def _check_tasks(self, sf: SourceFile, fn: FuncInfo) -> list[Finding]:
+        findings: list[Finding] = []
+        rd: "ReachingDefs | None" = None
+        # Statement-level scan of the function's own body: a task call
+        # that is the entire value of an Expr/Assign statement is the
+        # shape that can leak; a call nested in a larger expression
+        # (appended to a list, passed to gather/wait, returned,
+        # compared) flows into a consumer and is tracked by it.
+        for stmt in own_nodes(fn.node):
+            if isinstance(stmt, ast.Expr) and _is_task_call(stmt.value):
+                if id(stmt.value) in sf.index.awaited:
+                    continue
+                findings.append(self.finding(
+                    sf.relpath, stmt.value.lineno,
+                    f"{fn.name}() discards a {self._callee(stmt.value)} "
+                    "result: a fire-and-forget task leaks past the "
+                    "request (the PR 6 hedge-loser class) — await it, "
+                    "cancel-and-await it, or store it on a tracked "
+                    "field/set"))
+            elif (isinstance(stmt, ast.Assign)
+                    and _is_task_call(stmt.value)):
+                targets = stmt.targets
+                if any(not isinstance(t, ast.Name) for t in targets):
+                    continue  # self._task = ... : tracked field
+                if rd is None:
+                    rd = ReachingDefs(fn.node)
+                if not rd.uses_of(stmt):
+                    names = ", ".join(t.id for t in targets
+                                      if isinstance(t, ast.Name))
+                    findings.append(self.finding(
+                        sf.relpath, stmt.value.lineno,
+                        f"{fn.name}() assigns a "
+                        f"{self._callee(stmt.value)} result to "
+                        f"{names!r} but never uses it: the task is "
+                        "unreachable after this line — await/cancel/"
+                        "store it, or it leaks (the PR 6 hedge-loser "
+                        "class)"))
+        return findings
+
+    @staticmethod
+    def _callee(call: ast.Call) -> str:
+        name = dotted(call.func)
+        return name or (call.func.attr
+                        if isinstance(call.func, ast.Attribute)
+                        else "create_task")

@@ -1,0 +1,92 @@
+"""wire-token discipline: protocol sentinels are defined once and
+referenced by name — a re-typed literal is a silent protocol fork.
+
+The PR 10 review class: the client keys tenant behavior on stable
+machine-readable tokens in error details (``SET_NOT_REGISTERED``,
+``OVER_QUOTA``) and the trace context rides one metadata key
+(``TRACEPARENT_KEY``). A second copy of any of those strings typed
+inline elsewhere compiles, passes most tests, and forks the wire
+contract the first time only one side is edited — the gRPC analog of
+the PR 3 dispatch-parity drift. Rule, two directions:
+
+1. The declaring module still defines each declared constant as a
+   module-level string (a renamed constant must update the table here,
+   not silently vacate the gate).
+2. No other module under ``klogs_tpu/`` contains a string literal
+   equal to a token's value — reference the constant instead. Tests
+   are deliberately out of scope: asserting against the literal wire
+   bytes in a test is exactly how the contract should be pinned.
+"""
+
+import ast
+
+from tools.analysis.core import Finding, Pass, Project
+
+# declaring module -> constants that ARE the wire contract.
+TOKEN_OWNERS: dict = {
+    "klogs_tpu/service/transport.py": ("SET_NOT_REGISTERED", "OVER_QUOTA"),
+    "klogs_tpu/obs/trace.py": ("TRACEPARENT_KEY",),
+}
+
+SCOPE = ("klogs_tpu",)
+
+
+def _module_str_consts(tree: ast.AST) -> dict:
+    out = {}
+    for node in tree.body if hasattr(tree, "body") else []:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if (isinstance(t, ast.Name)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)):
+                out[t.id] = node.value.value
+    return out
+
+
+class WireTokensPass(Pass):
+    rule = "wire-token"
+    doc = ("wire sentinels (transport/trace constants) are defined "
+           "once and referenced by name, never re-typed as literals")
+
+    def run(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        # value -> (constant name, owning module)
+        tokens: dict = {}
+        any_owner = False
+        for relpath, names in sorted(TOKEN_OWNERS.items()):
+            sf = project.file(relpath)
+            if sf is None:
+                continue
+            any_owner = True
+            consts = _module_str_consts(sf.tree)
+            for name in names:
+                value = consts.get(name)
+                if value is None:
+                    findings.append(self.finding(
+                        relpath, 0,
+                        f"wire token {name} is declared in the "
+                        "wire-token table but not defined as a module-"
+                        "level string here — the table is stale (a "
+                        "renamed sentinel escapes the gate)"))
+                else:
+                    tokens[value] = (name, relpath)
+        if not any_owner or not tokens:
+            return findings
+
+        for sf in project.files(*SCOPE):
+            if sf.relpath in TOKEN_OWNERS:
+                # The owner may spell its own tokens (the definition
+                # itself, sibling f-strings building details).
+                continue
+            for node in ast.walk(sf.tree):
+                if (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)
+                        and node.value in tokens):
+                    name, owner = tokens[node.value]
+                    findings.append(self.finding(
+                        sf.relpath, node.lineno,
+                        f"re-typed wire token {node.value!r}: reference "
+                        f"{name} from {owner} instead — an inline copy "
+                        "forks the wire contract the first time only "
+                        "one side is edited"))
+        return findings

@@ -10,6 +10,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from klogs_tpu.utils.env import read as env_read  # noqa: E402
+
 import bench  # noqa: E402
 
 
@@ -28,7 +30,7 @@ def pipelined_lps(run, n_lines, repeats=3, n_flight=8):
 
 
 def main():
-    B = int(os.environ.get("KLOGS_BENCH_DEVICE_BATCH", "32768"))
+    B = int(env_read("KLOGS_BENCH_DEVICE_BATCH", "32768"))
     import jax
     import jax.numpy as jnp
     import numpy as np

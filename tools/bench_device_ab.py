@@ -26,6 +26,8 @@ from datetime import date
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from klogs_tpu.utils.env import read as env_read  # noqa: E402
+
 import bench  # noqa: E402  (repo-root bench.py: PATTERNS, make_lines, cpu_lps)
 
 
@@ -44,8 +46,8 @@ def pipelined_lps(run, n_lines: int, repeats: int = 3, n_flight: int = 8) -> flo
 
 
 def main() -> None:
-    quick = os.environ.get("KLOGS_AB_QUICK") == "1"
-    B = 4096 if quick else int(os.environ.get("KLOGS_BENCH_DEVICE_BATCH", "32768"))
+    quick = env_read("KLOGS_AB_QUICK") == "1"
+    B = 4096 if quick else int(env_read("KLOGS_BENCH_DEVICE_BATCH", "32768"))
     repeats = 2 if quick else 3
 
     import jax

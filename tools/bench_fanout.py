@@ -25,6 +25,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from klogs_tpu.utils.env import read as env_read  # noqa: E402
+
 from klogs_tpu.cluster.fake import synthetic_line  # noqa: E402
 from klogs_tpu.cluster.types import LogOptions  # noqa: E402
 from klogs_tpu.runtime.fanout import FanoutRunner, StreamJob  # noqa: E402
@@ -100,7 +102,7 @@ def direct_write(n_streams: int, chunks, outdir: str) -> float:
 
 
 def main() -> None:
-    total_mb = float(os.environ.get("KLOGS_FANOUT_MB", "256"))
+    total_mb = float(env_read("KLOGS_FANOUT_MB", "256"))
     results = []
     for n_streams in (64, 256, 1000):
         # Fixed total volume across stream counts.

@@ -22,6 +22,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from klogs_tpu.utils.env import read as env_read  # noqa: E402
+
 from klogs_tpu import app  # noqa: E402
 from klogs_tpu.cli import parse_args  # noqa: E402
 from klogs_tpu.cluster.fake import FakeCluster  # noqa: E402
@@ -38,7 +40,7 @@ def main() -> None:
                     help="historical lines per container at start")
     ns = ap.parse_args()
     patterns = ns.match or ["failed"]
-    rate = float(os.environ.get("KLOGS_FOLLOW_RATE_HZ", "100"))
+    rate = float(env_read("KLOGS_FOLLOW_RATE_HZ", "100"))
 
     out_dir = tempfile.mkdtemp(prefix="klogs-bench-follow-")
     fc = FakeCluster.synthetic(

@@ -27,6 +27,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from klogs_tpu.utils.env import read as env_read  # noqa: E402
+
 import bench  # noqa: E402
 
 
@@ -38,10 +40,10 @@ def main() -> None:
     from klogs_tpu.ops import nfa
     from klogs_tpu.ops.pallas_nfa import match_cls_grouped_pallas
 
-    B = int(os.environ.get("KLOGS_AB_BATCH", "1048576"))
+    B = int(env_read("KLOGS_AB_BATCH", "1048576"))
     flights = [int(x) for x in
-               os.environ.get("KLOGS_AB_FLIGHTS", "16,64").split(",")]
-    repeats = int(os.environ.get("KLOGS_AB_REPEATS", "3"))
+               env_read("KLOGS_AB_FLIGHTS", "16,64").split(",")]
+    repeats = int(env_read("KLOGS_AB_REPEATS", "3"))
 
     dev = jax.devices()[0]
     print(f"attached: {dev}", flush=True)

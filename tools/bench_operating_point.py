@@ -24,6 +24,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from klogs_tpu.utils.env import read as env_read  # noqa: E402
+
 import bench  # noqa: E402
 
 
@@ -59,11 +61,11 @@ def main() -> None:
     from klogs_tpu.ops import nfa
     from klogs_tpu.ops.pallas_nfa import match_cls_grouped_pallas
 
-    batches = [int(x) for x in os.environ.get(
+    batches = [int(x) for x in env_read(
         "KLOGS_OP_BATCHES", "262144,524288,1048576").split(",")]
-    flights = [int(x) for x in os.environ.get(
+    flights = [int(x) for x in env_read(
         "KLOGS_OP_FLIGHTS", "8,16,32,64").split(",")]
-    repeats = int(os.environ.get("KLOGS_OP_REPEATS", "3"))
+    repeats = int(env_read("KLOGS_OP_REPEATS", "3"))
 
     dev = jax.devices()[0]
     print(f"attached: {dev}", flush=True)

@@ -1,0 +1,177 @@
+"""Sanitizer build target for the native extension (docs/NATIVE.md).
+
+Compiles ``klogs_tpu/native/_hostops.c`` with
+``-fsanitize=address,undefined -fno-sanitize-recover=all`` and runs the
+existing native parity tests against THAT binary, so a buffer slip or
+UB in the C hot loops aborts the test run instead of corrupting memory
+quietly. This is the dynamic half of the native analysis tier (the
+static half is the ``native-tier`` pass in ``tools/analysis``); the
+SIMD sweep port (ROADMAP item 2) must land green under it.
+
+Mechanics: the host ``python`` binary is NOT sanitized, so the ASan
+runtime is LD_PRELOADed (``$CC -print-file-name=...``) and leak
+detection is disabled (CPython's interned allocations look like leaks
+at exit). The sanitized .so is pinned via ``KLOGS_NATIVE_SO`` — the
+loader raises if the pin fails to load, so a sanitizer run can never
+silently green-light the pure-Python fallback.
+
+Exit codes: 0 = built (and tests passed, unless --no-run-tests);
+2 = SKIP (no sanitizer-capable compiler / runtime in this
+environment — printed loudly, the tier-1 wrapper turns it into a
+pytest skip); 1 = build or test failure.
+
+Usage:
+    python -m tools.build_native_asan [--no-run-tests] [--out PATH]
+"""
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+import sysconfig
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC = os.path.join(ROOT, "klogs_tpu", "native", "_hostops.c")
+SAN_FLAGS = ["-fsanitize=address,undefined", "-fno-sanitize-recover=all"]
+TEST_FILES = ["tests/test_native.py"]
+
+
+def _candidate_compilers() -> "list[str]":
+    seen: "list[str]" = []
+    for cc in (os.environ.get("CC"), "clang", "gcc", "cc"):
+        if cc and cc not in seen and shutil.which(cc):
+            seen.append(cc)
+    return seen
+
+
+def _supports_sanitizers(cc: str) -> bool:
+    """Probe-compile an empty TU with the sanitizer flags."""
+    with tempfile.TemporaryDirectory() as td:
+        probe = os.path.join(td, "probe.c")
+        with open(probe, "w") as f:
+            f.write("int main(void) { return 0; }\n")
+        res = subprocess.run(
+            [cc, *SAN_FLAGS, probe, "-o", os.path.join(td, "probe")],
+            capture_output=True, timeout=60)
+        return res.returncode == 0
+
+
+def _find_runtime(cc: str, names: "list[str]") -> "str | None":
+    for name in names:
+        res = subprocess.run([cc, f"-print-file-name={name}"],
+                             capture_output=True, text=True, timeout=30)
+        path = res.stdout.strip()
+        if res.returncode == 0 and path and path != name \
+                and os.path.exists(path):
+            return path
+    return None
+
+
+def _asan_runtime(cc: str) -> "str | None":
+    """Path to the ASan runtime shared object for LD_PRELOAD: gcc
+    ships libasan.so, clang libclang_rt.asan-<arch>.so."""
+    import platform
+
+    return _find_runtime(cc, [
+        "libasan.so",
+        f"libclang_rt.asan-{platform.machine()}.so",
+        "libclang_rt.asan.so"])
+
+
+def _stdcxx_runtime(cc: str) -> "str | None":
+    """libstdc++ must ride the SAME LD_PRELOAD: python itself doesn't
+    link it, so ASan's __cxa_throw interceptor would otherwise resolve
+    its real_ pointer to NULL and abort the first time any bundled C++
+    extension (jaxlib's MLIR bindings) throws."""
+    return _find_runtime(cc, ["libstdc++.so.6", "libstdc++.so",
+                              "libc++.so.1", "libc++.so"])
+
+
+def build(cc: str, out: str) -> bool:
+    include = sysconfig.get_paths()["include"]
+    cmd = [cc, "-g", "-O1", "-fno-omit-frame-pointer", *SAN_FLAGS,
+           "-shared", "-fPIC", "-pthread", f"-I{include}", SRC,
+           "-o", out]
+    print(f"build: {' '.join(cmd)}")
+    res = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    if res.returncode != 0:
+        sys.stderr.write(res.stderr)
+        return False
+    return True
+
+
+def run_tests(out: str, preload: str) -> int:
+    env = dict(os.environ)
+    env["LD_PRELOAD"] = preload
+    env["KLOGS_NATIVE_SO"] = out
+    env.pop("KLOGS_NO_NATIVE", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    # CPython "leaks" its interned state at exit; halt_on_error stays
+    # on for real findings via -fno-sanitize-recover.
+    env["ASAN_OPTIONS"] = "detect_leaks=0"
+    cmd = [sys.executable, "-m", "pytest", *TEST_FILES, "-q",
+           "-p", "no:cacheprovider"]
+    print(f"test: LD_PRELOAD={preload!r} "
+          f"KLOGS_NATIVE_SO={out} {' '.join(cmd)}")
+    return subprocess.run(cmd, cwd=ROOT, env=env, timeout=600).returncode
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.build_native_asan",
+        description="ASan/UBSan build + parity-test run for _hostops.c")
+    ap.add_argument("--out", default=None,
+                    help="output .so path (default: temp dir)")
+    ap.add_argument("--no-run-tests", action="store_true",
+                    help="build only")
+    ns = ap.parse_args(argv)
+
+    if not os.path.exists(SRC):
+        print(f"SKIP: {SRC} not found")
+        return 2
+    chosen = None
+    for cc in _candidate_compilers():
+        if _supports_sanitizers(cc):
+            chosen = cc
+            break
+    if chosen is None:
+        print("SKIP: no compiler supporting -fsanitize=address,"
+              "undefined found (tried CC/clang/gcc/cc) — the sanitizer "
+              "tier needs clang or gcc with libasan/libubsan")
+        return 2
+    asan = _asan_runtime(chosen)
+    if asan is None:
+        print(f"SKIP: {chosen} supports the flags but no ASan runtime "
+              "library was found to LD_PRELOAD")
+        return 2
+    stdcxx = _stdcxx_runtime(chosen)
+    preload = f"{asan} {stdcxx}" if stdcxx else asan
+
+    out = ns.out
+    owned_dir = None
+    if out is None:
+        owned_dir = tempfile.mkdtemp(prefix="klogs-asan-")
+        out = os.path.join(owned_dir, "_hostops_asan.so")
+    try:
+        if not build(chosen, out):
+            print("FAIL: sanitizer build failed")
+            return 1
+        print(f"built {out} with {chosen}")
+        if ns.no_run_tests:
+            return 0
+        rc = run_tests(out, preload)
+        if rc != 0:
+            print(f"FAIL: native parity tests failed under ASan/UBSan "
+                  f"(rc={rc})")
+            return 1
+        print("OK: native parity tests passed under ASan/UBSan")
+        return 0
+    finally:
+        if owned_dir is not None:
+            shutil.rmtree(owned_dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,6 +22,9 @@ import tempfile
 import zipapp
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from klogs_tpu.utils.env import read as env_read  # noqa: E402
 
 MAIN = """\
 from klogs_tpu.cli import main
@@ -45,7 +48,7 @@ def build(outdir: str) -> str:
         # Bake the release version into the artifact (the env override
         # only exists on the build machine; ≙ the reference's -ldflags
         # -X link-time stamp).
-        ver = os.environ.get("KLOGS_BUILD_VERSION")
+        ver = env_read("KLOGS_BUILD_VERSION")
         if ver:
             with open(os.path.join(pkg_dst, "version.py"), "a") as f:
                 f.write(f"\nBUILD_VERSION = {ver!r}  # stamped at build\n")
