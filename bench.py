@@ -115,44 +115,97 @@ def make_patterns(k: int) -> "list[str]":
     return out[:k]
 
 
-def bench_sweep_row(filt, payload: bytes, offsets, k: int,
-                    repeats: int) -> dict:
-    """Sweep-STAGE-only throughput for one K (BENCH_SWEEP.json): the
-    host factor sweep vs the device sweep over the same framed corpus,
-    so the narrowing stage has its own trajectory separate from the
+_SIMD_NAMES = {0: "scalar", 1: "ssse3", 2: "avx2"}
+
+
+def _cpu_model() -> str:
+    """Human CPU identification for BENCH_SWEEP rows: the native-sweep
+    number depends on the SIMD level and the core, so rows are only
+    comparable across machines when both are recorded."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for ln in f:
+                if ln.startswith("model name"):
+                    return ln.split(":", 1)[1].strip()
+    except OSError:
+        pass
+    import platform
+
+    return platform.processor() or platform.machine()
+
+
+def bench_sweep_rows(filt, payload: bytes, offsets, k: int,
+                     repeats: int) -> "list[dict]":
+    """Sweep-STAGE-only throughput for one K (BENCH_SWEEP.json): one
+    row per implementation — ``numpy`` (the vectorized fallback and
+    parity oracle), ``native`` (the SIMD kernel in _hostops.c, with
+    the resolved stage-1 tier and CPU model recorded), ``device`` (the
+    fused on-device sweep, with the jax backend recorded — on the CPU
+    backend the dense sweep is gather-bound and LOSES to both host
+    paths; that measurement is why auto mode only flips the device
+    path on real accelerators) — over the same framed corpus, so the
+    narrowing stage has its own trajectory separate from the
     end-to-end rows in BENCH_K.json.
 
-    The device number is measured on whatever jax backend is up —
-    recorded in the row, because on the CPU backend the dense sweep is
-    gather-bound and LOSES to the host sweep (that measurement is why
-    auto mode only flips the device path on real accelerators). The
-    row also re-asserts host/device mask parity on the bench corpus:
-    a throughput row for a sweep that disagrees would be noise. On a
-    cpu-only install (jax is the optional [tpu] extra) the device half
-    degrades to nulls — the host trajectory is meaningful alone."""
+    Every non-oracle row re-asserts mask parity against the numpy
+    sweep on the corpus: a throughput row for a sweep that disagrees
+    would be noise. Missing implementations (no C toolchain, no jax)
+    degrade to fewer rows with a stderr note — the numpy trajectory
+    is meaningful alone."""
     import numpy as np
 
     from klogs_tpu.filters.base import pack_framed_rows
 
     n = len(offsets) - 1
-    host_best, gm_host = 0.0, None
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        gm_host = filt.index.group_candidates(payload, offsets)
-        host_best = max(host_best, n / (time.perf_counter() - t0))
-
-    row = {
+    base = {
         "k": k,
         "n_lines": n,
-        "host_sweep_lps": round(host_best, 1),
-        "device_sweep_lps": None,
-        "device_vs_host": None,
-        "pack_lps": None,
-        "backend": None,
-        "parity": None,
+        "cpu_model": _cpu_model(),
         "n_factors": filt.index.n_factors,
         "n_groups": len(filt.groups),
+        "simd": None,
+        "backend": None,
+        "pack_lps": None,
     }
+
+    def best_of(run):
+        best, out = 0.0, None
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            out = run()
+            best = max(best, n / (time.perf_counter() - t0))
+        return best, out
+
+    numpy_lps, gm_ref = best_of(
+        lambda: filt.index.group_candidates(payload, offsets,
+                                            impl="numpy"))
+    rows = [dict(base, sweep_impl="numpy",
+                 sweep_lps=round(numpy_lps, 1), vs_numpy=1.0,
+                 parity=True)]
+    msg = f"bench: K={k} sweep numpy={numpy_lps:,.0f} l/s"
+
+    from klogs_tpu import native as _native
+    from klogs_tpu.filters.compiler.index import native_simd_level
+
+    level = native_simd_level()
+    if (_native.hostops is not None
+            and hasattr(_native.hostops, "sweep_candidates")
+            and level is not None):
+        nat_lps, gm_nat = best_of(
+            lambda: filt.index.group_candidates(payload, offsets,
+                                                impl="native"))
+        simd = _SIMD_NAMES.get(
+            int(_native.hostops.sweep_simd_level(int(level))), "scalar")
+        parity = bool(np.array_equal(gm_ref, gm_nat))
+        rows.append(dict(base, sweep_impl="native",
+                         sweep_lps=round(nat_lps, 1),
+                         vs_numpy=round(nat_lps / numpy_lps, 2)
+                         if numpy_lps else None,
+                         parity=parity, simd=simd))
+        msg += f" native[{simd}]={nat_lps:,.0f} l/s parity={parity}"
+    else:
+        msg += " native=unavailable (no toolchain or KLOGS_NATIVE_SIMD=off)"
+
     try:
         import jax
         import jax.numpy as jnp
@@ -162,9 +215,8 @@ def bench_sweep_row(filt, payload: bytes, offsets, k: int,
             sweep_group_candidates,
         )
     except ImportError:
-        print(f"bench: K={k} sweep host={host_best:,.0f} l/s "
-              "device=unavailable (no jax)", file=sys.stderr)
-        return row
+        print(msg + " device=unavailable (no jax)", file=sys.stderr)
+        return rows
 
     st = device_sweep_tables(filt.index.sweep_program())
     lens = np.diff(np.asarray(offsets)).astype(np.int32)
@@ -177,25 +229,19 @@ def bench_sweep_row(filt, payload: bytes, offsets, k: int,
     batch_d = jnp.asarray(batch)
     lens_d = jnp.asarray(lens)
     gm_dev = np.asarray(sweep_group_candidates(st, batch_d, lens_d))
-    dev_best = 0.0
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        jax.block_until_ready(
-            sweep_group_candidates(st, batch_d, lens_d))
-        dev_best = max(dev_best, n / (time.perf_counter() - t0))
-    parity = bool(np.array_equal(gm_host, gm_dev))
-    row.update({
-        "device_sweep_lps": round(dev_best, 1),
-        "device_vs_host": round(dev_best / host_best, 3)
-        if host_best else None,
-        "pack_lps": round(pack_lps, 1),
-        "backend": jax.default_backend(),
-        "parity": parity,
-    })
-    print(f"bench: K={k} sweep host={host_best:,.0f} l/s "
-          f"device[{row['backend']}]={dev_best:,.0f} l/s "
+    dev_best, _ = best_of(
+        lambda: jax.block_until_ready(
+            sweep_group_candidates(st, batch_d, lens_d)))
+    parity = bool(np.array_equal(gm_ref, gm_dev))
+    rows.append(dict(base, sweep_impl="device",
+                     sweep_lps=round(dev_best, 1),
+                     vs_numpy=round(dev_best / numpy_lps, 3)
+                     if numpy_lps else None,
+                     parity=parity, backend=jax.default_backend(),
+                     pack_lps=round(pack_lps, 1)))
+    print(msg + f" device[{jax.default_backend()}]={dev_best:,.0f} l/s "
           f"parity={parity}", file=sys.stderr)
-    return row
+    return rows
 
 
 def bench_k_axis(ks=None, n_lines: "int | None" = None,
@@ -250,8 +296,8 @@ def bench_k_axis(ks=None, n_lines: "int | None" = None,
         # the index-vs-scan-all comparison (it has its own tests).
         filt._bypass_min_lines = 1 << 62
         if sweep_rows is not None:
-            sweep_rows.append(
-                bench_sweep_row(filt, payload, offsets, k, repeats))
+            sweep_rows.extend(
+                bench_sweep_rows(filt, payload, offsets, k, repeats))
         idx_lps, idx_matched = rate(filt)
         ratio = filt.narrowing_ratio
         # Scan-all comparator: SAME groups/tables, narrowing off.
@@ -283,6 +329,10 @@ def bench_k_axis(ks=None, n_lines: "int | None" = None,
         rows.append({
             "k": k,
             "n_lines": len(lines),
+            # Which narrowing implementation the host engine actually
+            # ran (native vs numpy): K rows are only comparable across
+            # machines when this matches.
+            "sweep_impl": filt.index.last_impl,
             "indexed_lps": round(idx_lps, 1),
             "scan_all_lps": round(all_lps, 1),
             "speedup_vs_scan_all": round(idx_lps / all_lps, 2),
@@ -564,9 +614,10 @@ def main() -> None:
             json.dump(payload, f, indent=1)
             f.write("\n")
         sweep_payload = {
-            "metric": "narrowing-stage-only lines/sec: host factor "
-                      "sweep vs device literal sweep, per K (masks "
-                      "parity-checked on the corpus)",
+            "metric": "narrowing-stage-only lines/sec per K and "
+                      "sweep_impl: numpy vs native SIMD vs device "
+                      "literal sweep (masks parity-checked against "
+                      "the numpy oracle on the corpus)",
             "unit": "lines/sec",
             "corpus": payload["corpus"],
             "rows": sweep_rows,

@@ -136,6 +136,44 @@ def _code_at(f: bytes, at: int) -> int:
                           "little" if _LITTLE else "big")
 
 
+# -- native SIMD sweep (klogs_tpu/native/_hostops.c) -------------------
+#
+# The native kernel consumes the SAME packed tables as the device sweep
+# (SweepProgram), serialized into one content-defined blob, plus the
+# Teddy stage-1 nibble masks. Exact verification makes all three
+# implementations (numpy / native / device) produce identical masks.
+_NATIVE_MAGIC = 0x4B535750
+_NATIVE_VERSION = 1
+_TEDDY_BUCKETS = 8
+_TEDDY_M = 4
+# KLOGS_NATIVE_SIMD: stage-1 implementation override. "auto" resolves
+# to the best CPU level at call time; "off" forces the numpy sweep
+# (the extension stays loaded for the other hot loops). "sse2" is
+# accepted as an alias for the ssse3 tier (the kernel clamps to what
+# the CPU really has, so it can only degrade to scalar, never fault).
+_SIMD_CHOICES: "dict[str, int | None]" = {
+    "auto": -1, "avx2": 2, "ssse3": 1, "sse2": 1, "scalar": 0,
+    "off": None,
+}
+_warned_no_native = False
+
+
+def native_simd_level() -> "int | None":
+    """Parsed KLOGS_NATIVE_SIMD: -1 auto, 0/1/2 a pinned stage-1 tier,
+    None = native sweep disabled. Malformed values raise naming the
+    knob (strict dialect: a typo'd SIMD pin silently timing the wrong
+    path would poison every benchmark row)."""
+    from klogs_tpu.utils.env import read
+
+    raw = read("KLOGS_NATIVE_SIMD", "auto") or "auto"
+    try:
+        return _SIMD_CHOICES[raw.strip().lower()]
+    except KeyError:
+        raise ValueError(
+            f"KLOGS_NATIVE_SIMD={raw!r}: expected one of "
+            f"{', '.join(sorted(_SIMD_CHOICES))}") from None
+
+
 @dataclass
 class SweepStats:
     """Narrowing outcome of one swept batch (observability)."""
@@ -209,6 +247,10 @@ class FactorIndex:
             self.guarded[pids] = True
         self._group_of = np.asarray(plan.group_of, dtype=np.int32)
         self._sweep_prog: "Optional[SweepProgram]" = None
+        self._native_blob: "Optional[bytes]" = None
+        # Which implementation produced the last group_candidates mask
+        # ("native" or "numpy"; the device path reports itself).
+        self.last_impl = "numpy"
 
         # Stage-1 union bloom (one gather gates everything) + per-tier
         # discrimination blooms consulted only at surviving positions.
@@ -360,22 +402,171 @@ class FactorIndex:
                 if inside.any():
                     out.append((fi, np.unique(line[inside])))
 
-    def group_candidates(self, payload: bytes,
-                         offsets: np.ndarray) -> np.ndarray:
+    def group_candidates(self, payload: bytes, offsets: np.ndarray,
+                         impl: "str | None" = None) -> np.ndarray:
         """[B, G] bool: True where the line might match a pattern of
         group g (necessary condition). Always-candidate groups are True
-        everywhere. Updates ``last_stats`` with the narrowing outcome."""
+        everywhere. Updates ``last_stats`` with the narrowing outcome.
+
+        ``impl`` pins the sweep implementation: ``"native"`` (the SIMD
+        kernel in the C extension — raises if unavailable), ``"numpy"``
+        (the vectorized fallback, also the parity oracle), or None =
+        auto: native when the extension is loadable and
+        KLOGS_NATIVE_SIMD is not ``off``, else numpy with ONE loud
+        notice per process. ``last_impl`` records what ran."""
+        if impl not in (None, "native", "numpy"):
+            raise ValueError(
+                f"impl={impl!r}: expected native, numpy or None")
         B = len(offsets) - 1
-        gm = np.zeros((B, self.n_groups), dtype=bool)
-        if len(self.always_groups):
-            gm[:, self.always_groups] = True
-        for fi, lines in self._hits(payload, offsets):
-            gm[np.ix_(lines, self.group_ids[fi])] = True
+        gm = None
+        if impl != "numpy":
+            gm = self._native_candidates(payload, offsets,
+                                         required=impl == "native")
+        if gm is None:
+            self.last_impl = "numpy"
+            gm = np.zeros((B, self.n_groups), dtype=bool)
+            if len(self.always_groups):
+                gm[:, self.always_groups] = True
+            for fi, lines in self._hits(payload, offsets):
+                gm[np.ix_(lines, self.group_ids[fi])] = True
+        else:
+            self.last_impl = "native"
         self.last_stats = SweepStats(
             lines=B, groups=self.n_groups,
             candidate_cells=int(gm.sum()),
             candidate_lines=int(gm.any(axis=1).sum()))
         return gm
+
+    def _native_candidates(self, payload: bytes, offsets: np.ndarray,
+                           required: bool = False) -> "np.ndarray | None":
+        """One native-kernel sweep, or None when the fallback should
+        run. The packed blob is built once per index and shared
+        read-only across threads (the kernel releases the GIL for the
+        whole scan)."""
+        global _warned_no_native
+        level = native_simd_level()
+        from klogs_tpu.native import hostops
+
+        ready = (level is not None and hostops is not None
+                 and hasattr(hostops, "sweep_candidates"))
+        if not ready:
+            if required:
+                raise RuntimeError(
+                    "native sweep unavailable (extension not loaded or "
+                    "KLOGS_NATIVE_SIMD=off)")
+            if level is not None and not _warned_no_native:
+                # Loud, once: a fleet silently narrowing 5-10x slower
+                # than provisioned is a capacity incident, not a detail.
+                _warned_no_native = True
+                from klogs_tpu.ui import term
+
+                term.warning(
+                    "native SIMD sweep unavailable (no C toolchain?); "
+                    "narrowing on the numpy sweep for this process")
+            return None
+        off = np.ascontiguousarray(offsets, dtype=np.int32)
+        B = len(off) - 1
+        if B <= 0:
+            return np.zeros((0, self.n_groups), dtype=bool)
+        raw = hostops.sweep_candidates(
+            self.native_sweep_blob(), payload, off, B, int(level))
+        bits = np.frombuffer(raw, dtype="<u4").reshape(B, -1)
+        gm = np.unpackbits(bits.view(np.uint8), axis=1,
+                           bitorder="little")[:, :self.n_groups]
+        return gm.astype(bool)
+
+    def native_sweep_blob(self) -> bytes:
+        """The native kernel's table blob: the default SweepProgram's
+        arrays serialized little-endian behind a fixed i32 header
+        (offsets into the blob; layout mirrored by the enums at the
+        top of the sweep section in _hostops.c), plus the Teddy
+        stage-1 nibble masks — _TEDDY_M (4) window bytes x {low, high}
+        nibble x 16 entries of 8-bucket bitmasks (128 bytes) — and the
+        64 KiB union bloom. Built once per index, cached
+        like ``_sweep_prog``; the blob is plain bytes, so it is
+        immutable and thread-shareable by construction."""
+        if self._native_blob is not None:
+            return self._native_blob
+        prog = self.sweep_program()
+        # Stage-1 tables: 4-deep Teddy nibble masks over each factor's
+        # anchored window (a 3-byte factor's 4th window byte is the
+        # don't-care extension -> wildcard in position 3), plus the
+        # union bloom (fold16 of every probe code of both tiers) the
+        # confirm consults before any hash probe.
+        teddy = np.zeros((_TEDDY_M, 2, 16), dtype=np.uint8)
+        bloom = np.zeros(1 << _BLOOM_BITS, dtype=np.uint8)
+        for f in self.factors:
+            if len(f) >= WIDE:
+                at = _anchor(f, WIDE)
+            elif len(f) >= NARROW:
+                at = _anchor(f, NARROW)
+            else:
+                at = 0
+            w = f[at:at + _TEDDY_M]
+            bucket = np.uint8(
+                1 << ((w[0] ^ (w[1] * 7) ^ (w[2] * 31)) % _TEDDY_BUCKETS))
+            for j in range(_TEDDY_M):
+                if j < len(w):
+                    teddy[j, 0, w[j] & 15] |= bucket
+                    teddy[j, 1, w[j] >> 4] |= bucket
+                else:
+                    teddy[j, 0, :] |= bucket
+                    teddy[j, 1, :] |= bucket
+            # Probe codes are the LITTLE-endian window codes of the
+            # packed tiers (sweep_program's le_code), independent of
+            # host byte order — same fold as the kernel's confirm.
+            if len(f) >= NARROW:
+                code = int.from_bytes(f[at:at + 4].ljust(4, b"\0"),
+                                      "little")
+                bloom[((code * _FIB) & 0xFFFFFFFF) >> 16] = 1
+            else:
+                for ext in range(256):
+                    code = int.from_bytes(f + bytes([ext]), "little")
+                    bloom[((code * _FIB) & 0xFFFFFFFF) >> 16] = 1
+
+        header = np.zeros(32, dtype=np.int32)
+        parts: "list[bytes]" = []
+        pos = len(header.tobytes())
+
+        def put(arr: np.ndarray, dt: str) -> int:
+            nonlocal pos
+            b = np.ascontiguousarray(arr, dtype=dt).tobytes()
+            at = pos
+            parts.append(b)
+            pos += len(b)
+            pad = (-pos) % 4
+            if pad:
+                parts.append(bytes(pad))
+                pos += pad
+            return at
+
+        header[0] = _NATIVE_MAGIC
+        header[1] = _NATIVE_VERSION
+        header[2] = len(prog.fac_len)
+        header[3] = prog.fac_words.shape[1]
+        header[4] = len(prog.always_mask)
+        header[5] = prog.n_groups
+        header[6] = put(teddy.reshape(-1), "u1")
+        header[7] = put(bloom, "u1")
+        header[8] = put(prog.always_mask, "<u4")
+        header[9] = put(prog.fac_len, "<i4")
+        header[10] = put(prog.fac_words.reshape(-1), "<u4")
+        header[11] = put(prog.fac_wmask.reshape(-1), "<u4")
+        header[12] = put(prog.fac_groups.reshape(-1), "<u4")
+        for base, tier in ((13, prog.narrow), (22, prog.wide)):
+            header[base + 0] = len(tier.slot_key)
+            header[base + 1] = len(tier.keys)
+            header[base + 2] = len(tier.fid) if len(tier.keys) else 0
+            header[base + 3] = tier.max_probe
+            header[base + 4] = put(tier.slot_key, "<u4")
+            header[base + 5] = put(tier.slot_eid, "<i4")
+            header[base + 6] = put(tier.bucket_start, "<i4")
+            header[base + 7] = put(tier.fid, "<i4")
+            header[base + 8] = put(tier.anchor, "<i4")
+        header[31] = pos
+        self._native_blob = header.astype("<i4").tobytes() + b"".join(parts)
+        assert len(self._native_blob) == pos
+        return self._native_blob
 
     def pattern_candidates(self, payload: bytes,
                            offsets: np.ndarray) -> np.ndarray:

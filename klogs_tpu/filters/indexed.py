@@ -134,6 +134,7 @@ class IndexedFilter(LogFilter):
         self._m_sweep_s = r.family("klogs_sweep_seconds")
         self._m_sweep_fallback = r.family("klogs_sweep_fallback_total")
         self._m_bypass = r.family("klogs_sweep_bypass_total")
+        self._m_sweep_impl = r.family("klogs_sweep_impl_batches_total")
 
         self.narrow = narrow
         self.infos: "list[PatternInfo]" = analyze(
@@ -290,6 +291,11 @@ class IndexedFilter(LogFilter):
             self.candidate_lines += cand_lines
             ratio = cand_cells / (B * G) if B and G else 1.0
             self._m_ratio.observe(ratio)
+            # Which implementation narrowed: the device kernel, the
+            # native SIMD kernel, or the numpy fallback (host path).
+            impl = ("device" if path == "device"
+                    else self.index.last_impl)
+            self._m_sweep_impl.labels(impl=impl).inc()
             self._m_sweep_batches.labels(path=path).inc()
             self._m_sweep_lines.labels(path=path).inc(B)
             self._m_sweep_cand.labels(path=path).inc(cand_lines)

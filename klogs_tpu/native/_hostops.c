@@ -1116,6 +1116,497 @@ join_kept(PyObject *self, PyObject *args)
     return buf;
 }
 
+/* ================= SIMD literal sweep (factor-index narrowing) =======
+ *
+ * sweep_candidates(blob, payload, offsets, n_lines, simd)
+ *     -> bytes holding u32[n_lines, GW] little-endian group bitsets
+ *
+ * Native twin of FactorIndex.group_candidates (filters/compiler/
+ * index.py) in the Hyperscan-FDR/Teddy shape: stage 1 is a SIMD shufti
+ * over the payload — per byte position, four nibble-LUT lookups AND'd
+ * across the first four bytes of every factor's rarest anchored window
+ * (8 bucket bits per byte, so unrelated factor families don't dilute
+ * each other's predicate; a 3-byte factor's 4th window byte is its
+ * don't-care extension -> wildcard position) — then a 64 KiB union-
+ * bloom gate on the exact 4-byte code, and only positions surviving
+ * BOTH pay the exact two-tier hash probe + masked-word verify. The tables ARE
+ * the device SweepProgram's (packed by FactorIndex.native_sweep_blob):
+ * narrow tier keyed on the LE 4-byte window code (3-byte factors as
+ * 256 one-byte extensions), wide tier on the Fibonacci mix of two
+ * chained half-window codes, open-addressed hash probe bounded by
+ * max_probe, exact factor verify as masked u32 compares, per-factor
+ * group bitset accumulate, always_mask pre-set on every row. Exact
+ * verification makes the mask byte-identical to both the numpy and
+ * the device sweeps (the three-way parity oracle in
+ * tests/test_native_sweep.py).
+ *
+ * Dispatch: AVX2 (32-wide) -> SSSE3 (16-wide) -> portable scalar
+ * (256-entry byte LUTs), resolved at runtime from CPUID and clamped
+ * by the caller's `simd` argument (KLOGS_NATIVE_SIMD, parsed in
+ * Python). The whole scan — offsets validation, padded copy, stage 1,
+ * confirms — runs inside Py_BEGIN_ALLOW_THREADS over borrowed
+ * read-only buffers and call-local scratch: the coalescer's fetch
+ * pool overlaps sweeps with packing and device fetches, and the
+ * packed tables are shareable across threads (no statics touched).
+ */
+
+#define SWEEP_MAGIC 0x4B535750  /* "PWSK" little-endian */
+#define SWEEP_VERSION 1
+#define SWEEP_FIB 2654435761u
+#define SWEEP_PAD 64            /* zero tail: widest SIMD load + code/verify overreach */
+
+/* Header word indexes (i32 each; see FactorIndex.native_sweep_blob). */
+enum {
+    SH_MAGIC = 0, SH_VERSION, SH_F, SH_NW, SH_GW, SH_G,
+    SH_TEDDY_OFF, SH_BLOOM_OFF, SH_ALWAYS_OFF, SH_FACLEN_OFF,
+    SH_FACWORDS_OFF, SH_FACWMASK_OFF, SH_FACGROUPS_OFF,
+    SH_NARROW = 13,             /* 9 words per tier */
+    SH_WIDE = 22,
+    SH_TOTAL = 31,
+    SH_WORDS = 32,
+};
+#define SWEEP_TEDDY_M 4         /* stage-1 window bytes (shufti AND depth) */
+#define SWEEP_BLOOM_SIZE 65536  /* union bloom: fold16 of every probe code */
+enum { ST_H = 0, ST_E, ST_NE, ST_MAXPROBE,
+       ST_SLOTKEY_OFF, ST_SLOTEID_OFF, ST_BSTART_OFF, ST_FID_OFF,
+       ST_ANCHOR_OFF };
+
+typedef struct {
+    uint32_t H, E, NE, max_probe, bits;
+    const uint32_t *slot_key;   /* [H] */
+    const int32_t *slot_eid;    /* [H], -1 = empty */
+    const int32_t *bucket_start;  /* [E+1] */
+    const int32_t *fid;         /* [NE] */
+    const int32_t *anchor;      /* [NE] */
+} sweep_tier_c;
+
+typedef struct {
+    int32_t F, NW, GW, G;
+    sweep_tier_c narrow, wide;
+    const int32_t *fac_len;     /* [F] */
+    const uint32_t *fac_words;  /* [F, NW] LE */
+    const uint32_t *fac_wmask;  /* [F, NW] */
+    const uint32_t *fac_groups; /* [F, GW] */
+    const uint32_t *always;     /* [GW] */
+    const uint8_t *teddy;       /* [M][2][16] nibble bucket masks */
+    const uint8_t *bloom;       /* [65536] union bloom over probe codes */
+} sweep_prog_c;
+
+static inline uint32_t
+sweep_le32(const uint8_t *p)
+{
+    uint32_t v;
+    memcpy(&v, p, 4);
+#if defined(__BYTE_ORDER__) && __BYTE_ORDER__ == __ORDER_BIG_ENDIAN__
+    v = __builtin_bswap32(v);
+#endif
+    return v;
+}
+
+/* Bounds-checked array slice out of the blob; returns NULL on a
+ * malformed offset (caller maps to ValueError). */
+static const void *
+sweep_arr(const char *blob, Py_ssize_t blen, int32_t off, int64_t count,
+          int64_t elem)
+{
+    if (off < 0 || (off & 3) || count < 0
+        || (int64_t)off + count * elem > (int64_t)blen)
+        return NULL;
+    return blob + off;
+}
+
+static int
+sweep_parse_tier(const char *blob, Py_ssize_t blen, const int32_t *h,
+                 sweep_tier_c *t)
+{
+    t->H = (uint32_t)h[ST_H];
+    t->E = (uint32_t)h[ST_E];
+    t->NE = (uint32_t)h[ST_NE];
+    t->max_probe = (uint32_t)h[ST_MAXPROBE];
+    if (t->H & (t->H - 1))
+        return -1;              /* hash size must be a power of two */
+    t->bits = 0;
+    for (uint32_t x = t->H; x > 1; x >>= 1)
+        t->bits++;
+    /* A probeable tier needs H >= 2: bits=0 would make the probe's
+     * `>> (32 - bits)` a shift-by-32 (UB). Internally packed tables
+     * are always H >= 16; this guards the untrusted-blob contract. */
+    if (t->max_probe > t->H || t->bits >= 32
+        || (t->max_probe && t->H < 2))
+        return -1;
+    t->slot_key = sweep_arr(blob, blen, h[ST_SLOTKEY_OFF], t->H, 4);
+    t->slot_eid = sweep_arr(blob, blen, h[ST_SLOTEID_OFF], t->H, 4);
+    t->bucket_start = sweep_arr(blob, blen, h[ST_BSTART_OFF],
+                                (int64_t)t->E + 1, 4);
+    t->fid = sweep_arr(blob, blen, h[ST_FID_OFF], t->NE, 4);
+    t->anchor = sweep_arr(blob, blen, h[ST_ANCHOR_OFF], t->NE, 4);
+    if (!t->slot_key || !t->slot_eid || !t->bucket_start || !t->fid
+        || !t->anchor)
+        return -1;
+    return 0;
+}
+
+static int
+sweep_parse_blob(const char *blob, Py_ssize_t blen, sweep_prog_c *sp)
+{
+    if (blen < SH_WORDS * 4)
+        return -1;
+    const int32_t *h = (const int32_t *)blob;
+    if (h[SH_MAGIC] != SWEEP_MAGIC || h[SH_VERSION] != SWEEP_VERSION
+        || h[SH_TOTAL] != (int32_t)blen)
+        return -1;
+    sp->F = h[SH_F];
+    sp->NW = h[SH_NW];
+    sp->GW = h[SH_GW];
+    sp->G = h[SH_G];
+    if (sp->F < 1 || sp->NW < 1 || sp->GW < 1 || sp->G < 1)
+        return -1;
+    sp->teddy = sweep_arr(blob, blen, h[SH_TEDDY_OFF],
+                          SWEEP_TEDDY_M * 32, 1);
+    sp->bloom = sweep_arr(blob, blen, h[SH_BLOOM_OFF],
+                          SWEEP_BLOOM_SIZE, 1);
+    sp->always = sweep_arr(blob, blen, h[SH_ALWAYS_OFF], sp->GW, 4);
+    sp->fac_len = sweep_arr(blob, blen, h[SH_FACLEN_OFF], sp->F, 4);
+    sp->fac_words = sweep_arr(blob, blen, h[SH_FACWORDS_OFF],
+                              (int64_t)sp->F * sp->NW, 4);
+    sp->fac_wmask = sweep_arr(blob, blen, h[SH_FACWMASK_OFF],
+                              (int64_t)sp->F * sp->NW, 4);
+    sp->fac_groups = sweep_arr(blob, blen, h[SH_FACGROUPS_OFF],
+                               (int64_t)sp->F * sp->GW, 4);
+    if (!sp->teddy || !sp->bloom || !sp->always || !sp->fac_len
+        || !sp->fac_words || !sp->fac_wmask || !sp->fac_groups)
+        return -1;
+    if (sweep_parse_tier(blob, blen, (const int32_t *)blob + SH_NARROW,
+                         &sp->narrow) < 0
+        || sweep_parse_tier(blob, blen, (const int32_t *)blob + SH_WIDE,
+                            &sp->wide) < 0)
+        return -1;
+    /* Entry tables index factors and buckets; validate once here so
+     * the hot confirm loop can trust them. */
+    for (int tix = 0; tix < 2; tix++) {
+        const sweep_tier_c *t = tix ? &sp->wide : &sp->narrow;
+        for (uint32_t i = 0; i < t->H; i++)
+            if (t->slot_eid[i] >= (int32_t)t->E)
+                return -1;
+        for (uint32_t i = 0; i <= t->E; i++)
+            if (t->bucket_start[i] < 0
+                || t->bucket_start[i] > (int32_t)t->NE
+                || (i && t->bucket_start[i] < t->bucket_start[i - 1]))
+                return -1;
+        for (uint32_t i = 0; i < t->NE; i++)
+            if (t->fid[i] < 0 || t->fid[i] >= sp->F || t->anchor[i] < 0)
+                return -1;
+    }
+    /* fac_len 0 is the zero-factor index's padding row (never
+     * referenced by any tier entry — both tiers are empty there). */
+    for (int32_t i = 0; i < sp->F; i++)
+        if (sp->fac_len[i] < 0 || (sp->fac_len[i] + 3) / 4 > sp->NW)
+            return -1;
+    return 0;
+}
+
+/* Exact resolution of one stage-1 survivor against one tier: hash
+ * probe -> bucket run -> masked-word factor verify -> line bounds ->
+ * group bitset accumulate. Mirrors FactorIndex._emit exactly: the
+ * line is the one containing the FACTOR START q (not the probe
+ * window), and the factor's own bytes must sit inside it. */
+static void
+sweep_probe_tier(const sweep_prog_c *sp, const sweep_tier_c *t,
+                 uint32_t key, const uint8_t *pad, Py_ssize_t n,
+                 const int32_t *ov, Py_ssize_t B, Py_ssize_t pos,
+                 uint32_t *out)
+{
+    uint32_t h = (uint32_t)(key * SWEEP_FIB) >> (32 - t->bits);
+    int32_t eid = -1;
+    for (uint32_t j = 0; j < t->max_probe; j++) {
+        uint32_t s = (h + j) & (t->H - 1);
+        int32_t e = t->slot_eid[s];
+        if (e < 0)
+            return;             /* empty slot ends the probe cluster */
+        if (t->slot_key[s] == key) {
+            eid = e;
+            break;
+        }
+    }
+    if (eid < 0)
+        return;
+    for (int32_t bi = t->bucket_start[eid]; bi < t->bucket_start[eid + 1];
+         bi++) {
+        int32_t fi = t->fid[bi];
+        Py_ssize_t q = pos - t->anchor[bi];
+        int32_t L = sp->fac_len[fi];
+        if (q < 0 || q + L > n)
+            continue;
+        int32_t W = (L + 3) / 4;
+        int ok = 1;
+        for (int32_t w = 0; w < W; w++) {
+            if ((sweep_le32(pad + q + 4 * (Py_ssize_t)w)
+                 & sp->fac_wmask[(size_t)fi * sp->NW + w])
+                != sp->fac_words[(size_t)fi * sp->NW + w]) {
+                ok = 0;
+                break;
+            }
+        }
+        if (!ok || q < ov[0])
+            continue;
+        /* Largest line with ov[line] <= q (searchsorted right - 1). */
+        Py_ssize_t a = 0, b = B + 1;
+        while (b - a > 1) {
+            Py_ssize_t m = a + (b - a) / 2;
+            if ((Py_ssize_t)ov[m] <= q)
+                a = m;
+            else
+                b = m;
+        }
+        if (a >= B || q + L > (Py_ssize_t)ov[a + 1])
+            continue;
+        uint32_t *row = out + (size_t)a * sp->GW;
+        for (int32_t k = 0; k < sp->GW; k++)
+            row[k] |= sp->fac_groups[(size_t)fi * sp->GW + k];
+    }
+}
+
+static void
+sweep_confirm(const sweep_prog_c *sp, const uint8_t *pad, Py_ssize_t n,
+              const int32_t *ov, Py_ssize_t B, Py_ssize_t pos,
+              uint32_t *out)
+{
+    /* Union-bloom gate first (fold16 of the position's 4-byte code,
+     * covering BOTH tiers' probe codes — the numpy sweep's stage-1
+     * twin): the nibble-LUT stage over-approximates heavily on
+     * digit-dense corpora, and this one multiply + cache-resident
+     * byte load rules out ~95% of its survivors before any hash
+     * probe is paid. */
+    uint32_t code = sweep_le32(pad + pos);
+    if (!sp->bloom[(uint32_t)(code * SWEEP_FIB) >> 16])
+        return;
+    if (sp->narrow.max_probe)
+        sweep_probe_tier(sp, &sp->narrow, code, pad, n, ov, B, pos, out);
+    if (sp->wide.max_probe) {
+        uint32_t lo = sweep_le32(pad + pos + 4);
+        sweep_probe_tier(sp, &sp->wide,
+                         (uint32_t)(code * SWEEP_FIB) ^ lo,
+                         pad, n, ov, B, pos, out);
+    }
+}
+
+/* Portable scalar stage 1: the nibble masks expanded once into three
+ * 256-entry byte LUTs (cache-resident), then 3 loads + 2 ANDs per
+ * position. Also the tail/readability reference for the SIMD paths. */
+static void
+sweep_scan_scalar(const sweep_prog_c *sp, const uint8_t *pad,
+                  Py_ssize_t n, const int32_t *ov, Py_ssize_t B,
+                  uint32_t *out)
+{
+    uint8_t lut[SWEEP_TEDDY_M][256];
+    for (int j = 0; j < SWEEP_TEDDY_M; j++) {
+        const uint8_t *lo = sp->teddy + j * 32;
+        const uint8_t *hi = lo + 16;
+        for (int c = 0; c < 256; c++)
+            lut[j][c] = (uint8_t)(lo[c & 15] & hi[c >> 4]);
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        if (lut[0][pad[i]] & lut[1][pad[i + 1]] & lut[2][pad[i + 2]]
+            & lut[3][pad[i + 3]])
+            sweep_confirm(sp, pad, n, ov, B, i, out);
+    }
+}
+
+#if defined(__x86_64__) && (defined(__GNUC__) || defined(__clang__))
+#define SWEEP_HAVE_X86 1
+#include <immintrin.h>
+
+__attribute__((target("ssse3"))) static void
+sweep_scan_ssse3(const sweep_prog_c *sp, const uint8_t *pad,
+                 Py_ssize_t n, const int32_t *ov, Py_ssize_t B,
+                 uint32_t *out)
+{
+    const __m128i lowm = _mm_set1_epi8(0x0f);
+    __m128i tl[SWEEP_TEDDY_M], th[SWEEP_TEDDY_M];
+    for (int j = 0; j < SWEEP_TEDDY_M; j++) {
+        tl[j] = _mm_loadu_si128((const __m128i *)(sp->teddy + j * 32));
+        th[j] = _mm_loadu_si128(
+            (const __m128i *)(sp->teddy + j * 32 + 16));
+    }
+    for (Py_ssize_t i = 0; i < n; i += 16) {
+        __m128i m = _mm_set1_epi8((char)0xff);
+        for (int j = 0; j < SWEEP_TEDDY_M; j++) {
+            __m128i d = _mm_loadu_si128((const __m128i *)(pad + i + j));
+            __m128i lo = _mm_shuffle_epi8(tl[j], _mm_and_si128(d, lowm));
+            __m128i hi = _mm_shuffle_epi8(
+                th[j],
+                _mm_and_si128(_mm_srli_epi16(d, 4), lowm));
+            m = _mm_and_si128(m, _mm_and_si128(lo, hi));
+        }
+        int bits = _mm_movemask_epi8(
+            _mm_cmpeq_epi8(m, _mm_setzero_si128())) ^ 0xffff;
+        while (bits) {
+            int b = __builtin_ctz((unsigned)bits);
+            bits &= bits - 1;
+            Py_ssize_t pos = i + b;
+            if (pos < n)
+                sweep_confirm(sp, pad, n, ov, B, pos, out);
+        }
+    }
+}
+
+__attribute__((target("avx2"))) static void
+sweep_scan_avx2(const sweep_prog_c *sp, const uint8_t *pad,
+                Py_ssize_t n, const int32_t *ov, Py_ssize_t B,
+                uint32_t *out)
+{
+    const __m256i lowm = _mm256_set1_epi8(0x0f);
+    __m256i tl[SWEEP_TEDDY_M], th[SWEEP_TEDDY_M];
+    for (int j = 0; j < SWEEP_TEDDY_M; j++) {
+        tl[j] = _mm256_broadcastsi128_si256(
+            _mm_loadu_si128((const __m128i *)(sp->teddy + j * 32)));
+        th[j] = _mm256_broadcastsi128_si256(
+            _mm_loadu_si128((const __m128i *)(sp->teddy + j * 32 + 16)));
+    }
+    for (Py_ssize_t i = 0; i < n; i += 32) {
+        __m256i m = _mm256_set1_epi8((char)0xff);
+        for (int j = 0; j < SWEEP_TEDDY_M; j++) {
+            __m256i d = _mm256_loadu_si256(
+                (const __m256i *)(pad + i + j));
+            __m256i lo = _mm256_shuffle_epi8(tl[j],
+                                             _mm256_and_si256(d, lowm));
+            __m256i hi = _mm256_shuffle_epi8(
+                th[j],
+                _mm256_and_si256(_mm256_srli_epi16(d, 4), lowm));
+            m = _mm256_and_si256(m, _mm256_and_si256(lo, hi));
+        }
+        uint32_t bits = ~(uint32_t)_mm256_movemask_epi8(
+            _mm256_cmpeq_epi8(m, _mm256_setzero_si256()));
+        while (bits) {
+            int b = __builtin_ctz(bits);
+            bits &= bits - 1;
+            Py_ssize_t pos = i + b;
+            if (pos < n)
+                sweep_confirm(sp, pad, n, ov, B, pos, out);
+        }
+    }
+}
+
+static int
+sweep_cpu_level(void)
+{
+    if (__builtin_cpu_supports("avx2"))
+        return 2;
+    if (__builtin_cpu_supports("ssse3"))
+        return 1;
+    return 0;
+}
+#else
+static int
+sweep_cpu_level(void)
+{
+    return 0;
+}
+#endif
+
+/* requested: -1 auto, 0 scalar, 1 ssse3, 2 avx2 — clamped to what the
+ * CPU actually has, so a pinned KLOGS_NATIVE_SIMD=avx2 on an old box
+ * degrades to the best real level instead of faulting. */
+static int
+sweep_resolve_level(int requested)
+{
+    int cpu = sweep_cpu_level();
+    if (requested < 0 || requested > cpu)
+        return cpu;
+    return requested;
+}
+
+static PyObject *
+sweep_simd_level(PyObject *self, PyObject *args)
+{
+    int requested = -1;
+    if (!PyArg_ParseTuple(args, "|i", &requested))
+        return NULL;
+    return PyLong_FromLong(sweep_resolve_level(requested));
+}
+
+static PyObject *
+sweep_candidates(PyObject *self, PyObject *args)
+{
+    Py_buffer blob, payload, offs;
+    Py_ssize_t B;
+    int requested;
+    if (!PyArg_ParseTuple(args, "y*y*y*ni", &blob, &payload, &offs, &B,
+                          &requested))
+        return NULL;
+    sweep_prog_c sp;
+    if (B < 0 || offs.len < (B + 1) * 4
+        || sweep_parse_blob((const char *)blob.buf, blob.len, &sp) < 0) {
+        PyBuffer_Release(&blob);
+        PyBuffer_Release(&payload);
+        PyBuffer_Release(&offs);
+        PyErr_SetString(PyExc_ValueError,
+                        "sweep_candidates: malformed tables or sizes");
+        return NULL;
+    }
+    const Py_ssize_t n = payload.len;
+    PyObject *mask = PyBytes_FromStringAndSize(
+        NULL, B * (Py_ssize_t)sp.GW * 4);
+    uint8_t *pad = PyMem_Malloc((size_t)n + SWEEP_PAD);
+    if (!mask || !pad) {
+        PyBuffer_Release(&blob);
+        PyBuffer_Release(&payload);
+        PyBuffer_Release(&offs);
+        Py_XDECREF(mask);
+        PyMem_Free(pad);
+        return PyErr_NoMemory();
+    }
+    const int32_t *ov = (const int32_t *)offs.buf;
+    uint32_t *out = (uint32_t *)PyBytes_AS_STRING(mask);
+    int level = sweep_resolve_level(requested);
+    int bad = 0;
+
+    Py_BEGIN_ALLOW_THREADS
+    /* Offsets must be non-decreasing within the payload: the confirm
+     * loop's binary search trusts them. */
+    if (ov[0] < 0 || (Py_ssize_t)ov[B] > n)
+        bad = 1;
+    for (Py_ssize_t i = 0; i < B && !bad; i++)
+        if (ov[i] > ov[i + 1])
+            bad = 1;
+    if (!bad) {
+        if (n)
+            memcpy(pad, payload.buf, n);
+        memset(pad + n, 0, SWEEP_PAD);
+        /* Every row starts as the always-candidate mask (groups owning
+         * unguarded patterns), exactly like the host sweep. */
+        for (Py_ssize_t i = 0; i < B; i++)
+            memcpy(out + (size_t)i * sp.GW, sp.always,
+                   (size_t)sp.GW * 4);
+        if (n >= 3) {
+#if SWEEP_HAVE_X86
+            if (level >= 2)
+                sweep_scan_avx2(&sp, pad, n, ov, B, out);
+            else if (level == 1)
+                sweep_scan_ssse3(&sp, pad, n, ov, B, out);
+            else
+                sweep_scan_scalar(&sp, pad, n, ov, B, out);
+#else
+            (void)level;
+            sweep_scan_scalar(&sp, pad, n, ov, B, out);
+#endif
+        }
+    }
+    Py_END_ALLOW_THREADS
+
+    PyMem_Free(pad);
+    PyBuffer_Release(&blob);
+    PyBuffer_Release(&payload);
+    PyBuffer_Release(&offs);
+    if (bad) {
+        Py_DECREF(mask);
+        PyErr_SetString(PyExc_ValueError,
+                        "sweep_candidates: offsets out of range");
+        return NULL;
+    }
+    return mask;
+}
+
 static PyMethodDef Methods[] = {
     {"pack_lines", pack_lines, METH_VARARGS,
      "pack_lines(lines, width, rows) -> (bytes, int32-lengths-bytes)"},
@@ -1142,6 +1633,12 @@ static PyMethodDef Methods[] = {
      "find_newlines(data, base) -> int32 after-newline positions"},
     {"join_kept_framed", join_kept_framed, METH_VARARGS,
      "join_kept_framed(payload, offsets, n, mask) -> bytes"},
+    {"sweep_candidates", sweep_candidates, METH_VARARGS,
+     "sweep_candidates(blob, payload, offsets, n_lines, simd)"
+     " -> u32[n_lines, GW] group-bitset bytes"},
+    {"sweep_simd_level", sweep_simd_level, METH_VARARGS,
+     "sweep_simd_level(requested=-1) -> resolved SIMD level"
+     " (0 scalar, 1 ssse3, 2 avx2)"},
     {NULL, NULL, 0, NULL},
 };
 

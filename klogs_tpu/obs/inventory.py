@@ -174,6 +174,12 @@ SPECS: dict[str, dict] = {
         "histogram", "Sweep-stage latency per batch, by stage.",
         labels=("path",), buckets=LATENCY_BUCKETS,
         bounds={"path": "enum"}),
+    "klogs_sweep_impl_batches_total": _m(
+        "counter", "Batches narrowed by the literal sweep, by "
+        "IMPLEMENTATION: device (fused on-device sweep), native (SIMD "
+        "kernel in the C extension, the host default), or numpy (the "
+        "vectorized fallback when no toolchain or KLOGS_NATIVE_SIMD="
+        "off).", labels=("impl",), bounds={"impl": "enum"}),
     "klogs_sweep_fallback_total": _m(
         "counter", "Device-sweep degrades: build or kernel failures "
         "that dropped a batch (and every later one) to the fallback "

@@ -1239,6 +1239,93 @@ def test_native_tier_real_tree_clean():
     assert _active(REPO, "native-tier") == []
 
 
+# The SIMD sweep port's two new failure shapes (docs/NATIVE.md): a
+# CPython API call inside the GIL-released SIMD block, and buffers
+# left unreleased on a CPU-dispatch early-exit path.
+_C_SIMD_LEAKY = """
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+static PyObject *
+sweepy(PyObject *self, PyObject *args)
+{
+    Py_buffer blob, payload;
+    int level;
+    if (!PyArg_ParseTuple(args, "y*y*i", &blob, &payload, &level))
+        return NULL;
+    char *pad = PyMem_Malloc(payload.len + 64);
+    pad[0] = 0;
+    if (level > 2) {
+        PyMem_Free(pad);
+        PyErr_SetString(PyExc_ValueError, "no such SIMD tier");
+        return NULL;
+    }
+    Py_BEGIN_ALLOW_THREADS
+    PyErr_CheckSignals();
+    Py_END_ALLOW_THREADS
+    PyMem_Free(pad);
+    PyBuffer_Release(&blob);
+    PyBuffer_Release(&payload);
+    Py_RETURN_NONE;
+}
+"""
+
+_C_SIMD_CLEAN = """
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <string.h>
+
+static PyObject *
+sweepy(PyObject *self, PyObject *args)
+{
+    Py_buffer blob, payload;
+    int level;
+    if (!PyArg_ParseTuple(args, "y*y*i", &blob, &payload, &level))
+        return NULL;
+    char *pad = PyMem_Malloc(payload.len + 64);
+    if (!pad) {
+        PyBuffer_Release(&blob);
+        PyBuffer_Release(&payload);
+        return PyErr_NoMemory();
+    }
+    if (level > 2) {
+        PyMem_Free(pad);
+        PyBuffer_Release(&blob);
+        PyBuffer_Release(&payload);
+        PyErr_SetString(PyExc_ValueError, "no such SIMD tier");
+        return NULL;
+    }
+    Py_BEGIN_ALLOW_THREADS
+    memset(pad, 0, 64);
+    Py_END_ALLOW_THREADS
+    PyMem_Free(pad);
+    PyBuffer_Release(&blob);
+    PyBuffer_Release(&payload);
+    Py_RETURN_NONE;
+}
+"""
+
+
+def test_native_tier_simd_sweep_seeded(tmp_path):
+    """The SIMD-port failure modes the lint must catch: interpreter
+    API with the GIL released, a raw allocation, and an early-exit
+    dispatch path that leaks both acquired buffers."""
+    root = _tree(tmp_path, {"klogs_tpu/native/sweep_bad.c": _C_SIMD_LEAKY})
+    found = _active(root, "native-tier")
+    msgs = "\n".join(f.message for f in found)
+    assert "'PyErr_CheckSignals'" in msgs and "GIL-released" in msgs
+    assert "not NULL-checked" in msgs
+    assert "return without PyBuffer_Release(&blob)" in msgs
+    assert "return without PyBuffer_Release(&payload)" in msgs
+
+
+def test_native_tier_simd_sweep_clean(tmp_path):
+    """The same function shaped per docs/NATIVE.md's rules (checked
+    alloc, every exit releases, pure-C GIL block) raises nothing."""
+    root = _tree(tmp_path, {"klogs_tpu/native/sweep_good.c": _C_SIMD_CLEAN})
+    assert _active(root, "native-tier") == []
+
+
 # -- suppression-audit -------------------------------------------------
 
 def test_suppression_audit_stale_and_unknown(tmp_path):

@@ -94,15 +94,30 @@ def test_bench_k_axis_contract(tmp_path):
     # the production path.
     assert rec["rows"][1]["auto_engine"] == "indexed"
     # The narrowing stage's own trajectory rides along: one
-    # BENCH_SWEEP row per K, host vs device sweep, and the masks must
-    # have agreed on the corpus (parity is measured, not assumed).
+    # BENCH_SWEEP row per K per sweep_impl (numpy / native / device),
+    # and every non-oracle row's mask must have agreed with the numpy
+    # oracle on the corpus (parity is measured, not assumed).
     sw = json.loads(sweep_out.read_text())
-    assert [r["k"] for r in sw["rows"]] == [8, 64]
+    by_impl: dict = {}
     for row in sw["rows"]:
-        assert row["host_sweep_lps"] > 0
-        assert row["device_sweep_lps"] > 0
-        assert row["backend"]
+        by_impl.setdefault(row["sweep_impl"], []).append(row)
+        assert row["sweep_lps"] > 0
         assert row["parity"] is True
+        assert row["cpu_model"]
+    assert [r["k"] for r in by_impl["numpy"]] == [8, 64]
+    # jax is importable in this environment, so device rows exist.
+    assert [r["k"] for r in by_impl["device"]] == [8, 64]
+    for row in by_impl["device"]:
+        assert row["backend"]
+    from klogs_tpu import native as _native
+
+    if _native.hostops is not None and hasattr(_native.hostops,
+                                               "sweep_candidates"):
+        assert [r["k"] for r in by_impl["native"]] == [8, 64]
+        for row in by_impl["native"]:
+            assert row["simd"] in ("scalar", "ssse3", "avx2")
+            assert row["vs_numpy"] > 0
+    assert rec["rows"][0]["sweep_impl"] in ("native", "numpy")
 
 
 def test_graft_entry_contract():

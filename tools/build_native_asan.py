@@ -35,7 +35,11 @@ import tempfile
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SRC = os.path.join(ROOT, "klogs_tpu", "native", "_hostops.c")
 SAN_FLAGS = ["-fsanitize=address,undefined", "-fno-sanitize-recover=all"]
-TEST_FILES = ["tests/test_native.py"]
+# The sweep parity suite rides along so the GIL-released SIMD kernel
+# (unaligned loads, masked tails, hash probes over untrusted offsets)
+# is exercised under ASan/UBSan in every tier-1 run; its `slow` loops
+# are excluded to keep the gate fast.
+TEST_FILES = ["tests/test_native.py", "tests/test_native_sweep.py"]
 
 
 def _candidate_compilers() -> "list[str]":
@@ -112,7 +116,7 @@ def run_tests(out: str, preload: str) -> int:
     # on for real findings via -fno-sanitize-recover.
     env["ASAN_OPTIONS"] = "detect_leaks=0"
     cmd = [sys.executable, "-m", "pytest", *TEST_FILES, "-q",
-           "-p", "no:cacheprovider"]
+           "-m", "not slow", "-p", "no:cacheprovider"]
     print(f"test: LD_PRELOAD={preload!r} "
           f"KLOGS_NATIVE_SO={out} {' '.join(cmd)}")
     return subprocess.run(cmd, cwd=ROOT, env=env, timeout=600).returncode

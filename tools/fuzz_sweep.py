@@ -1,0 +1,181 @@
+"""Differential fuzz of the native SIMD sweep vs the numpy sweep.
+
+The native literal sweep (``sweep_candidates`` in
+``klogs_tpu/native/_hostops.c``) must produce BYTE-IDENTICAL
+group-candidate masks to ``FactorIndex.group_candidates``'s vectorized
+numpy path — that equality is what lets the numpy sweep act as the
+parity oracle for hand-written SIMD C (and, transitively, for the
+device sweep, which is oracled against the same numpy masks in
+tests/test_sweep.py). This fuzzer generates adversarial pattern sets ×
+framed payloads and asserts full mask equality every trial, rotating
+KLOGS_NATIVE_SIMD across all stage-1 tiers (scalar / ssse3 / avx2 /
+auto) so every kernel variant is exercised.
+
+Deliberately covered shapes (the cases a buffer-arithmetic slip would
+miss silently):
+
+- factors in every tier: 3-byte (256-extension), narrow (4-7B), wide
+  (>= 8B), and past SWEEP_FACTOR_CAP (swept as a rarest 24B window);
+- factors planted at offset 0, flush against the line end, exactly the
+  line, one byte short of fitting;
+- a factor SPLIT across two adjacent framed lines (must count for
+  neither — the cross-line false positive);
+- empty lines, empty payloads, runs of duplicate offsets;
+- OR-guard alternations and unguarded patterns (always-candidate
+  groups).
+
+Usage: python tools/fuzz_sweep.py [--trials N] [--seed S]
+Exit 1 on any divergence (repro line printed), 2 = SKIP when the
+native extension is unavailable. A seeded fast subset runs in tier-1
+(tests/test_native_sweep.py); the default loop here is the long form.
+"""
+
+import argparse
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from klogs_tpu.filters.base import frame_lines  # noqa: E402
+from klogs_tpu.filters.compiler.groups import analyze, plan_groups  # noqa: E402
+from klogs_tpu.filters.compiler.index import (  # noqa: E402
+    SWEEP_FACTOR_CAP,
+    FactorIndex,
+)
+
+ALPHA = b"abcdef0123-=/ :\t.XYZ"
+SIMD_LEVELS = ("scalar", "ssse3", "avx2", "auto")
+
+
+def rand_patterns(rng: random.Random) -> "list[str]":
+    """2-12 patterns mixing every factor tier plus guard shapes."""
+    import re as _re
+
+    pats: "list[str]" = []
+    for _ in range(rng.randrange(2, 12)):
+        kind = rng.random()
+        n = rng.choice((3, 3, 4, 5, 7, 8, 9, 14, 23, 24, 25,
+                        SWEEP_FACTOR_CAP + rng.randrange(1, 16)))
+        lit = "".join(chr(ALPHA[rng.randrange(len(ALPHA))])
+                      for _ in range(n))
+        if kind < 0.6:
+            pats.append(_re.escape(lit))
+        elif kind < 0.75:  # OR guard: both branches must stay guarded
+            lit2 = "".join(chr(ALPHA[rng.randrange(len(ALPHA))])
+                           for _ in range(rng.randrange(3, 10)))
+            pats.append(f"(?:{_re.escape(lit)}|{_re.escape(lit2)})")
+        elif kind < 0.9:  # literal head + regex tail
+            pats.append(_re.escape(lit) + r"\d+")
+        else:  # unguarded -> always-candidate group
+            pats.append(r"[a-z]*\d?")
+    return pats
+
+
+def rand_lines(rng: random.Random,
+               pats: "list[str]") -> "list[bytes]":
+    """Random lines with planted/split factors and boundary shapes."""
+    raws = [p.replace("\\", "").replace("(?:", "").replace(")", "")
+            .replace("|", "").encode() for p in pats]
+    lines: "list[bytes]" = []
+    for _ in range(rng.randrange(1, 60)):
+        body = bytes(ALPHA[rng.randrange(len(ALPHA))]
+                     for _ in range(rng.randrange(0, 56)))
+        roll = rng.random()
+        if roll < 0.45 and raws:
+            raw = raws[rng.randrange(len(raws))]
+            at = rng.choice([0, len(body), rng.randrange(len(body) + 1)])
+            body = body[:at] + raw + body[at:]
+            if rng.random() < 0.15 and len(body) > 1:
+                body = body[:-1]  # one byte short of the full factor
+        elif roll < 0.55 and raws:
+            # Cross-line split: this line ends with a factor prefix,
+            # the next begins with its suffix.
+            raw = raws[rng.randrange(len(raws))]
+            if len(raw) >= 2:
+                cut = rng.randrange(1, len(raw))
+                lines.append(body + raw[:cut])
+                body = raw[cut:] + bytes(
+                    ALPHA[rng.randrange(len(ALPHA))]
+                    for _ in range(rng.randrange(0, 8)))
+        elif roll < 0.65:
+            body = b""  # empty line (duplicate offsets)
+        lines.append(body)
+    return lines
+
+
+def run_trials(trials: int, seed: int, quiet: bool = True) -> int:
+    """Run ``trials`` differential trials; returns the number checked.
+    Raises AssertionError with a repro line on the first divergence.
+    The caller owns KLOGS_NATIVE_SIMD restoration."""
+    from klogs_tpu import native
+
+    if native.hostops is None or not hasattr(native.hostops,
+                                             "sweep_candidates"):
+        raise RuntimeError("native extension unavailable")
+    from klogs_tpu.utils.env import read as env_read
+
+    rng = random.Random(seed)
+    saved = env_read("KLOGS_NATIVE_SIMD")
+    checked = 0
+    try:
+        for trial in range(trials):
+            pats = rand_patterns(rng)
+            try:
+                infos = analyze(pats)
+                idx = FactorIndex(
+                    infos, plan_groups(
+                        infos,
+                        max_group_patterns=rng.choice((2, 3, 32))))
+            except Exception:
+                continue  # outside the analyzable subset
+            lines = rand_lines(rng, pats)
+            payload, offsets, _ = frame_lines(lines)
+            offsets = np.asarray(offsets, dtype=np.int32)
+            expect = idx.group_candidates(payload, offsets, impl="numpy")
+            level = SIMD_LEVELS[trial % len(SIMD_LEVELS)]
+            os.environ["KLOGS_NATIVE_SIMD"] = level
+            got = idx.group_candidates(payload, offsets, impl="native")
+            assert np.array_equal(expect, got), (
+                f"DIVERGENCE: seed={seed} trial={trial} simd={level} "
+                f"patterns={pats!r} lines={lines!r}\n"
+                f"numpy:\n{expect.astype(int)}\n"
+                f"native:\n{got.astype(int)}")
+            checked += 1
+            if not quiet and trial and trial % 200 == 0:
+                print(f"  {trial} trials, {checked} checked", flush=True)
+    finally:
+        if saved is None:
+            os.environ.pop("KLOGS_NATIVE_SIMD", None)
+        else:
+            os.environ["KLOGS_NATIVE_SIMD"] = saved
+    return checked
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=2000)
+    ap.add_argument("--seed", type=int, default=None)
+    args = ap.parse_args()
+    seed = args.seed if args.seed is not None else int(time.time())
+    print(f"fuzz-sweep: seed={seed} trials={args.trials}", flush=True)
+    t0 = time.time()
+    try:
+        checked = run_trials(args.trials, seed, quiet=False)
+    except RuntimeError as e:
+        print(f"SKIP: {e}")
+        return 2
+    except AssertionError as e:
+        print(str(e), flush=True)
+        return 1
+    print(f"fuzz-sweep OK: {checked} mask comparisons across "
+          f"{args.trials} trials, {time.time() - t0:.0f}s, seed={seed}",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
