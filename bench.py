@@ -268,15 +268,15 @@ def bench_k_axis(ks=None, n_lines: "int | None" = None,
     payload, offsets, _ = frame_lines(lines)
     offsets = np.asarray(offsets, dtype=np.int32)
 
-    def rate(filt) -> "tuple[float, int]":
-        best, matched = 0.0, 0
+    def rate(filt) -> "tuple[float, int, np.ndarray]":
+        best, matched, v = 0.0, 0, np.zeros(0, dtype=bool)
         for _ in range(repeats):
             t0 = time.perf_counter()
             v = np.asarray(filt.fetch_framed(
                 filt.dispatch_framed(payload, offsets)))
             best = max(best, len(lines) / (time.perf_counter() - t0))
             matched = int(v.sum())
-        return best, matched
+        return best, matched, v
 
     rows = []
     for k in ks:
@@ -298,13 +298,24 @@ def bench_k_axis(ks=None, n_lines: "int | None" = None,
         if sweep_rows is not None:
             sweep_rows.extend(
                 bench_sweep_rows(filt, payload, offsets, k, repeats))
-        idx_lps, idx_matched = rate(filt)
+        # Per-stage attribution of the indexed measurement (sweep /
+        # group-scan confirm / combined-re remainder), reset here so
+        # the breakdown covers exactly the timed repeats. The adaptive
+        # re-guard stays LIVE (unlike the bypass it keeps the index
+        # narrowing — it IS the steady-state production path; its
+        # probation slab is inside repeat 1 and best-of picks the
+        # warmed repeats).
+        for stage in filt.stage_s:
+            filt.stage_s[stage] = 0.0
+        idx_lps, idx_matched, idx_verd = rate(filt)
+        stage_s = dict(filt.stage_s)
         ratio = filt.narrowing_ratio
         # Scan-all comparator: SAME groups/tables, narrowing off.
         filt.narrow = False
-        all_lps, all_matched = rate(filt)
+        all_lps, all_matched, all_verd = rate(filt)
         filt.narrow = True
-        assert idx_matched == all_matched, (
+        parity = bool(np.array_equal(idx_verd, all_verd))
+        assert parity, (
             f"K={k}: indexed verdicts diverged "
             f"({idx_matched} vs {all_matched})")
         # The production auto path (best_host_filter): below
@@ -325,7 +336,7 @@ def bench_k_axis(ks=None, n_lines: "int | None" = None,
             auto_kind, auto_lps = "indexed", idx_lps
         else:
             auto, auto_kind = best_host_filter(pats)
-            auto_lps, _ = rate(auto)
+            auto_lps = rate(auto)[0]
         rows.append({
             "k": k,
             "n_lines": len(lines),
@@ -333,6 +344,18 @@ def bench_k_axis(ks=None, n_lines: "int | None" = None,
             # ran (native vs numpy): K rows are only comparable across
             # machines when this matches.
             "sweep_impl": filt.index.last_impl,
+            # Per-stage seconds across the indexed measurement's
+            # repeats, plus which confirm implementation ran — the
+            # next PR reads where the remaining time goes.
+            "sweep_s": round(stage_s["sweep"], 3),
+            "group_scan_s": round(stage_s["group_scan"], 3),
+            "merge_s": round(stage_s["merge"], 3),
+            "group_scan_impl": filt.group_scan_impl,
+            # Full indexed-vs-scan-all mask equality (not just counts).
+            "parity": parity,
+            # Guard factors the adaptive re-guard banned mid-run (0 =
+            # the static index was already well-tuned for the corpus).
+            "banned_factors": len(filt.banned_factors),
             "indexed_lps": round(idx_lps, 1),
             "scan_all_lps": round(all_lps, 1),
             "speedup_vs_scan_all": round(idx_lps / all_lps, 2),

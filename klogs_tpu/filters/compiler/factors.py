@@ -209,24 +209,40 @@ def factors_from_ast(node: object) -> "list[bytes]":
 MAX_GUARD_FACTORS = 8
 
 
-def guard_factors(node: object) -> "list[bytes] | None":
+def guard_factors(node: object,
+                  banned: "object | None" = None
+                  ) -> "list[bytes] | None":
     """OR-semantics guard for the regex index: a set of literals such
     that EVERY match of the pattern contains AT LEAST ONE of them.
 
     A pattern with a mandatory factor guards on its rarest one
     (singleton OR-set). A pattern that is an alternation with no
     common factor — ``FATAL|CRIT`` — still guards: every match matches
-    some branch, so the union of per-branch guards is necessary.
+    some branch, so the union of per-branch guards is necessary. A
+    concatenation whose own factor chain yields nothing usable still
+    guards through any one guardable PART — a match of the Cat
+    contains a match of every part, so a part's guard is necessary for
+    the whole; the best-scored part guard wins.
     Returns None when no guard exists (nullable content everywhere, or
     an alternation with an unguardable branch): the pattern must stay
-    an always-candidate."""
-    fs = factors_from_ast(node)
+    an always-candidate.
+
+    ``banned`` (optional predicate ``bytes -> bool``) vetoes guard
+    literals the caller has measured to be useless on the live corpus
+    — a factor present in ~every line narrows nothing while taxing
+    every sweep position (the IndexedFilter's adaptive re-guard;
+    docs/PATTERNS.md). Banning only restricts the CHOICE of guard:
+    whatever survives is still a necessary condition, and a pattern
+    with no unbanned guard degrades to always-candidate — necessity is
+    preserved under any ban."""
+    fs = [f for f in factors_from_ast(node)
+          if banned is None or not banned(f)]
     if fs:
         return [fs[0]]
     if isinstance(node, Alt):
         out: "list[bytes]" = []
         for part in node.parts:
-            sub = guard_factors(part)
+            sub = guard_factors(part, banned)
             if sub is None:
                 return None
             for f in sub:
@@ -235,6 +251,18 @@ def guard_factors(node: object) -> "list[bytes] | None":
             if len(out) > MAX_GUARD_FACTORS:
                 return None
         return out
+    if isinstance(node, Cat):
+        best: "list[bytes] | None" = None
+        best_score = 0.0
+        for part in node.parts:
+            sub = guard_factors(part, banned)
+            if sub is None:
+                continue
+            # An OR-set is as selective as its WORST member.
+            score = max(factor_score(f) for f in sub)
+            if best is None or score < best_score:
+                best, best_score = sub, score
+        return best
     return None
 
 

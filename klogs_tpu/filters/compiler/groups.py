@@ -65,11 +65,13 @@ class PatternInfo:
     clauses: int
 
 
-def analyze(patterns: "list[str]",
-            ignore_case: bool = False) -> "list[PatternInfo]":
+def analyze(patterns: "list[str]", ignore_case: bool = False,
+            banned: "object | None" = None) -> "list[PatternInfo]":
     """Parse each pattern once; extract guard factors, pair-CNF clause
     count, and automaton size. Patterns the compiler cannot parse get
-    (guard=None, positions=None) and ride the `re` fallback path."""
+    (guard=None, positions=None) and ride the `re` fallback path.
+    ``banned`` (a ``bytes -> bool`` predicate) vetoes guard literals —
+    see factors.guard_factors; necessity holds under any ban."""
     from klogs_tpu.filters.compiler.glushkov import compile_patterns
 
     out: "list[PatternInfo]" = []
@@ -79,7 +81,7 @@ def analyze(patterns: "list[str]",
         except (RegexSyntaxError, ValueError):
             out.append(PatternInfo(i, pat, None, None, 0, 0))
             continue
-        guard = guard_factors(ast)
+        guard = guard_factors(ast, banned)
         n_factors = len(factors_from_ast(ast))
         n_clauses = len(clauses_from_ast(ast))
         try:
@@ -90,6 +92,34 @@ def analyze(patterns: "list[str]",
         out.append(PatternInfo(
             i, pat, tuple(guard) if guard is not None else None,
             positions, n_factors, n_clauses))
+    return out
+
+
+def reguard_infos(infos: "list[PatternInfo]", ignore_case: bool = False,
+                  banned: "object | None" = None) -> "list[PatternInfo]":
+    """Re-run ONLY guard extraction over already-analyzed patterns
+    (the IndexedFilter's adaptive re-guard): positions / factor /
+    clause counts are invariant under a ban, so the expensive
+    per-pattern automaton sizing from ``analyze`` is reused and the
+    rebuild costs one parse per pattern. The group plan stays valid —
+    it partitions pattern INDICES — but a pattern whose guard vanishes
+    under the ban must make its group always-candidate; FactorIndex
+    derives that from the infos themselves."""
+    out: "list[PatternInfo]" = []
+    for info in infos:
+        if info.guard is None and info.positions is None:
+            out.append(info)  # unparseable: nothing to re-extract
+            continue
+        try:
+            ast = parse(info.pattern, ignore_case=ignore_case)
+        except (RegexSyntaxError, ValueError):
+            out.append(info)
+            continue
+        guard = guard_factors(ast, banned)
+        out.append(PatternInfo(
+            info.index, info.pattern,
+            tuple(guard) if guard is not None else None,
+            info.positions, info.factors, info.clauses))
     return out
 
 

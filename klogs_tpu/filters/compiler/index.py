@@ -59,11 +59,14 @@ K-axis (BENCH_K.json) quantifies exactly this gap.
 """
 
 from dataclasses import dataclass
-from typing import Optional
+from typing import TYPE_CHECKING, Optional
 
 import numpy as np
 
 from klogs_tpu.filters.compiler.groups import GroupPlan, PatternInfo
+
+if TYPE_CHECKING:
+    from klogs_tpu.filters.compiler.dfa import DFATables
 
 # Minimum factor width the sweep can probe: matches
 # factors.MIN_FACTOR_LEN (every guard literal is at least 3 bytes).
@@ -108,6 +111,18 @@ def _anchor(f: bytes, width: int) -> int:
         return 0
     sums = np.convolve(w, np.ones(width), mode="valid")
     return int(np.argmax(sums))
+
+
+def sweep_factor(f: bytes) -> bytes:
+    """The exact bytes the sweep indexes for guard literal ``f``:
+    over-long literals (past SWEEP_FACTOR_CAP) are cut to their rarest
+    cap-width window — a substring of a mandatory literal is itself
+    mandatory. Shared by the index build and the adaptive re-guard's
+    ban test (the ban must name what the sweep actually probed)."""
+    if len(f) > SWEEP_FACTOR_CAP:
+        at = _anchor(f, SWEEP_FACTOR_CAP)
+        return f[at:at + SWEEP_FACTOR_CAP]
+    return f
 
 
 def _fold1(code: int) -> int:
@@ -157,6 +172,112 @@ _SIMD_CHOICES: "dict[str, int | None]" = {
 }
 _warned_no_native = False
 
+# KLOGS_NATIVE_GROUPSCAN: the batched MultiDFA group-scan stage of the
+# indexed engine (group_scan in _hostops.c). "auto" = native when the
+# extension is loadable (quiet per-group Python loop otherwise, ONE
+# loud notice per process), "native" = required (raise when
+# unavailable — tests/benches that must time the kernel), "off" = the
+# per-group dispatch loop, which is also the parity oracle.
+_GROUPSCAN_CHOICES = ("auto", "native", "off")
+
+
+def native_groupscan_mode() -> str:
+    """Parsed KLOGS_NATIVE_GROUPSCAN (strict dialect: a typo'd knob
+    silently timing the wrong confirm stage would poison every
+    BENCH_K row)."""
+    from klogs_tpu.utils.env import read
+
+    raw = (read("KLOGS_NATIVE_GROUPSCAN", "auto") or "auto")
+    mode = raw.strip().lower()
+    if mode not in _GROUPSCAN_CHOICES:
+        raise ValueError(
+            f"KLOGS_NATIVE_GROUPSCAN={raw!r}: expected one of "
+            f"{', '.join(_GROUPSCAN_CHOICES)}")
+    return mode
+
+
+# -- MultiDFA program blob (native batched group scan) -----------------
+#
+# The confirm-stage twin of native_sweep_blob(): every DFA-backed
+# group's flat scan tables (DFATables from compiler/dfa.py), packed
+# behind one validated header so group_scan in _hostops.c can walk the
+# whole candidate matrix in ONE GIL-released call. Unlike the sweep
+# blob the tables here can run to several MB, so the blob stays in
+# NATIVE byte order and is strictly process-local (built and consumed
+# in the same process, never persisted or sent anywhere) — no
+# byte-swapping pass is ever paid. The build is content-defined
+# (a pure function of the member tables); IndexedFilter caches it
+# keyed by member-table identity and rebuilds only when a member's
+# tables object changes (e.g. the DFA LRU refreshed it), reusing the
+# bytes of unchanged members via ``chunks``.
+_MDFA_MAGIC = 0x4B4D4446
+_MDFA_VERSION = 1
+_MDFA_HEADER_WORDS = 8
+_MDFA_DESC_WORDS = 10
+
+
+def multidfa_blob(tables: "list[DFATables]",
+                  chunks: "dict[int, tuple[bytes, bytes, bytes]] | None"
+                  = None) -> bytes:
+    """Pack ``tables`` (one DFATables per program member, in candidate-
+    matrix column order) into the MultiDFA program blob.
+
+    Layout (i32 words, native order; mirrored by the MH_*/MD_* enums
+    in _hostops.c): an 8-word header (magic, version, member count,
+    total length, 4 reserved), then per member a 10-word descriptor
+    (n_dfa, n_classes, start, end_class, wide, match_all, and 4-byte-
+    aligned offsets of the row-major transition table, the accept
+    flags, and the int32[256] byte->class map), then the concatenated
+    arrays. ``chunks`` (keyed by ``id(table_set)``) caches each
+    member's serialized arrays so an incremental rebuild re-serializes
+    only refreshed members."""
+    if not tables:
+        raise ValueError("multidfa_blob needs at least one table set")
+    M = len(tables)
+    header = np.zeros(_MDFA_HEADER_WORDS + _MDFA_DESC_WORDS * M,
+                      dtype=np.int32)
+    parts: "list[bytes]" = []
+    pos = header.nbytes
+
+    def put(b: bytes) -> int:
+        nonlocal pos
+        at = pos
+        parts.append(b)
+        pos += len(b)
+        pad = (-pos) % 4
+        if pad:
+            parts.append(bytes(pad))
+            pos += pad
+        return at
+
+    for m, t in enumerate(tables):
+        cached = chunks.get(id(t)) if chunks is not None else None
+        if cached is None:
+            cached = (np.ascontiguousarray(t.table).tobytes(),
+                      np.ascontiguousarray(t.accept,
+                                           dtype=np.uint8).tobytes(),
+                      np.ascontiguousarray(t.byte_class,
+                                           dtype=np.int32).tobytes())
+            if chunks is not None:
+                chunks[id(t)] = cached
+        d = _MDFA_HEADER_WORDS + _MDFA_DESC_WORDS * m
+        header[d + 0] = len(t.accept)
+        header[d + 1] = t.n_classes
+        header[d + 2] = t.start
+        header[d + 3] = t.end_class
+        header[d + 4] = 1 if t.table.dtype == np.uint32 else 0
+        header[d + 5] = 1 if t.match_all else 0
+        header[d + 6] = put(cached[0])
+        header[d + 7] = put(cached[1])
+        header[d + 8] = put(cached[2])
+    header[0] = _MDFA_MAGIC
+    header[1] = _MDFA_VERSION
+    header[2] = M
+    header[3] = pos
+    blob = header.tobytes() + b"".join(parts)
+    assert len(blob) == pos
+    return blob
+
 
 def native_simd_level() -> "int | None":
     """Parsed KLOGS_NATIVE_SIMD: -1 auto, 0/1/2 a pinned stage-1 tier,
@@ -182,6 +303,10 @@ class SweepStats:
     groups: int = 0
     candidate_cells: int = 0  # candidate (line, group) scan units
     candidate_lines: int = 0  # lines with at least one candidate group
+    # Per-group candidate counts of the batch ([G] int64, None when
+    # not tallied): the engine's group-scan ordering reuses this
+    # instead of re-reducing the multi-MB candidate matrix.
+    col_cells: "np.ndarray | None" = None
 
     @property
     def narrowing_ratio(self) -> float:
@@ -207,12 +332,38 @@ class _Tier:
 
 
 class FactorIndex:
-    """Compiled sweep tables for one analyzed, grouped pattern set."""
+    """Compiled sweep tables for one analyzed, grouped pattern set.
 
-    def __init__(self, infos: "list[PatternInfo]", plan: GroupPlan) -> None:
+    ``code_freq`` (optional, {native-endian 4-byte code: observed
+    count}) feeds the adaptive RE-ANCHOR: probe windows are normally
+    placed by the static log-text rarity prior, but the prior can
+    misfire on a live corpus — a factor like ``errcode=00881`` anchored
+    on its ``code`` window pays a bloom hit + hash probe at EVERY
+    ``code=`` occurrence even though the full factor never verifies.
+    When observed counts are supplied (the IndexedFilter measures them
+    on the probation slab), each factor's window minimizes the
+    OBSERVED density first and falls back to the static prior as the
+    tie-break. Anchoring only moves the probe window WITHIN the
+    factor, so necessity — and numpy/native/device mask parity, since
+    all three consume tables built from the same anchors — is
+    untouched."""
+
+    def __init__(self, infos: "list[PatternInfo]", plan: GroupPlan,
+                 code_freq: "dict[int, int] | None" = None) -> None:
+        self._code_freq = code_freq or {}
         self.n_patterns = len(infos)
         self.n_groups = plan.n_groups
-        self.always_groups = np.asarray(plan.always_groups, dtype=np.int64)
+        # Always-candidate groups: the plan's (groups packed from
+        # unguardable patterns) PLUS any group holding a pattern whose
+        # info carries no guard — under an adaptive re-guard ban
+        # (groups.reguard_infos) a member of a guarded-plan group can
+        # lose its guard, and its whole group must then be a candidate
+        # for every line or necessity breaks.
+        always = set(int(g) for g in plan.always_groups)
+        for info in infos:
+            if info.guard is None:
+                always.add(int(plan.group_of[info.index]))
+        self.always_groups = np.asarray(sorted(always), dtype=np.int64)
         # Dedupe guard literals across the set; remember, per literal,
         # which patterns it guards (for the per-pattern matrix) and
         # which groups those patterns live in (for the group sweep).
@@ -220,16 +371,15 @@ class FactorIndex:
         for info in infos:
             for f in info.guard or ():
                 # Over-long factors (un-truncated exact literals) sweep
-                # as their rarest SWEEP_FACTOR_CAP-byte window: a
-                # substring of a mandatory literal is itself mandatory,
-                # so necessity is preserved, and the cap bounds the
-                # verify word count on BOTH the host and device paths
-                # (the two must verify identical bytes for the device
-                # mask to equal the host mask bit for bit).
-                if len(f) > SWEEP_FACTOR_CAP:
-                    at = _anchor(f, SWEEP_FACTOR_CAP)
-                    f = f[at : at + SWEEP_FACTOR_CAP]
-                by_factor.setdefault(f, []).append(info.index)
+                # as their rarest SWEEP_FACTOR_CAP-byte window
+                # (sweep_factor): a substring of a mandatory literal
+                # is itself mandatory, so necessity is preserved, and
+                # the cap bounds the verify word count on BOTH the
+                # host and device paths (the two must verify identical
+                # bytes for the device mask to equal the host mask bit
+                # for bit).
+                by_factor.setdefault(sweep_factor(f),
+                                     []).append(info.index)
         self.factors: "list[bytes]" = sorted(by_factor)
         self.pattern_ids: "list[np.ndarray]" = [
             np.asarray(by_factor[f], dtype=np.int64) for f in self.factors]
@@ -258,18 +408,45 @@ class FactorIndex:
         self._bloom_a = np.zeros(1 << _BLOOM_BITS, dtype=np.uint8)
         self._bloom_b = np.zeros(1 << _BLOOM_BITS, dtype=np.uint8)
         self._bloom_n = np.zeros(1 << _BLOOM_BITS, dtype=np.uint8)
+        # THE per-factor probe decision (tier + window anchor),
+        # computed ONCE and consulted by every table builder — the
+        # host tiers here, the native blob's teddy masks, and the
+        # device SweepProgram — so no two implementations can ever
+        # disagree on where a factor's window sits. A >= WIDE factor
+        # normally probes the wide tier, but under observed densities
+        # it DEMOTES to the narrow tier when its best 4-byte window is
+        # rarer than its best 8-byte window HEAD ("ms code=418": every
+        # 8B window starts in omnipresent template text, while the
+        # narrow "=418" window is needle-rare). The verify is always
+        # the full factor, so tier choice is purely a probe-cost
+        # decision.
+        self._probes: "list[tuple[str, int]]" = []
+        for f in self.factors:
+            if len(f) < NARROW:
+                self._probes.append(("ext", 0))
+            elif len(f) < WIDE:
+                self._probes.append(
+                    ("narrow", self._anchor_of(f, NARROW)))
+            else:
+                wat = self._anchor_of(f, WIDE)
+                tier, at = "wide", wat
+                if self._code_freq:
+                    nat = self._anchor_of(f, NARROW)
+                    if (self._code_freq.get(_code_at(f, nat), 0)
+                            < self._code_freq.get(_code_at(f, wat), 0)):
+                        tier, at = "narrow", nat
+                self._probes.append((tier, at))
         wide_entries: "list[tuple[int, int, int]]" = []
         narrow_entries: "list[tuple[int, int, int]]" = []
         for fi, f in enumerate(self.factors):
-            if len(f) >= WIDE:
-                at = _anchor(f, WIDE)
+            tier, at = self._probes[fi]
+            if tier == "wide":
                 hi, lo = _code_at(f, at), _code_at(f, at + 4)
                 self._bloom_u[_fold1(hi)] = 1
                 self._bloom_a[_fold1(hi)] = 1
                 self._bloom_b[_fold1(lo)] = 1
                 wide_entries.append(((hi << 32) | lo, fi, at))
-            elif len(f) >= NARROW:
-                at = _anchor(f, NARROW)
+            elif tier == "narrow":
                 code = _code_at(f, at)
                 self._bloom_u[_fold1(code)] = 1
                 self._bloom_n[_fold1(code)] = 1
@@ -289,6 +466,29 @@ class FactorIndex:
     @property
     def n_factors(self) -> int:
         return len(self.factors)
+
+    def _anchor_of(self, f: bytes, width: int) -> int:
+        """Probe-window offset for factor ``f``: observed corpus
+        density first (class docstring), static rarity prior as the
+        tie-break — or the prior alone when no observations exist.
+        EVERY window consumer (tier build, teddy masks, device
+        program) anchors through here so the implementations can
+        never disagree on where a factor's window sits."""
+        if not self._code_freq or len(f) <= width:
+            return _anchor(f, width)
+        w = _BYTE_RARITY[np.frombuffer(f, dtype=np.uint8)]
+        sums = np.convolve(w, np.ones(width), mode="valid")
+        best = 0
+        best_key: "tuple[int, float] | None" = None
+        for o in range(len(f) - width + 1):
+            # Stage 1 (teddy + union bloom) gates on the window's
+            # FIRST 4 bytes, so that code's observed count is the
+            # survivor-cost driver for both tiers.
+            key = (self._code_freq.get(_code_at(f, o), 0),
+                   -float(sums[o]))
+            if best_key is None or key < best_key:
+                best_key, best = key, o
+        return best
 
     # -- the sweep ----------------------------------------------------
 
@@ -431,10 +631,19 @@ class FactorIndex:
                 gm[np.ix_(lines, self.group_ids[fi])] = True
         else:
             self.last_impl = "native"
+        # One column reduction serves the cell count, the engine's
+        # scan ordering, AND — when some column is full, the common
+        # case with an always-candidate group — the line count, which
+        # would otherwise cost a second multi-MB reduction per batch.
+        colsums = gm.sum(axis=0, dtype=np.int64)
+        cand_lines = (B if B and len(colsums)
+                      and int(colsums.max()) == B
+                      else int(gm.any(axis=1).sum()) if B else 0)
         self.last_stats = SweepStats(
             lines=B, groups=self.n_groups,
-            candidate_cells=int(gm.sum()),
-            candidate_lines=int(gm.any(axis=1).sum()))
+            candidate_cells=int(colsums.sum()),
+            candidate_lines=cand_lines,
+            col_cells=colsums)
         return gm
 
     def _native_candidates(self, payload: bytes, offsets: np.ndarray,
@@ -495,13 +704,8 @@ class FactorIndex:
         # confirm consults before any hash probe.
         teddy = np.zeros((_TEDDY_M, 2, 16), dtype=np.uint8)
         bloom = np.zeros(1 << _BLOOM_BITS, dtype=np.uint8)
-        for f in self.factors:
-            if len(f) >= WIDE:
-                at = _anchor(f, WIDE)
-            elif len(f) >= NARROW:
-                at = _anchor(f, NARROW)
-            else:
-                at = 0
+        for fi, f in enumerate(self.factors):
+            tier, at = self._probes[fi]
             w = f[at:at + _TEDDY_M]
             bucket = np.uint8(
                 1 << ((w[0] ^ (w[1] * 7) ^ (w[2] * 31)) % _TEDDY_BUCKETS))
@@ -515,7 +719,7 @@ class FactorIndex:
             # Probe codes are the LITTLE-endian window codes of the
             # packed tiers (sweep_program's le_code), independent of
             # host byte order — same fold as the kernel's confirm.
-            if len(f) >= NARROW:
+            if tier != "ext":
                 code = int.from_bytes(f[at:at + 4].ljust(4, b"\0"),
                                       "little")
                 bloom[((code * _FIB) & 0xFFFFFFFF) >> 16] = 1
@@ -655,12 +859,11 @@ class FactorIndex:
             for g in np.unique(gof[self.pattern_ids[fi]]):
                 fac_groups[fi, int(g) // 32] |= np.uint32(
                     1 << (int(g) % 32))
-            if len(f) >= WIDE:
-                at = _anchor(f, WIDE)
+            tier, at = self._probes[fi]
+            if tier == "wide":
                 hi, lo = le_code(f[at : at + 4]), le_code(f[at + 4 : at + 8])
                 wide.append((((hi * _FIB) & 0xFFFFFFFF) ^ lo, fi, at))
-            elif len(f) >= NARROW:
-                at = _anchor(f, NARROW)
+            elif tier == "narrow":
                 narrow.append((le_code(f[at : at + 4]), fi, at))
             else:
                 # 3-byte factor: all 256 one-byte extensions, anchor 0
@@ -672,9 +875,9 @@ class FactorIndex:
 
         n_tier = pack_sweep_tier(narrow)
         w_tier = pack_sweep_tier(wide)
-        # Per-tier verify bound: the narrow tier only holds factors
-        # shorter than WIDE, so its word loop is 2 compares max no
-        # matter how long the wide tier's factors run.
+        # Per-tier verify bound: each tier's word loop only runs as
+        # deep as its own longest member (demoted wide factors can
+        # deepen the narrow tier; the max below tracks that).
         n_tier.n_words = max(
             (int(fac_len[fi]) + 3) // 4 for _, fi, _ in narrow) if narrow \
             else 0

@@ -104,6 +104,12 @@ class DFAFilter(LogFilter):
         self._accept_b = t.accept.tobytes()
         self._bclass_b = t.byte_class.tobytes()
 
+    @property
+    def tables(self):
+        """The compiled DFATables — the indexed engine's MultiDFA
+        program builder packs these (filters/compiler/index.py)."""
+        return self._t
+
     def match_lines(self, lines: list[bytes]) -> list[bool]:
         from klogs_tpu.filters.base import frame_lines
 

@@ -60,6 +60,16 @@ SWEEP_MAX_BATCH_BYTES = 64 << 20
 # switch to scan-all for subsequent batches and say so once.
 BYPASS_RATIO = 0.5
 BYPASS_MIN_LINES = 65536
+# Adaptive re-guard (KLOGS_INDEX_DENSE_RATIO / _LINES): after the
+# probation window, guard factors observed in more than DENSE_RATIO of
+# swept lines are banned and the index re-guarded — see the
+# constructor comment. RATIO >= 1 disables (no factor can exceed it).
+DENSE_RATIO = 0.5
+DENSE_MIN_LINES = 65536
+# One loud notice per process when auto mode wanted the native batched
+# group scan but the extension is unavailable (mirrors the sweep's
+# _warned_no_native discipline).
+_warned_no_groupscan = False
 
 
 class _Group:
@@ -135,6 +145,17 @@ class IndexedFilter(LogFilter):
         self._m_sweep_fallback = r.family("klogs_sweep_fallback_total")
         self._m_bypass = r.family("klogs_sweep_bypass_total")
         self._m_sweep_impl = r.family("klogs_sweep_impl_batches_total")
+        gs_batches = r.family("klogs_groupscan_batches_total")
+        gs_rows = r.family("klogs_groupscan_rows_total")
+        gs_cells = r.family("klogs_groupscan_cells_total")
+        gs_s = r.family("klogs_groupscan_seconds")
+        self._m_gs = {impl: (gs_batches.labels(impl=impl),
+                             gs_rows.labels(impl=impl),
+                             gs_cells.labels(impl=impl),
+                             gs_s.labels(impl=impl))
+                      for impl in ("native", "python")}
+        self._m_gs_fallback = r.family("klogs_groupscan_fallback_total")
+        self._m_reguard = r.family("klogs_prefilter_reguard_total")
 
         self.narrow = narrow
         self.infos: "list[PatternInfo]" = analyze(
@@ -152,6 +173,27 @@ class IndexedFilter(LogFilter):
             for members in self.plan.groups
         ]
         self._m_groups.set(len(self.groups))
+        # Group partition for the confirm stage: DFA-backed groups ride
+        # the batched MultiDFA native scan (one group_scan call per
+        # slab); the combined-re/re remainder keeps the per-group
+        # Python path.
+        self._dfa_cols = [g for g, grp in enumerate(self.groups)
+                          if grp.kind == "dfa"]
+        self._dfa_cols_arr = np.asarray(self._dfa_cols, dtype=np.int32)
+        self._rest_cols = [g for g, grp in enumerate(self.groups)
+                           if grp.kind != "dfa"]
+        # MultiDFA program blob cache: rebuilt (incrementally, via the
+        # per-member chunk cache) only when a member group's tables
+        # object changes — e.g. the DFA LRU refreshed it.
+        self._mdfa_key: Any = None
+        self._mdfa_blob: "bytes | None" = None
+        self._mdfa_chunks: "dict[int, tuple[bytes, bytes, bytes]]" = {}
+        self._groupscan_broken = False
+        # Per-stage time attribution (BENCH_K's sweep_s / group_scan_s
+        # / merge_s breakdown): cumulative seconds per pipeline stage,
+        # and which confirm implementation the last slab ran.
+        self.stage_s = {"sweep": 0.0, "group_scan": 0.0, "merge": 0.0}
+        self.group_scan_impl = "python"
         # Cumulative narrowing tallies (bench/introspection).
         self.swept_lines = 0
         self.swept_cells = 0
@@ -167,6 +209,25 @@ class IndexedFilter(LogFilter):
             "KLOGS_INDEX_BYPASS_RATIO", BYPASS_RATIO)
         self._bypass_min_lines = int(_env_float(
             "KLOGS_INDEX_BYPASS_LINES", BYPASS_MIN_LINES))
+        # Adaptive re-guard (one-shot, probation-gated like the
+        # bypass): a guard factor observed in ~every line narrows
+        # nothing while taxing every sweep position AND making its
+        # groups dense-candidate — after KLOGS_INDEX_DENSE_LINES swept
+        # lines, factors whose line-hit density exceeds
+        # KLOGS_INDEX_DENSE_RATIO are BANNED and the index rebuilt:
+        # ban-aware guard extraction (factors.guard_factors) re-guards
+        # each affected pattern on its next-best clause ("FATAL|CRIT"
+        # instead of an omnipresent "code="), or degrades it to
+        # always-candidate. Groups, plans, and compiled engines are
+        # untouched; verdicts cannot change (the guard stays a
+        # necessary condition under any ban).
+        self._ignore_case = ignore_case
+        self._reguarded = False
+        self.banned_factors: "tuple[bytes, ...]" = ()
+        self._dense_ratio = _env_float(
+            "KLOGS_INDEX_DENSE_RATIO", DENSE_RATIO)
+        self._dense_min_lines = int(_env_float(
+            "KLOGS_INDEX_DENSE_LINES", DENSE_MIN_LINES))
         # Narrowing stage: the device sweep (ops/sweep.py via jax) when
         # requested — or in auto mode when a real accelerator backend
         # is up — else the host sweep. Device-path failures fall back
@@ -269,7 +330,6 @@ class IndexedFilter(LogFilter):
     def _match_slab(self, payload: bytes,
                     offsets: np.ndarray) -> np.ndarray:
         B = len(offsets) - 1
-        out = np.zeros(B, dtype=bool)
         if self.narrow and not self.bypassed:
             t0 = time.perf_counter()
             path = "host"
@@ -283,8 +343,16 @@ class IndexedFilter(LogFilter):
                     gm = self.index.group_candidates(payload, offsets)
                 sp.set_attr("path", path)
             G = len(self.groups)
-            cand_lines = int(gm.any(axis=1).sum())
-            cand_cells = int(gm.sum())
+            if path == "host":
+                # group_candidates already tallied this gm into
+                # last_stats — reuse it instead of re-reducing a
+                # multi-MB bool matrix (a measured ~4ms/slab at
+                # K=1024, pure duplication).
+                cand_lines = self.index.last_stats.candidate_lines
+                cand_cells = self.index.last_stats.candidate_cells
+            else:
+                cand_lines = int(gm.any(axis=1).sum())
+                cand_cells = int(gm.sum())
             self.swept_lines += B
             self.swept_cells += B * G
             self.candidate_cells += cand_cells
@@ -299,39 +367,204 @@ class IndexedFilter(LogFilter):
             self._m_sweep_batches.labels(path=path).inc()
             self._m_sweep_lines.labels(path=path).inc(B)
             self._m_sweep_cand.labels(path=path).inc(cand_lines)
-            self._m_sweep_s.labels(path=path).observe(
-                time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self.stage_s["sweep"] += dt
+            self._m_sweep_s.labels(path=path).observe(dt)
             self._maybe_bypass()
-        else:
-            gm = np.ones((B, len(self.groups)), dtype=bool)
-            self.swept_lines += B
-            self.swept_cells += B * len(self.groups)
-            self.candidate_cells += B * len(self.groups)
-            self.candidate_lines += B
+            if not self._reguarded \
+                    and self.swept_lines >= self._dense_min_lines:
+                self._maybe_reguard(payload, offsets)
+            colsums = (self.index.last_stats.col_cells
+                       if path == "host" else None)
+            return self._scan_candidates(payload, offsets, gm,
+                                         colsums=colsums)
+        gm = np.ones((B, len(self.groups)), dtype=bool)
+        self.swept_lines += B
+        self.swept_cells += B * len(self.groups)
+        self.candidate_cells += B * len(self.groups)
+        self.candidate_lines += B
+        return self._scan_candidates(
+            payload, offsets, gm,
+            colsums=np.full(len(self.groups), B, dtype=np.int64))
+
+    def _scan_candidates(self, payload: bytes, offsets: np.ndarray,
+                         gm: np.ndarray,
+                         colsums: "np.ndarray | None" = None
+                         ) -> np.ndarray:
+        """The confirm stage: run each line's candidate groups until
+        one accepts. DFA-backed groups go through ONE batched native
+        group_scan call per slab (zero sub-frame copies, GIL released;
+        the per-group loop below is the KLOGS_NATIVE_GROUPSCAN=off /
+        no-toolchain fallback and the parity oracle — mask-identical
+        by construction since every (row, group) verdict is the same
+        DFA table walk). The combined-re/re remainder always takes the
+        per-group path, after the DFA groups so it inherits their
+        accepts as early-outs."""
+        B = len(offsets) - 1
+        out = np.zeros(B, dtype=bool)
         arr = np.frombuffer(payload, dtype=np.uint8)
         lens = np.diff(offsets)
-        for g, grp in enumerate(self.groups):
-            col = gm[:, g]
-            if not col.any():
-                continue
-            if col.all():
-                # Whole slab is candidate (always-candidate groups, the
-                # scan-all comparator): the engine's framed fast path.
-                verd = np.asarray(grp.filt.fetch_framed(
-                    grp.filt.dispatch_framed(payload, offsets)))
-                out |= verd[:B]
-                continue
-            rows = np.nonzero(col & ~out)[0]  # already-kept rows skip
-            if not len(rows):
-                continue
-            # Candidate rows ride the framed path too: a vectorized
-            # ragged gather builds the sub-frame (no per-line PyBytes —
-            # the whole narrow path stays at C speed).
-            sub_pay, sub_off = _gather_frame(arr, offsets, lens, rows)
-            verd = np.asarray(grp.filt.fetch_framed(
-                grp.filt.dispatch_framed(sub_pay, sub_off)))
-            out[rows[verd[:len(rows)]]] = True
+        t0 = time.perf_counter()
+        impl = "python"
+        rows_in = 0
+        with trace.TRACER.span("device.groupscan", lines=B,
+                               groups=len(self.groups)) as sp:
+            scanned: "int | None" = None
+            if self._dfa_cols and B:
+                gm = np.ascontiguousarray(gm)
+                # Per-member candidate counts drive the scan order
+                # (most selective first) and the rows-in figure; the
+                # sweep's own column reduction is reused when it ran.
+                if colsums is None:
+                    colsums = gm.sum(axis=0, dtype=np.int64)
+                dsum = colsums[self._dfa_cols_arr]
+                rows_in = (B if len(dsum) and int(dsum.max()) == B
+                           else int(gm[:, self._dfa_cols]
+                                    .any(axis=1).sum()))
+                scanned = self._groupscan_native(payload, offsets, gm,
+                                                 dsum, out)
+            if scanned is None:
+                scanned = 0
+                for g in self._dfa_cols:
+                    scanned += self._scan_group(g, gm, out, payload,
+                                                offsets, arr, lens)
+            else:
+                impl = "native"
+            dt = time.perf_counter() - t0
+            self.stage_s["group_scan"] += dt
+            self.group_scan_impl = impl
+            sp.set_attr("impl", impl)
+            sp.set_attr("rows", rows_in)
+            sp.set_attr("cells", int(scanned))
+            m_batches, m_rows, m_cells, m_s = self._m_gs[impl]
+            m_batches.inc()
+            m_rows.inc(rows_in)
+            m_cells.inc(int(scanned))
+            m_s.observe(dt)
+        t1 = time.perf_counter()
+        for g in self._rest_cols:
+            self._scan_group(g, gm, out, payload, offsets, arr, lens)
+        self.stage_s["merge"] += time.perf_counter() - t1
         return out
+
+    def _scan_group(self, g: int, gm: np.ndarray, out: np.ndarray,
+                    payload: bytes, offsets: np.ndarray,
+                    arr: np.ndarray, lens: np.ndarray) -> int:
+        """One group's engine over its candidate rows not yet accepted
+        (the per-group path). Returns the number of rows scanned."""
+        grp = self.groups[g]
+        B = len(out)
+        col = gm[:, g]
+        if not col.any():
+            return 0
+        rows = np.nonzero(col & ~out)[0]  # already-kept rows skip
+        if not len(rows):
+            return 0
+        if col.all() and 2 * len(rows) >= B:
+            # Whole slab is candidate and most rows still undecided
+            # (always-candidate groups, the scan-all comparator): the
+            # engine's framed fast path — gathering a near-full
+            # sub-frame copy costs more than re-scanning the few
+            # already-kept rows. Once MOST rows are accepted, the
+            # gathered branch below takes over so a cheap earlier
+            # group's accepts are not re-scanned wholesale (they
+            # were, before PR 14).
+            verd = np.asarray(grp.filt.fetch_framed(
+                grp.filt.dispatch_framed(payload, offsets)))
+            out |= verd[:B]
+            return B  # the whole frame was scanned (cells metric)
+        # Candidate rows ride the framed path too: a vectorized
+        # ragged gather builds the sub-frame (no per-line PyBytes —
+        # the whole narrow path stays at C speed).
+        sub_pay, sub_off = _gather_frame(arr, offsets, lens, rows)
+        verd = np.asarray(grp.filt.fetch_framed(
+            grp.filt.dispatch_framed(sub_pay, sub_off)))
+        out[rows[verd[:len(rows)]]] = True
+        return len(rows)
+
+    # -- batched native group scan ------------------------------------
+
+    def _multidfa(self) -> bytes:
+        """The cached MultiDFA program blob over the DFA-backed
+        groups' tables (compiler/index.py multidfa_blob). Rebuilt —
+        reusing unchanged members' serialized chunks — only when a
+        member's tables object changed (DFA LRU refresh)."""
+        from klogs_tpu.filters.compiler.index import multidfa_blob
+
+        tables = [self.groups[g].filt.tables for g in self._dfa_cols]
+        key = tuple(id(t) for t in tables)
+        if self._mdfa_key != key or self._mdfa_blob is None:
+            live = set(key)
+            for stale in [k for k in self._mdfa_chunks
+                          if k not in live]:
+                del self._mdfa_chunks[stale]
+            self._mdfa_blob = multidfa_blob(tables,
+                                            chunks=self._mdfa_chunks)
+            self._mdfa_key = key
+        return self._mdfa_blob
+
+    def _groupscan_native(self, payload: bytes, offsets: np.ndarray,
+                          gm: np.ndarray, dsum: np.ndarray,
+                          out: np.ndarray) -> "int | None":
+        """One batched group_scan call over every (row, DFA-group)
+        candidate cell, writing verdicts into ``out`` in place (native
+        kernel in _hostops.c; monotonic 0->1 writes only). ``gm`` is
+        passed WHOLE — zero copies — with a stride + member-column map;
+        ``dsum`` is the per-DFA-member candidate count. Returns the
+        scanned-cell count, or None when the per-group Python loop
+        should run instead (KLOGS_NATIVE_GROUPSCAN=off, no toolchain,
+        or a previous kernel failure)."""
+        from klogs_tpu.filters.compiler.index import (
+            native_groupscan_mode,
+        )
+
+        mode = native_groupscan_mode()
+        if mode == "off" or self._groupscan_broken:
+            return None
+        from klogs_tpu.native import hostops
+
+        if hostops is None or not hasattr(hostops, "group_scan"):
+            if mode == "native":
+                raise RuntimeError(
+                    "native group scan unavailable (extension not "
+                    "loaded) with KLOGS_NATIVE_GROUPSCAN=native")
+            global _warned_no_groupscan
+            if not _warned_no_groupscan:
+                _warned_no_groupscan = True
+                from klogs_tpu.ui import term
+
+                term.warning(
+                    "native group scan unavailable (no C toolchain?); "
+                    "confirming on the per-group loop for this process")
+            return None
+        # Most selective group first: rows accepted by a rarely-
+        # candidate group (a factor hit is a strong match signal) skip
+        # the broader — and the always-candidate — groups entirely.
+        # Members with zero candidates are omitted outright (the
+        # kernel pays a full column skip-walk per listed member).
+        order = np.argsort(dsum, kind="stable").astype(np.int32)
+        order = np.ascontiguousarray(order[dsum[order] > 0])
+        off = np.ascontiguousarray(offsets, dtype=np.int32)
+        try:
+            return int(hostops.group_scan(
+                self._multidfa(), payload, off, len(off) - 1, gm,
+                gm.shape[1], self._dfa_cols_arr, order, out))
+        except Exception as e:
+            if mode == "native":
+                raise
+            # Loud, counted, permanent: the per-group loop is mask-
+            # identical, so verdicts cannot change — but a fleet
+            # silently confirming several times slower than
+            # provisioned is a capacity incident.
+            self._groupscan_broken = True
+            self._m_gs_fallback.inc()
+            trace.flight_trigger("groupscan-fallback", error=str(e))
+            from klogs_tpu.ui import term
+
+            term.warning(
+                "native group scan failed (%s); per-group loop from "
+                "here on", str(e)[:120])
+            return None
 
     def _maybe_bypass(self) -> None:
         """Adaptive bypass: after the probation window, a cumulative
@@ -350,6 +583,119 @@ class IndexedFilter(LogFilter):
             "index narrowing ratio %.2f stayed above %.2f after %d "
             "lines; switching to scan-all for subsequent batches",
             self.narrowing_ratio, self._bypass_ratio, self.swept_lines)
+
+    def _maybe_reguard(self, payload: bytes,
+                       offsets: np.ndarray) -> None:
+        """One-shot adaptive re-tune of the narrowing tables
+        (constructor comment), two measurements off one probation
+        slab:
+
+        - **re-guard**: per-FACTOR line-hit density via the numpy
+          sweep's own hit extraction — factors present in ~every line
+          are banned and their patterns re-guarded on next-best
+          clauses;
+        - **re-anchor**: observed 4-byte-code densities — probe
+          windows the static prior placed on corpus-dense text
+          (``errcode=00881`` anchored on ``code``) move to the
+          window the corpus actually keeps rare.
+
+        Only the index tables rebuild; groups and compiled engines
+        are untouched and verdicts cannot change (necessity holds
+        under any ban, and anchoring only moves probe windows WITHIN
+        factors)."""
+        B = len(offsets) - 1
+        # The measurement slab must itself be representative: a tiny
+        # follow-mode batch crossing the probation threshold would
+        # otherwise ban a needle factor that merely appeared in it
+        # (B=1, thresh 0.5 -> one occurrence reads as "dense",
+        # permanently). Keep the one-shot ARMED until a big-enough
+        # slab arrives; an explicit low KLOGS_INDEX_DENSE_LINES opts
+        # into smaller measurement slabs.
+        if B < min(1024, self._dense_min_lines):
+            return
+        self._reguarded = True
+        if self._dense_ratio >= 1.0:
+            return
+        thresh = self._dense_ratio * B
+        # Aggregate hit lines PER FACTOR before thresholding: the
+        # ext tier (3-byte factors) reports up to 256 separate
+        # (fid, lines) tuples — one per extension code — and exactly
+        # the omnipresent short guards this measurement targets would
+        # otherwise slip under the threshold piecewise.
+        agg: "dict[int, np.ndarray]" = {}
+        for fi, lines in self.index._hits(payload, offsets):
+            prev = agg.get(fi)
+            agg[fi] = lines if prev is None else np.union1d(prev, lines)
+        ban = {self.index.factors[fi]
+               for fi, hit in agg.items() if len(hit) > thresh}
+        code_freq = self._dense_codes(payload)
+        if not ban and not code_freq:
+            return
+        from klogs_tpu.filters.compiler.groups import reguard_infos
+        from klogs_tpu.filters.compiler.index import (
+            FactorIndex,
+            sweep_factor,
+        )
+
+        infos2 = (reguard_infos(
+            self.infos, ignore_case=self._ignore_case,
+            banned=lambda f: sweep_factor(f) in ban)
+            if ban else self.infos)
+        new_index = FactorIndex(infos2, self.plan,
+                                code_freq=code_freq)
+        if self._sweep_path == "device":
+            try:
+                from klogs_tpu.ops.sweep import device_sweep_tables
+
+                self._sweep_tables = device_sweep_tables(
+                    new_index.sweep_program())
+            except Exception as e:
+                # Same terminal degrade as a device-sweep failure: the
+                # host sweep is the parity oracle, verdicts unchanged.
+                self._sweep_path = "host"
+                self._m_sweep_fallback.inc()
+                trace.flight_trigger("sweep-fallback", error=str(e))
+        self.infos = infos2
+        self.index = new_index
+        self.banned_factors = tuple(sorted(ban))
+        if ban:
+            self._m_reguard.inc(len(ban))
+        from klogs_tpu.ui import term
+
+        term.info(
+            "re-tuned index after %d lines: %d dense guard factor(s) "
+            "banned (density > %.2f), %d dense probe code(s) "
+            "re-anchored around", self.swept_lines, len(ban),
+            self._dense_ratio, len(code_freq))
+
+    @staticmethod
+    def _dense_codes(payload: bytes) -> "dict[int, int]":
+        """Observed-dense 4-byte codes of (a sample of) the slab: the
+        re-anchor's density map. Only codes at per-line-ish density
+        survive (the map stays tens of entries, not a corpus
+        histogram); everything absent reads as rare."""
+        cap = min(len(payload), 1 << 21)
+        if cap < 4096:
+            return {}
+        arr = np.frombuffer(payload, dtype=np.uint8, count=cap)
+        b = arr[:cap - 3].astype(np.uint32)
+        code = (b | (arr[1:cap - 2].astype(np.uint32) << np.uint32(8))
+                | (arr[2:cap - 1].astype(np.uint32) << np.uint32(16))
+                | (arr[3:cap].astype(np.uint32) << np.uint32(24)))
+        if not np.little_endian:  # match _code_at's native-order codes
+            code = ((code & np.uint32(0xFF)) << np.uint32(24)
+                    | (code & np.uint32(0xFF00)) << np.uint32(8)
+                    | (code >> np.uint32(8)) & np.uint32(0xFF00)
+                    | code >> np.uint32(24))
+        vals, counts = np.unique(code, return_counts=True)
+        # Keep anything near or above ~0.2% of sample positions (a few
+        # hundred entries): the re-anchor compares candidate windows
+        # by MINIMUM observed count, so mid-density codes (a literal
+        # on 25% of lines) must be visible too, not just omnipresent
+        # ones.
+        keep = counts > max(8, cap >> 12)
+        return {int(v): int(c)
+                for v, c in zip(vals[keep], counts[keep])}
 
     def _device_candidates(self, payload: bytes,
                            offsets: np.ndarray) -> "np.ndarray | None":

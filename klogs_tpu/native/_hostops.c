@@ -1607,6 +1607,476 @@ sweep_candidates(PyObject *self, PyObject *args)
     return mask;
 }
 
+/* ================= MultiDFA batched group scan =======================
+ *
+ * group_scan(blob, payload, offsets, n_lines, cand, stride, cols,
+ *            order, out) -> scanned candidate cells (int)
+ *
+ * The "confirm" stage of the indexed engine done in one native call
+ * (Hyperscan-FDR shape; filters/indexed.py): instead of a Python loop
+ * dispatching one dfa_scan per candidate GROUP — each paying a gathered
+ * sub-frame copy and its own GIL round-trip — every DFA-backed group's
+ * flat scan tables travel in ONE MultiDFA program blob
+ * (FactorIndex-side builder: filters/compiler/index.py multidfa_blob)
+ * and this kernel walks all (row, group) candidate cells in place via
+ * the framed offsets: zero sub-frame copies, one native call per slab.
+ *
+ *   blob:    MultiDFA program (validated header below; native byte
+ *            order — the blob is process-local, never persisted)
+ *   payload: framed slab bytes (borrowed, read-only)
+ *   offsets: i32[n_lines+1] exclusive prefix offsets
+ *   cand:    u8[n_lines, stride] candidate matrix (0 = the sweep
+ *            ruled the cell out). `stride` + `cols` let the engine
+ *            pass its FULL [B, n_groups] bool group matrix with zero
+ *            copies: member m's candidate column is cand[., cols[m]].
+ *   order:   i32[M] scan order over members (the engine passes
+ *            ascending candidate count: most selective first, so
+ *            always-candidate groups run last and inherit every
+ *            earlier accept as an early-out)
+ *   out:     u8[n_lines] verdict bytes, WRITABLE, monotonic 0->1 only
+ *            (rows already 1 on entry are skipped entirely)
+ *
+ * Group-major walk with exact early-out: members run in `order`; each
+ * member scans the candidate rows no earlier member accepted. That is
+ * cell-for-cell the same skip set as a row-major walk (each (row,
+ * member) cell runs iff no member earlier in `order` accepted the
+ * row) but keeps one member's tables hot in cache across its whole
+ * run. Parallelism reuses the slice_jobs/dispatch_row_jobs machinery
+ * over ROW ranges — each worker owns a disjoint slice of rows and
+ * with it that slice's verdict bytes, so the shared `out` array sees
+ * monotonic, non-racing writes by construction and the early-out is
+ * exact (not opportunistic). The whole walk runs inside
+ * Py_BEGIN_ALLOW_THREADS over borrowed read-only buffers + the
+ * caller-owned out buffer.
+ *
+ * Start-state acceleration (Hyperscan "accel state" shape): at parse
+ * time each member's start-state row is scanned for its ESCAPE bytes
+ * — bytes whose class leaves the start state. A member with <= 2
+ * escape bytes runs a memchr-driven loop: while the automaton sits in
+ * its start state, memchr jumps straight to the next escape byte
+ * (every skipped byte provably self-loops), and the table walk only
+ * runs from there until the state falls back to start. On literal-ish
+ * patterns the scan approaches memchr speed instead of the ~1 GB/s
+ * dependent-load table walk; the state trajectory is identical by
+ * construction.
+ *
+ * Per-cell semantics are exactly dfa_scan's scalar loop (strip
+ * trailing '\n', start state after BEGIN, accept latched per byte,
+ * end_class step last, match_all short-circuit) — so the per-group
+ * dfa_scan path is this kernel's parity oracle, cell for cell. State
+ * ids loaded from the (untrusted-bytes) tables are bounds-checked in
+ * the loop before use: a corrupt blob raises, never reads out of
+ * bounds.
+ */
+
+#define MDFA_MAGIC 0x4B4D4446   /* "FDMK" little-endian */
+#define MDFA_VERSION 1
+/* Header word indexes (i32; see multidfa_blob in compiler/index.py). */
+enum { MH_MAGIC = 0, MH_VERSION, MH_M, MH_TOTAL, MH_WORDS = 8 };
+/* Per-member descriptor words following the header. */
+enum { MD_NDFA = 0, MD_NCLASSES, MD_START, MD_ENDCLASS, MD_WIDE,
+       MD_MATCHALL, MD_TABLE_OFF, MD_ACCEPT_OFF, MD_BCLASS_OFF,
+       MD_WORDS = 10 };
+
+#define MDFA_MAX_ESC 2          /* accel only for <= 2 escape bytes */
+
+typedef struct {
+    int32_t n_dfa, n_classes, start, end_class, wide, match_all;
+    const uint16_t *tab16;      /* [n_dfa * n_classes] when !wide */
+    const uint32_t *tab32;      /* [n_dfa * n_classes] when wide */
+    const uint8_t *accept;      /* [n_dfa] */
+    const int32_t *bc;          /* [256], entries < n_classes */
+    int esc_n;                  /* start-state escape bytes (-1 = many) */
+    uint8_t esc[MDFA_MAX_ESC];
+} mdfa_member;
+
+static int
+mdfa_parse_blob(const char *blob, Py_ssize_t blen, int32_t *m_out,
+                mdfa_member **members_out)
+{
+    if (blen < MH_WORDS * 4)
+        return -1;
+    const int32_t *h = (const int32_t *)blob;
+    if (h[MH_MAGIC] != MDFA_MAGIC || h[MH_VERSION] != MDFA_VERSION
+        || h[MH_TOTAL] != (int32_t)blen)
+        return -1;
+    int32_t M = h[MH_M];
+    if (M < 1
+        || (int64_t)MH_WORDS * 4 + (int64_t)M * MD_WORDS * 4 > (int64_t)blen)
+        return -1;
+    mdfa_member *mem = PyMem_Malloc((size_t)M * sizeof(mdfa_member));
+    if (!mem)
+        return -1;
+    for (int32_t m = 0; m < M; m++) {
+        const int32_t *d = h + MH_WORDS + (size_t)m * MD_WORDS;
+        mdfa_member *mm = &mem[m];
+        mm->n_dfa = d[MD_NDFA];
+        mm->n_classes = d[MD_NCLASSES];
+        mm->start = d[MD_START];
+        mm->end_class = d[MD_ENDCLASS];
+        mm->wide = d[MD_WIDE];
+        mm->match_all = d[MD_MATCHALL];
+        if (mm->n_dfa < 1 || mm->n_classes < 1
+            || mm->start < 0 || mm->start >= mm->n_dfa
+            || mm->end_class < 0 || mm->end_class >= mm->n_classes
+            || (mm->wide != 0 && mm->wide != 1)
+            || (mm->match_all != 0 && mm->match_all != 1)) {
+            PyMem_Free(mem);
+            return -1;
+        }
+        const void *tab = sweep_arr(blob, blen, d[MD_TABLE_OFF],
+                                    (int64_t)mm->n_dfa * mm->n_classes,
+                                    mm->wide ? 4 : 2);
+        mm->accept = sweep_arr(blob, blen, d[MD_ACCEPT_OFF],
+                               mm->n_dfa, 1);
+        mm->bc = sweep_arr(blob, blen, d[MD_BCLASS_OFF], 256, 4);
+        if (!tab || !mm->accept || !mm->bc) {
+            PyMem_Free(mem);
+            return -1;
+        }
+        mm->tab16 = (const uint16_t *)tab;
+        mm->tab32 = (const uint32_t *)tab;
+        for (int c = 0; c < 256; c++) {
+            if (mm->bc[c] < 0 || mm->bc[c] >= mm->n_classes) {
+                PyMem_Free(mem);
+                return -1;
+            }
+        }
+        /* Start-state escape set for the memchr acceleration: bytes
+         * whose class maps start anywhere but back to start. */
+        mm->esc_n = 0;
+        for (int c = 0; c < 256 && mm->esc_n >= 0; c++) {
+            uint32_t nxt = mm->wide
+                ? mm->tab32[(size_t)mm->start * mm->n_classes
+                            + (uint32_t)mm->bc[c]]
+                : mm->tab16[(size_t)mm->start * mm->n_classes
+                            + (uint32_t)mm->bc[c]];
+            if (nxt == (uint32_t)mm->start)
+                continue;
+            if (mm->esc_n >= MDFA_MAX_ESC)
+                mm->esc_n = -1;  /* too many: plain table walk */
+            else
+                mm->esc[mm->esc_n++] = (uint8_t)c;
+        }
+    }
+    *m_out = M;
+    *members_out = mem;
+    return 0;
+}
+
+typedef struct {
+    const mdfa_member *mem;     /* [M] parsed program members */
+    int32_t M;
+    int32_t n_ord;              /* members to scan (order entries) */
+    const uint8_t *src;
+    Py_ssize_t src_len;
+    const int32_t *ov;          /* [B+1] framed offsets */
+    const uint8_t *cand;        /* [B, stride] candidate bytes */
+    Py_ssize_t stride;
+    const int32_t *cols;        /* [M] member -> cand column */
+    const int32_t *order;       /* [n_ord] member scan order — the
+                                 * caller may omit members it knows
+                                 * have zero candidates */
+    uint8_t *out;               /* [B] verdict bytes (monotonic 0->1) */
+    long long scanned;          /* candidate cells actually scanned */
+    Py_ssize_t lo, hi;          /* row range for this worker */
+    int bad;                    /* 1 = offsets, 2 = table state id */
+} gs_job;
+
+/* One (row, member) cell: dfa_scan's scalar loop with an in-loop
+ * state-id bound check (the blob is untrusted bytes — a corrupt table
+ * entry must raise, not index past accept[]) and the memchr start-
+ * state acceleration (header comment). Returns 1 on accept. */
+static inline int
+gs_scan_cell(const mdfa_member *d, const uint8_t *row, Py_ssize_t len,
+             int *bad)
+{
+    const uint32_t nc = (uint32_t)d->n_classes;
+    const uint32_t nd = (uint32_t)d->n_dfa;
+    const uint32_t start = (uint32_t)d->start;
+    uint32_t s = start;
+    if (d->accept[s])
+        return 1;
+    const uint8_t *p = row;
+    const uint8_t *pe = row + len;
+    if (d->esc_n == 0)
+        p = pe;                 /* no byte ever leaves the start state */
+    while (p < pe) {
+        if (s == start && d->esc_n > 0) {
+            /* Every byte before the next escape byte provably maps
+             * start -> start: jump straight there. */
+            const uint8_t *q = memchr(p, d->esc[0], (size_t)(pe - p));
+            if (d->esc_n == 2) {
+                const uint8_t *q2 = memchr(p, d->esc[1],
+                                           (size_t)(pe - p));
+                if (!q || (q2 && q2 < q))
+                    q = q2;
+            }
+            if (!q)
+                break;
+            p = q;
+        }
+        s = d->wide ? d->tab32[s * nc + (uint32_t)d->bc[*p]]
+                    : d->tab16[s * nc + (uint32_t)d->bc[*p]];
+        p++;
+        if (s >= nd) {
+            *bad = 2;
+            return 0;
+        }
+        if (d->accept[s])
+            return 1;
+    }
+    s = d->wide ? d->tab32[s * nc + (uint32_t)d->end_class]
+                : d->tab16[s * nc + (uint32_t)d->end_class];
+    if (s >= nd) {
+        *bad = 2;
+        return 0;
+    }
+    return d->accept[s];
+}
+
+static void
+group_scan_rows(gs_job *job)
+{
+    const uint8_t *src = job->src;
+    const int32_t *ov = job->ov;
+    /* Validate this slice's offsets ONCE; the per-member passes below
+     * then trust them. */
+    for (Py_ssize_t i = job->lo; i < job->hi; i++) {
+        if (ov[i] < 0 || ov[i + 1] < ov[i] || ov[i + 1] > job->src_len) {
+            job->bad = 1;
+            return;
+        }
+    }
+    /* Group-major: one member's tables stay cache-hot across its
+     * whole row run; early-out semantics match the row-major walk
+     * cell for cell (header comment). */
+    for (int32_t k = 0; k < job->n_ord; k++) {
+        const int32_t g = job->order[k];
+        const mdfa_member *d = &job->mem[g];
+        const int32_t col = job->cols[g];
+        if (d->esc_n < 0 && !d->match_all && !d->wide
+            && !d->accept[d->start]) {
+            /* No start-state acceleration possible (broad escape
+             * set): interleave DFA_LANES candidate rows so the
+             * dependent state->table->state load chains overlap —
+             * the same trick as dfa_scan_rows, gathered over this
+             * member's candidate rows. */
+            const uint32_t nc = (uint32_t)d->n_classes;
+            const uint32_t nd = (uint32_t)d->n_dfa;
+            Py_ssize_t idx[DFA_LANES];
+            const uint8_t *p[DFA_LANES], *pe[DFA_LANES];
+            uint32_t s[DFA_LANES];
+            int nl = 0;
+            for (Py_ssize_t i = job->lo; i <= job->hi; i++) {
+                if (i < job->hi) {
+                    if (job->out[i]
+                        || !job->cand[(size_t)i * job->stride + col])
+                        continue;
+                    job->scanned++;
+                    int32_t rlo = ov[i];
+                    Py_ssize_t len = ov[i + 1] - rlo;
+                    while (len > 0 && src[rlo + len - 1] == '\n')
+                        len--;
+                    idx[nl] = i;
+                    p[nl] = src + rlo;
+                    pe[nl] = p[nl] + len;
+                    s[nl] = (uint32_t)d->start;
+                    nl++;
+                    if (nl < DFA_LANES)
+                        continue;
+                }
+                unsigned active = 0;
+                for (int l = 0; l < nl; l++)
+                    if (p[l] < pe[l])
+                        active |= 1u << l;
+                    else
+                        s[l] = UINT32_MAX;  /* empty: end step below */
+                while (active) {
+                    for (int l = 0; l < nl; l++) {
+                        if (!(active & (1u << l)))
+                            continue;
+                        uint32_t nxt = d->tab16[s[l] * nc
+                                       + (uint32_t)d->bc[*p[l]]];
+                        p[l]++;
+                        if (nxt >= nd) {
+                            job->bad = 2;
+                            return;
+                        }
+                        if (d->accept[nxt]) {
+                            job->out[idx[l]] = 1;
+                            active &= ~(1u << l);
+                        } else if (p[l] == pe[l]) {
+                            s[l] = nxt;
+                            active &= ~(1u << l);
+                        } else {
+                            s[l] = nxt;
+                        }
+                    }
+                }
+                for (int l = 0; l < nl; l++) {
+                    if (job->out[idx[l]])
+                        continue;
+                    uint32_t sf = s[l] == UINT32_MAX
+                        ? (uint32_t)d->start : s[l];
+                    sf = d->tab16[sf * nc + (uint32_t)d->end_class];
+                    if (sf >= nd) {
+                        job->bad = 2;
+                        return;
+                    }
+                    if (d->accept[sf])
+                        job->out[idx[l]] = 1;
+                }
+                nl = 0;
+            }
+            continue;
+        }
+        for (Py_ssize_t i = job->lo; i < job->hi; i++) {
+            if (job->out[i]
+                || !job->cand[(size_t)i * job->stride + col])
+                continue;
+            job->scanned++;
+            int32_t rlo = ov[i];
+            Py_ssize_t len = ov[i + 1] - rlo;
+            while (len > 0 && src[rlo + len - 1] == '\n')
+                len--;
+            if (d->match_all
+                || gs_scan_cell(d, src + rlo, len, &job->bad))
+                job->out[i] = 1;
+            if (job->bad)
+                return;
+        }
+    }
+}
+
+static void *
+group_scan_worker(void *arg)
+{
+    group_scan_rows((gs_job *)arg);
+    return NULL;
+}
+
+static void
+group_scan_run(void *arg)
+{
+    group_scan_rows((gs_job *)arg);
+}
+
+static PyObject *
+group_scan(PyObject *self, PyObject *args)
+{
+    Py_buffer blob, payload, offs, cand, cols, order, outb;
+    Py_ssize_t B, stride;
+    if (!PyArg_ParseTuple(args, "y*y*y*ny*ny*y*w*", &blob, &payload,
+                          &offs, &B, &cand, &stride, &cols, &order,
+                          &outb))
+        return NULL;
+    int32_t M = 0;
+    mdfa_member *mem = NULL;
+    int ok = (B >= 0 && stride >= 1 && offs.len >= (B + 1) * 4
+              && mdfa_parse_blob((const char *)blob.buf, blob.len,
+                                 &M, &mem) == 0);
+    /* order may name FEWER members than the program holds — the
+     * caller omits members it knows have zero candidate rows. */
+    const int32_t n_ord = (int32_t)(order.len / 4);
+    if (ok && (cand.len < (int64_t)B * stride
+               || cols.len < (Py_ssize_t)M * 4
+               || n_ord > M || outb.len < B))
+        ok = 0;
+    if (ok) {
+        const int32_t *colv = (const int32_t *)cols.buf;
+        const int32_t *ordv = (const int32_t *)order.buf;
+        for (int32_t k = 0; k < M; k++)
+            if (colv[k] < 0 || colv[k] >= stride)
+                ok = 0;
+        for (int32_t k = 0; k < n_ord; k++)
+            if (ordv[k] < 0 || ordv[k] >= M)
+                ok = 0;
+    }
+    if (!ok) {
+        PyMem_Free(mem);
+        PyBuffer_Release(&blob);
+        PyBuffer_Release(&payload);
+        PyBuffer_Release(&offs);
+        PyBuffer_Release(&cand);
+        PyBuffer_Release(&cols);
+        PyBuffer_Release(&order);
+        PyBuffer_Release(&outb);
+        PyErr_SetString(PyExc_ValueError,
+                        "group_scan: malformed program blob or sizes");
+        return NULL;
+    }
+    /* Escape-byte density sampling: the memchr acceleration LOSES to
+     * the interleaved table walk when the escape byte saturates the
+     * corpus (an 'e' every few bytes means a memchr restart per hit);
+     * histogram the payload head once and demote dense-escape members
+     * to the interleaved path. Pure cost heuristic — both paths step
+     * the identical automaton. */
+    {
+        size_t hn = payload.len < 4096 ? (size_t)payload.len : 4096;
+        uint32_t hist[256] = {0};
+        const uint8_t *hp = (const uint8_t *)payload.buf;
+        for (size_t i = 0; i < hn; i++)
+            hist[hp[i]]++;
+        for (int32_t m = 0; m < M; m++) {
+            if (mem[m].esc_n <= 0)
+                continue;
+            uint32_t cnt = 0;
+            for (int e = 0; e < mem[m].esc_n; e++)
+                cnt += hist[mem[m].esc[e]];
+            if (hn && (size_t)cnt * 32 > hn)
+                mem[m].esc_n = -1;
+        }
+    }
+    gs_job job = {mem, M, n_ord, (const uint8_t *)payload.buf,
+                  payload.len, (const int32_t *)offs.buf,
+                  (const uint8_t *)cand.buf, stride,
+                  (const int32_t *)cols.buf,
+                  (const int32_t *)order.buf, (uint8_t *)outb.buf,
+                  0, 0, B, 0};
+    int nthreads = host_threads();
+    long long scanned = 0;
+    int bad = 0;
+    if (nthreads <= 1 || B < 8192) {
+        /* Small slabs stay single-threaded (spawn cost would swamp a
+         * sub-ms scan) but still release the GIL: sibling Python
+         * threads sweep/pack while this slab confirms. */
+        Py_BEGIN_ALLOW_THREADS
+        group_scan_rows(&job);
+        Py_END_ALLOW_THREADS
+        scanned = job.scanned;
+        bad = job.bad;
+    } else {
+        gs_job jobs[64];
+        int count = slice_jobs((char *)jobs, sizeof(gs_job), &job, B,
+                               nthreads, 1, offsetof(gs_job, lo),
+                               offsetof(gs_job, hi));
+        Py_BEGIN_ALLOW_THREADS
+        dispatch_row_jobs((char *)jobs, sizeof(gs_job), count,
+                          group_scan_worker, group_scan_run);
+        Py_END_ALLOW_THREADS
+        for (int t = 0; t < count; t++) {
+            scanned += jobs[t].scanned;
+            bad |= jobs[t].bad;
+        }
+    }
+    PyMem_Free(mem);
+    PyBuffer_Release(&blob);
+    PyBuffer_Release(&payload);
+    PyBuffer_Release(&offs);
+    PyBuffer_Release(&cand);
+    PyBuffer_Release(&cols);
+    PyBuffer_Release(&order);
+    PyBuffer_Release(&outb);
+    if (bad) {
+        PyErr_SetString(PyExc_ValueError,
+                        bad & 2 ? "group_scan: table state id out of range"
+                                : "group_scan: offsets out of range");
+        return NULL;
+    }
+    return PyLong_FromLongLong(scanned);
+}
+
 static PyMethodDef Methods[] = {
     {"pack_lines", pack_lines, METH_VARARGS,
      "pack_lines(lines, width, rows) -> (bytes, int32-lengths-bytes)"},
@@ -1639,6 +2109,9 @@ static PyMethodDef Methods[] = {
     {"sweep_simd_level", sweep_simd_level, METH_VARARGS,
      "sweep_simd_level(requested=-1) -> resolved SIMD level"
      " (0 scalar, 1 ssse3, 2 avx2)"},
+    {"group_scan", group_scan, METH_VARARGS,
+     "group_scan(blob, payload, offsets, n_lines, cand, stride, cols,"
+     " order, out) -> scanned candidate cells (out updated in place)"},
     {NULL, NULL, 0, NULL},
 };
 

@@ -151,6 +151,11 @@ SPECS: dict[str, dict] = {
     "klogs_prefilter_groups": _m(
         "gauge", "Pattern groups compiled by the thousand-pattern "
         "index (grouping bounds per-group DFA construction)."),
+    "klogs_prefilter_reguard_total": _m(
+        "counter", "Guard factors banned by the adaptive re-guard: an "
+        "IndexedFilter measured these factors in more than "
+        "KLOGS_INDEX_DENSE_RATIO of swept lines after its probation "
+        "window and rebuilt the index on next-best guard clauses."),
     "klogs_prefilter_table_cache_events_total": _m(
         "counter", "On-disk DFA table cache outcomes during index "
         "compiles: hit (table loaded), miss (determinized fresh), "
@@ -184,6 +189,33 @@ SPECS: dict[str, dict] = {
         "counter", "Device-sweep degrades: build or kernel failures "
         "that dropped a batch (and every later one) to the fallback "
         "path."),
+
+    # -- batched group scan (indexed engine confirm stage) ------------
+    "klogs_groupscan_batches_total": _m(
+        "counter", "Slabs that ran the candidate group-scan (confirm) "
+        "stage, by implementation: native (one batched MultiDFA "
+        "group_scan call for every DFA-backed group) or python (the "
+        "per-group dispatch loop — the KLOGS_NATIVE_GROUPSCAN=off / "
+        "no-toolchain fallback and parity oracle).",
+        labels=("impl",), bounds={"impl": "enum"}),
+    "klogs_groupscan_rows_total": _m(
+        "counter", "Rows entering the group-scan stage with at least "
+        "one candidate DFA-backed group, by implementation.",
+        labels=("impl",), bounds={"impl": "enum"}),
+    "klogs_groupscan_cells_total": _m(
+        "counter", "Candidate (row, group) cells the confirm stage "
+        "actually scanned, by implementation — below the sweep's "
+        "candidate-cell count when early-out skipped cells whose row "
+        "an earlier group already accepted.",
+        labels=("impl",), bounds={"impl": "enum"}),
+    "klogs_groupscan_seconds": _m(
+        "histogram", "Group-scan stage latency per slab, by "
+        "implementation.", labels=("impl",), buckets=LATENCY_BUCKETS,
+        bounds={"impl": "enum"}),
+    "klogs_groupscan_fallback_total": _m(
+        "counter", "Batched group-scan degrades: a native kernel "
+        "failure dropped this process permanently to the per-group "
+        "Python loop."),
     "klogs_sweep_bypass_total": _m(
         "counter", "Adaptive sweep bypasses: an IndexedFilter observed "
         "a narrowing ratio above KLOGS_INDEX_BYPASS_RATIO after its "

@@ -1326,6 +1326,126 @@ def test_native_tier_simd_sweep_clean(tmp_path):
     assert _active(root, "native-tier") == []
 
 
+# The MultiDFA group-scan port's failure shapes (PR 14, docs/NATIVE.md):
+# a verdict byte written through the CPython API inside the GIL-released
+# block, a program-blob parser that skips the version/length header
+# checks, and a job-slice dispatch path that leaks its acquired buffers
+# on the early validation exit.
+_C_GROUPSCAN_LEAKY = """
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#define TOY_MAGIC 0x4B4D4446
+
+static int
+toy_parse_blob(const char *blob, Py_ssize_t blen, int *m_out)
+{
+    const int *h = (const int *)blob;
+    if (h[0] != TOY_MAGIC)
+        return -1;
+    *m_out = h[2];
+    return 0;
+}
+
+static PyObject *
+scanny(PyObject *self, PyObject *args)
+{
+    Py_buffer blob, cand, outb;
+    if (!PyArg_ParseTuple(args, "y*y*w*", &blob, &cand, &outb))
+        return NULL;
+    int m = 0;
+    int ok = toy_parse_blob((const char *)blob.buf, blob.len, &m) == 0;
+    if (ok && cand.len < m)
+        ok = 0;
+    if (ok && outb.len < m)
+        ok = 0;
+    if (ok && m > 4096)
+        ok = 0;
+    if (!ok) {
+        PyErr_SetString(PyExc_ValueError, "bad blob");
+        return NULL;
+    }
+    Py_BEGIN_ALLOW_THREADS
+    PyBytes_AS_STRING(outb.obj)[0] = 1;
+    Py_END_ALLOW_THREADS
+    PyBuffer_Release(&blob);
+    PyBuffer_Release(&cand);
+    PyBuffer_Release(&outb);
+    Py_RETURN_NONE;
+}
+"""
+
+_C_GROUPSCAN_CLEAN = """
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#define TOY_MAGIC 0x4B4D4446
+#define TOY_VERSION 1
+
+static int
+toy_parse_blob(const char *blob, Py_ssize_t blen, int *m_out)
+{
+    if (blen < 16)
+        return -1;
+    const int *h = (const int *)blob;
+    if (h[0] != TOY_MAGIC || h[1] != TOY_VERSION
+        || h[3] != (int)blen)
+        return -1;
+    *m_out = h[2];
+    return 0;
+}
+
+static PyObject *
+scanny(PyObject *self, PyObject *args)
+{
+    Py_buffer blob, cand, outb;
+    if (!PyArg_ParseTuple(args, "y*y*w*", &blob, &cand, &outb))
+        return NULL;
+    int m = 0;
+    if (toy_parse_blob((const char *)blob.buf, blob.len, &m) < 0) {
+        PyBuffer_Release(&blob);
+        PyBuffer_Release(&cand);
+        PyBuffer_Release(&outb);
+        PyErr_SetString(PyExc_ValueError, "bad blob");
+        return NULL;
+    }
+    char *verdicts = (char *)outb.buf;
+    Py_BEGIN_ALLOW_THREADS
+    verdicts[0] = 1;
+    Py_END_ALLOW_THREADS
+    PyBuffer_Release(&blob);
+    PyBuffer_Release(&cand);
+    PyBuffer_Release(&outb);
+    Py_RETURN_NONE;
+}
+"""
+
+
+def test_native_tier_groupscan_seeded(tmp_path):
+    """The group-scan failure modes the lint must catch: a verdict
+    write through the CPython API with the GIL released, a blob parser
+    missing the version + total-length checks, and an early exit that
+    leaks every acquired buffer."""
+    root = _tree(tmp_path,
+                 {"klogs_tpu/native/gs_bad.c": _C_GROUPSCAN_LEAKY})
+    found = _active(root, "native-tier")
+    msgs = "\n".join(f.message for f in found)
+    assert "'PyBytes_AS_STRING'" in msgs and "GIL-released" in msgs
+    assert "blob header under-validation" in msgs
+    assert "*_VERSION check" in msgs and "'blen'" in msgs
+    assert "return without PyBuffer_Release(&blob)" in msgs
+    assert "return without PyBuffer_Release(&cand)" in msgs
+    assert "return without PyBuffer_Release(&outb)" in msgs
+
+
+def test_native_tier_groupscan_clean(tmp_path):
+    """The same entrypoint with a fully-validated header, snapshot
+    pointer writes, and release-on-every-exit raises nothing."""
+    root = _tree(tmp_path,
+                 {"klogs_tpu/native/gs_good.c": _C_GROUPSCAN_CLEAN})
+    assert _active(root, "native-tier") == []
+
+
 # -- suppression-audit -------------------------------------------------
 
 def test_suppression_audit_stale_and_unknown(tmp_path):

@@ -85,10 +85,22 @@ def test_bench_k_axis_contract(tmp_path):
     for row in rec["rows"]:
         for key in ("indexed_lps", "scan_all_lps", "lps_pattern",
                     "narrowing_ratio", "auto_engine", "n_groups",
-                    "speedup_vs_scan_all"):
+                    "speedup_vs_scan_all", "sweep_s", "group_scan_s",
+                    "merge_s", "group_scan_impl", "parity",
+                    "banned_factors"):
             assert key in row, key
         assert 0.0 <= row["narrowing_ratio"] <= 1.0
         assert row["indexed_lps"] > 0 and row["scan_all_lps"] > 0
+        # PR 14: per-stage breakdown + measured mask parity. The
+        # confirm stage must report which implementation ran (native =
+        # the batched MultiDFA group_scan kernel, python = the
+        # per-group dispatch loop), and indexed vs scan-all masks must
+        # be EQUAL, not merely equinumerous.
+        assert row["parity"] is True
+        assert row["group_scan_impl"] in ("native", "python")
+        assert row["sweep_s"] >= 0 and row["group_scan_s"] >= 0
+        assert row["merge_s"] >= 0
+        assert row["banned_factors"] >= 0
     # Same verdicts from both configurations is asserted inside the
     # sweep itself; above the auto threshold the indexed engine is
     # the production path.

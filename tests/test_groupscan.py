@@ -1,0 +1,513 @@
+"""Batched MultiDFA group scan: blob ABI validation, three-way parity
+(python oracle / per-group-native / batched-native), early-out and
+ordering semantics, env discipline, metrics, and the seeded
+differential-fuzz subset.
+
+The load-bearing invariant: ``group_scan`` (one GIL-released native
+call over the whole candidate matrix) must produce verdicts identical
+to the per-group dispatch loop it replaced — the loop IS the parity
+oracle, and ``KLOGS_NATIVE_GROUPSCAN=off`` must stay byte-identical to
+the pre-batching path."""
+
+import numpy as np
+import pytest
+
+from klogs_tpu import native
+from klogs_tpu.filters.base import frame_lines
+from klogs_tpu.filters.compiler.index import (
+    multidfa_blob,
+    native_groupscan_mode,
+)
+from klogs_tpu.filters.cpu import DFAFilter, RegexFilter
+from klogs_tpu.filters.indexed import IndexedFilter
+from klogs_tpu.obs.metrics import Registry
+
+
+def require_native():
+    if native.hostops is None or not hasattr(native.hostops,
+                                             "group_scan"):
+        pytest.skip("native extension unavailable (no C toolchain)")
+
+
+def _frame(lines):
+    payload, offsets, _ = frame_lines(lines)
+    return payload, np.asarray(offsets, dtype=np.int32)
+
+
+def _scan(blob, payload, offsets, cand, cols=None, order=None,
+          out=None):
+    B = len(offsets) - 1
+    cand = np.ascontiguousarray(cand, dtype=np.uint8)
+    M = len(cols) if cols is not None else cand.shape[1]
+    if cols is None:
+        cols = np.arange(M, dtype=np.int32)
+    if order is None:
+        order = np.arange(M, dtype=np.int32)
+    if out is None:
+        out = np.zeros(B, dtype=bool)
+    scanned = native.hostops.group_scan(
+        blob, payload, offsets, B, cand, cand.shape[1],
+        np.ascontiguousarray(cols, dtype=np.int32),
+        np.ascontiguousarray(order, dtype=np.int32), out)
+    return out, scanned
+
+
+# -- blob ABI + validation --------------------------------------------
+
+
+def _tables(patterns):
+    return DFAFilter(patterns, cache=False).tables
+
+
+def test_blob_roundtrip_single_member():
+    require_native()
+    blob = multidfa_blob([_tables(["needle"])])
+    payload, offsets = _frame([b"a needle here", b"nothing", b"needle"])
+    out, scanned = _scan(blob, payload, offsets,
+                         np.ones((3, 1), dtype=bool))
+    assert out.tolist() == [True, False, True]
+    assert scanned == 3
+
+
+def test_blob_requires_tables():
+    with pytest.raises(ValueError):
+        multidfa_blob([])
+
+
+@pytest.mark.parametrize("mangle", [
+    lambda b: b[:16],                      # truncated header
+    lambda b: b"\0\0\0\0" + b[4:],         # bad magic
+    lambda b: b[:4] + b"\x63\0\0\0" + b[8:],   # bad version
+    lambda b: b[:12] + b"\x01\0\0\0" + b[16:],  # total_len lies
+    lambda b: b[:40] + b"\xff\xff\xff\x7f" + b[44:],  # desc off OOB
+])
+def test_malformed_blob_rejected(mangle):
+    """Header under-validation is a memory-safety bug: every mangled
+    blob must raise ValueError, never read out of bounds."""
+    require_native()
+    blob = mangle(multidfa_blob([_tables(["needle"])]))
+    payload, offsets = _frame([b"a needle here"])
+    with pytest.raises(ValueError):
+        _scan(blob, payload, offsets, np.ones((1, 1), dtype=bool))
+
+
+def test_corrupt_table_state_id_rejected():
+    """A state id pointing past the DFA must raise (the in-loop bound
+    check), not index past accept[]."""
+    require_native()
+    t = _tables(["needle"])
+    blob = bytearray(multidfa_blob([t]))
+    head = np.frombuffer(bytes(blob), dtype=np.int32, count=18)
+    table_off = head[14]  # member 0 descriptor word 6
+    bad = np.asarray([60000], dtype=t.table.dtype).tobytes()
+    blob[table_off:table_off + len(bad)] = bad
+    payload, offsets = _frame([b"zzz needle zzz"])
+    with pytest.raises(ValueError):
+        _scan(bytes(blob), payload, offsets,
+              np.ones((1, 1), dtype=bool))
+
+
+def test_bad_offsets_rejected():
+    require_native()
+    blob = multidfa_blob([_tables(["needle"])])
+    payload, offsets = _frame([b"a needle", b"x"])
+    off = offsets.copy()
+    off[1] = 99  # past the payload
+    with pytest.raises(ValueError):
+        _scan(blob, payload, off, np.ones((2, 1), dtype=bool))
+
+
+def test_bad_cols_and_order_rejected():
+    require_native()
+    blob = multidfa_blob([_tables(["needle"])])
+    payload, offsets = _frame([b"a needle"])
+    with pytest.raises(ValueError):
+        _scan(blob, payload, offsets, np.ones((1, 1), dtype=bool),
+              cols=np.asarray([5], dtype=np.int32))  # >= stride
+    with pytest.raises(ValueError):
+        _scan(blob, payload, offsets, np.ones((1, 1), dtype=bool),
+              order=np.asarray([3], dtype=np.int32))  # >= M
+
+
+# -- scan semantics ----------------------------------------------------
+
+
+def test_candidate_gating_and_monotonic_out():
+    """Cells the candidate matrix rules out are never scanned; rows
+    already accepted on entry are skipped entirely (monotonic 0->1)."""
+    require_native()
+    blob = multidfa_blob([_tables(["aaa"]), _tables(["bbb"])])
+    lines = [b"aaa bbb", b"aaa", b"bbb", b"neither"]
+    payload, offsets = _frame(lines)
+    cand = np.zeros((4, 2), dtype=bool)
+    cand[:, 1] = True  # only member 1 ("bbb") may scan
+    out, scanned = _scan(blob, payload, offsets, cand)
+    assert out.tolist() == [True, False, True, False]
+    assert scanned == 4  # member 0's cells were all ruled out
+    out2 = np.zeros(4, dtype=bool)
+    out2[1] = True  # pre-accepted: its cells must be skipped
+    out3, scanned3 = _scan(blob, payload, offsets, cand, out=out2)
+    assert out3.tolist() == [True, True, True, False]
+    assert scanned3 == scanned - 1
+
+
+def test_early_out_order_skips_later_members():
+    """A row accepted by an earlier member in `order` never scans the
+    later members' cells (the scanned-cell count proves it)."""
+    require_native()
+    blob = multidfa_blob([_tables(["hit"]), _tables(["hit"])])
+    lines = [b"a hit row", b"another hit"]
+    payload, offsets = _frame(lines)
+    cand = np.ones((2, 2), dtype=bool)
+    _, scanned = _scan(blob, payload, offsets, cand,
+                       order=np.asarray([0, 1], dtype=np.int32))
+    assert scanned == 2  # member 0 accepts both; member 1 never runs
+
+
+def test_order_may_omit_members():
+    require_native()
+    blob = multidfa_blob([_tables(["aaa"]), _tables(["bbb"])])
+    payload, offsets = _frame([b"aaa bbb"])
+    out, scanned = _scan(blob, payload, offsets,
+                         np.ones((1, 2), dtype=bool),
+                         order=np.asarray([1], dtype=np.int32))
+    assert out.tolist() == [True]
+    assert scanned == 1  # member 0 omitted entirely
+
+
+def test_match_all_member():
+    require_native()
+    # ".*" determinizes to a match-all DFA: candidates accept with no
+    # byte walk, gated rows stay untouched.
+    blob = multidfa_blob([_tables([".*"])])
+    payload, offsets = _frame([b"x", b"y", b""])
+    cand = np.asarray([[1], [0], [1]], dtype=bool)
+    out, _ = _scan(blob, payload, offsets, cand)
+    assert out.tolist() == [True, False, True]
+
+
+def test_stride_column_mapping():
+    """The engine passes its FULL group matrix: member columns are
+    picked via cols, other columns must be ignored."""
+    require_native()
+    blob = multidfa_blob([_tables(["aaa"])])
+    payload, offsets = _frame([b"aaa", b"aaa"])
+    cand = np.zeros((2, 5), dtype=bool)
+    cand[0, 3] = True  # member 0 lives in column 3
+    cand[1, 2] = True  # a foreign column: not ours
+    out, scanned = _scan(blob, payload, offsets, cand,
+                         cols=np.asarray([3], dtype=np.int32))
+    assert out.tolist() == [True, False]
+    assert scanned == 1
+
+
+def test_newline_and_dollar_semantics():
+    """Trailing-newline strip + end-sentinel handling must match
+    dfa_scan exactly (the $ pattern class)."""
+    require_native()
+    blob = multidfa_blob([_tables([r"end$"])])
+    lines = [b"the end\n", b"the end", b"end here", b"no"]
+    payload, offsets = _frame(lines)
+    out, _ = _scan(blob, payload, offsets, np.ones((4, 1), dtype=bool))
+    oracle = DFAFilter([r"end$"], cache=False).match_lines(lines)
+    assert out.tolist() == oracle
+
+
+def test_accel_vs_plain_same_verdicts():
+    """The memchr start-state acceleration is a pure cost heuristic:
+    literal-anchored members (1 escape byte) and broad members must
+    agree with the python oracle on boundary shapes."""
+    require_native()
+    from klogs_tpu.filters.compiler.dfa import scan_python
+
+    pats = ["zebra", "a+b"]
+    tabs = [_tables([p]) for p in pats]
+    blob = multidfa_blob(tabs)
+    lines = [b"zebra", b"zzebra", b"azzz", b"aab", b"ab", b"ba",
+             b"z" * 200, b"", b"zebr", b"ebra", b"xx zebra yy"]
+    payload, offsets = _frame(lines)
+    out, _ = _scan(blob, payload, offsets,
+                   np.ones((len(lines), 2), dtype=bool))
+    expect = np.zeros(len(lines), dtype=bool)
+    for t in tabs:
+        expect |= np.asarray(scan_python(t, lines), dtype=bool)
+    assert out.tolist() == expect.tolist()
+
+
+# -- engine wiring -----------------------------------------------------
+
+
+PATS = ["ERR!", "panic: out of memory", "FATAL|CRIT", r"[a-z]*\d",
+        "svc-0001 unreachable", r"errcode=\d{5}", "quota exceeded"]
+LINES = [b"an ERR! line", b"panic: out of memory now", b"CRIT boom",
+         b"benign text", b"", b"svc-0001 unreachable!!",
+         b"errcode=00002 here", b"tenant quota exceeded", b"abc9",
+         b"ERR", b"FATA", b"errcode=123"]
+
+
+def test_engine_modes_mask_identical(monkeypatch):
+    """auto/native/off produce identical verdicts, equal to the
+    re-oracle — off IS the pre-batching path (acceptance: byte-
+    identical fallback)."""
+    require_native()
+    oracle = RegexFilter(PATS).match_lines(LINES)
+    for mode in ("auto", "native", "off"):
+        monkeypatch.setenv("KLOGS_NATIVE_GROUPSCAN", mode)
+        f = IndexedFilter(PATS, cache=False)
+        assert f.match_lines(LINES) == oracle, mode
+        want = "python" if mode == "off" else "native"
+        assert f.group_scan_impl == want
+
+
+def test_engine_scan_all_comparator(monkeypatch):
+    """narrow=False (the honest scan-all comparator) also rides the
+    batched kernel, same verdicts."""
+    require_native()
+    oracle = RegexFilter(PATS).match_lines(LINES)
+    f = IndexedFilter(PATS, cache=False, narrow=False)
+    assert f.match_lines(LINES) == oracle
+    assert f.group_scan_impl == "native"
+
+
+def test_env_validation(monkeypatch):
+    monkeypatch.setenv("KLOGS_NATIVE_GROUPSCAN", "bogus")
+    with pytest.raises(ValueError, match="KLOGS_NATIVE_GROUPSCAN"):
+        native_groupscan_mode()
+    monkeypatch.setenv("KLOGS_NATIVE_GROUPSCAN", " Native ")
+    assert native_groupscan_mode() == "native"
+    monkeypatch.delenv("KLOGS_NATIVE_GROUPSCAN")
+    assert native_groupscan_mode() == "auto"
+
+
+def test_mode_native_requires_extension(monkeypatch):
+    require_native()
+    f = IndexedFilter(PATS, cache=False)
+    monkeypatch.setenv("KLOGS_NATIVE_GROUPSCAN", "native")
+    monkeypatch.setattr(native, "hostops", None)
+    with pytest.raises(RuntimeError, match="native group scan"):
+        f.match_lines(LINES)
+
+
+def test_auto_falls_back_without_extension(monkeypatch):
+    """auto degrades to the per-group loop (one loud notice handled
+    elsewhere) and still matches the oracle."""
+    oracle = RegexFilter(PATS).match_lines(LINES)
+    f = IndexedFilter(PATS, cache=False)
+    monkeypatch.setattr(native, "hostops", None)
+    monkeypatch.setenv("KLOGS_NATIVE_GROUPSCAN", "auto")
+    assert f.match_lines(LINES) == oracle
+    assert f.group_scan_impl == "python"
+
+
+def test_kernel_failure_degrades_loudly(monkeypatch):
+    """A kernel exception flips the engine permanently to the
+    per-group loop and counts klogs_groupscan_fallback_total."""
+    require_native()
+    reg = Registry()
+    f = IndexedFilter(PATS, cache=False, registry=reg)
+
+    def boom(*a, **k):
+        raise ValueError("synthetic kernel fault")
+
+    monkeypatch.setattr(native.hostops, "group_scan", boom)
+    oracle = RegexFilter(PATS).match_lines(LINES)
+    assert f.match_lines(LINES) == oracle
+    assert f.group_scan_impl == "python"
+    assert f._groupscan_broken
+    assert reg.family(
+        "klogs_groupscan_fallback_total").value == 1
+    # ... and stays on the loop without re-trying the kernel.
+    assert f.match_lines(LINES) == oracle
+
+
+def test_groupscan_metrics(monkeypatch):
+    require_native()
+    reg = Registry()
+    f = IndexedFilter(PATS, cache=False, registry=reg)
+    f.match_lines(LINES)
+    batches = reg.family("klogs_groupscan_batches_total")
+    assert batches.labels(impl="native").value == 1
+    assert reg.family("klogs_groupscan_seconds").labels(
+        impl="native").count == 1
+    cells = reg.family("klogs_groupscan_cells_total").labels(
+        impl="native").value
+    assert cells >= 0
+    monkeypatch.setenv("KLOGS_NATIVE_GROUPSCAN", "off")
+    f.match_lines(LINES)
+    assert batches.labels(impl="python").value == 1
+
+
+def test_multidfa_blob_cache_and_incremental_rebuild():
+    require_native()
+    f = IndexedFilter(PATS, cache=False)
+    b1 = f._multidfa()
+    assert f._multidfa() is b1  # cached
+    # Simulate the DFA LRU refreshing ONE member: only that member's
+    # chunks re-serialize, the rest come from the chunk cache.
+    g = f._dfa_cols[0]
+    fresh = DFAFilter(f.groups[g].patterns, cache=False)
+    f.groups[g].filt = fresh
+    b2 = f._multidfa()
+    assert b2 is not b1 and len(b2) > 0
+    assert f._multidfa() is b2
+
+
+def test_stage_attribution_and_impl():
+    require_native()
+    f = IndexedFilter(PATS, cache=False)
+    f.match_lines(LINES)
+    assert f.stage_s["sweep"] > 0
+    assert f.stage_s["group_scan"] > 0
+    assert f.group_scan_impl in ("native", "python")
+
+
+def test_whole_slab_fast_path_restricts_to_undecided(monkeypatch):
+    """PR 14 satellite: an always-candidate group scanned AFTER most
+    rows are decided gathers only the undecided rows instead of
+    re-scanning the whole slab (counted via the gathered sub-frame's
+    dispatch)."""
+    require_native()
+    f = IndexedFilter(PATS, cache=False)
+    payload, offsets = _frame(LINES)
+    B = len(LINES)
+    gm = np.ones((B, len(f.groups)), dtype=bool)
+    out = np.zeros(B, dtype=bool)
+    out[:B - 2] = True  # most rows already decided
+    g = f._rest_cols[0] if f._rest_cols else f._dfa_cols[0]
+    calls = {}
+    grp = f.groups[g]
+    orig = grp.filt.dispatch_framed
+
+    def spy(payload_, offsets_):
+        calls["n"] = len(offsets_) - 1
+        return orig(payload_, offsets_)
+
+    monkeypatch.setattr(grp.filt, "dispatch_framed", spy)
+    arr = np.frombuffer(payload, dtype=np.uint8)
+    lens = np.diff(offsets)
+    f._scan_group(g, gm, out, payload, offsets, arr, lens)
+    assert calls["n"] == 2  # only the undecided rows were dispatched
+
+
+# -- adaptive re-guard -------------------------------------------------
+
+
+def test_reguard_dense_factor_rebuilds_index(monkeypatch):
+    """A guard factor present in ~every line gets banned after the
+    probation window; verdicts are unchanged and the pattern re-guards
+    on its next-best clause (or its group goes always-candidate)."""
+    pats = [r"(?:RAREA|RAREB).*stamp=\d+", "needle-lit"]
+    lines = [b"stamp=123 benign %d" % i for i in range(64)]
+    lines += [b"RAREA hit stamp=9", b"needle-lit", b"RAREB x stamp=1"]
+    monkeypatch.setenv("KLOGS_INDEX_DENSE_LINES", "32")
+    reg = Registry()
+    f = IndexedFilter(pats, cache=False, registry=reg)
+    oracle = RegexFilter(pats).match_lines(lines)
+    assert f.match_lines(lines) == oracle
+    assert f._reguarded
+    assert b"stamp=" in f.banned_factors
+    assert reg.family("klogs_prefilter_reguard_total").value >= 1
+    # Rebuilt index narrows again AND still matches.
+    assert f.match_lines(lines) == oracle
+    # The re-guarded pattern now guards on the RARE alternation, so
+    # the benign lines are no longer candidates for its group.
+    gm = f.index.group_candidates(*_frame([b"stamp=55 benign"])[:2])
+    g = int(f.plan.group_of[0])
+    assert g in f.index.always_groups or not gm[0, g]
+
+
+def test_reguard_noop_on_selective_corpus(monkeypatch):
+    monkeypatch.setenv("KLOGS_INDEX_DENSE_LINES", "8")
+    f = IndexedFilter(["rare-needle-xyz"], cache=False)
+    lines = [b"benign line %d" % i for i in range(32)]
+    f.match_lines(lines)
+    assert f._reguarded
+    assert f.banned_factors == ()
+
+
+def test_reguard_defers_on_tiny_slab(monkeypatch):
+    """A tiny follow-mode batch crossing the probation threshold must
+    NOT run the density measurement (a needle appearing once in a
+    1-line slab would read as 'dense' and get banned permanently);
+    the one-shot stays armed for a representative slab."""
+    monkeypatch.setenv("KLOGS_INDEX_DENSE_LINES", "2048")
+    f = IndexedFilter(["ERRX123-needle"], cache=False)
+    f.match_lines([b"benign %d" % i for i in range(2100)][:2100])
+    assert f._reguarded  # big slab: measured (and found nothing)
+    f2 = IndexedFilter(["ERRX123-needle"], cache=False)
+    for _ in range(300):
+        f2.match_lines([b"benign", b"x", b"ERRX123-needle hit",
+                        b"y", b"z", b"w", b"v"])
+    # Probation crossed long ago, but every slab was tiny: deferred,
+    # and the needle guard was never spuriously banned.
+    assert not f2._reguarded
+    assert f2.banned_factors == ()
+
+
+def test_reguard_bans_dense_3byte_factor(monkeypatch):
+    """Ext-tier (3-byte) factors report per-extension hit tuples; the
+    ban must aggregate them per factor or omnipresent short guards —
+    exactly the target of the measurement — slip under the threshold
+    piecewise."""
+    monkeypatch.setenv("KLOGS_INDEX_DENSE_LINES", "32")
+    f = IndexedFilter([r"zq=(\d+)", "rare-needle-xyz"], cache=False)
+    assert any(len(fac) == 3 for fac in f.index.factors)
+    # 'zq=' on every line, each followed by a DIFFERENT digit run.
+    lines = [b"zq=%d benign %d" % (i, i) for i in range(64)]
+    oracle = RegexFilter([r"zq=(\d+)", "rare-needle-xyz"]).match_lines(
+        lines)
+    assert f.match_lines(lines) == oracle
+    assert b"zq=" in f.banned_factors
+
+
+def test_reguard_env_validation(monkeypatch):
+    monkeypatch.setenv("KLOGS_INDEX_DENSE_RATIO", "nope")
+    with pytest.raises(ValueError, match="KLOGS_INDEX_DENSE_RATIO"):
+        IndexedFilter(["abc-lit"], cache=False)
+
+
+# -- differential fuzz (seeded tier-1 subset) --------------------------
+
+
+def test_fuzz_seeded_subset():
+    """~40 seeded trials of the three-way differential fuzzer (python
+    oracle vs per-group-native vs batched-native; real + random
+    candidate matrices). The long loop lives in
+    tools/fuzz_groupscan.py and the slow marker below."""
+    require_native()
+    from tools.fuzz_groupscan import run_trials
+
+    assert run_trials(40, seed=20260804) > 0
+
+
+@pytest.mark.slow
+def test_fuzz_long_loop():
+    require_native()
+    from tools.fuzz_groupscan import run_trials
+
+    assert run_trials(1500, seed=1337) > 0
+
+
+@pytest.mark.slow
+def test_threaded_rows_parity(monkeypatch):
+    """KLOGS_HOST_THREADS>1 splits rows across workers (disjoint
+    verdict ranges): verdicts must equal the single-threaded scan on
+    a slab big enough to cross the threading threshold."""
+    require_native()
+    rng = np.random.default_rng(7)
+    lines = []
+    for i in range(9000):
+        body = bytes(rng.integers(97, 122, size=24, dtype=np.uint8))
+        if i % 11 == 0:
+            body += b" needle"
+        if i % 17 == 0:
+            body += b" zebra9"
+        lines.append(body)
+    blob = multidfa_blob([_tables(["needle"]), _tables([r"zebra\d"])])
+    payload, offsets = _frame(lines)
+    cand = np.ones((len(lines), 2), dtype=bool)
+    monkeypatch.delenv("KLOGS_HOST_THREADS", raising=False)
+    single, _ = _scan(blob, payload, offsets, cand)
+    monkeypatch.setenv("KLOGS_HOST_THREADS", "4")
+    multi, _ = _scan(blob, payload, offsets, cand)
+    assert np.array_equal(single, multi)

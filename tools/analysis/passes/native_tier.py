@@ -9,7 +9,8 @@ tier-1, and ``tools/build_native_asan.py`` (docs/NATIVE.md) compiles
 the extension under ASan/UBSan and re-runs the parity tests.
 
 This is a LINT, not a prover: it reasons about lexical windows, not
-control flow. Three rules, each encoding a CPython-API contract:
+control flow. Four rules, the first three encoding CPython-API
+contracts and the fourth the untrusted-blob parsing contract:
 
 1. **Buffer release pairing.** A ``Py_buffer`` filled by
    ``PyArg_ParseTuple(... "y*" ...)`` / ``PyObject_GetBuffer`` must be
@@ -26,6 +27,14 @@ control flow. Three rules, each encoding a CPython-API contract:
    ``Py_BEGIN_ALLOW_THREADS`` and ``Py_END_ALLOW_THREADS`` must not
    call into the interpreter (``Py*``/``Py_*`` identifiers): the
    row-parallel workers run concurrently with other Python threads.
+4. **Blob-parse discipline.** A ``*_parse_blob`` function consumes an
+   UNTRUSTED bytes program (the SIMD sweep's tables, the MultiDFA
+   group-scan program): its body must reference a ``*_MAGIC`` and a
+   ``*_VERSION`` token and compare its length parameter (the first
+   parameter whose name contains ``len``) — a parser that skips the
+   header checks turns every downstream offset into a wild read
+   (ASan finds it only on the payload that happens to trip it; this
+   gate fails tier-1 regardless).
 
 Findings in .c files cannot be suppressed inline (the ``# klogs:``
 comment grammar is Python's); fix the code or adjust the rule.
@@ -112,6 +121,50 @@ class NativeTierPass(Pass):
         for name, start, end in _functions(lines):
             findings.extend(
                 self._check_function(rel, name, lines, start, end))
+            if name.endswith("_parse_blob"):
+                findings.extend(
+                    self._check_parse_blob(rel, name, lines, start,
+                                           end))
+        return findings
+
+    # -- rule 4: blob-parse discipline ---------------------------------
+
+    def _check_parse_blob(self, rel: str, name: str,
+                          lines: "list[str]", start: int,
+                          end: int) -> list[Finding]:
+        """A *_parse_blob function must check magic, version, and the
+        blob length before trusting any offset (module docstring)."""
+        findings: list[Finding] = []
+        # Parameter list: the declaration lines just above the body.
+        sig = " ".join(lines[max(0, start - 4):start + 1])
+        body = "\n".join(lines[start:end + 1])
+        m = re.search(rf"{re.escape(name)}\s*\(([^)]*)\)", sig)
+        params = m.group(1) if m else ""
+        len_param = None
+        for piece in params.split(","):
+            words = re.findall(r"\w+", piece)
+            if words and "len" in words[-1]:
+                len_param = words[-1]
+                break
+        missing = []
+        if not re.search(r"\w+_MAGIC\b", body):
+            missing.append("a *_MAGIC check")
+        if not re.search(r"\w+_VERSION\b", body):
+            missing.append("a *_VERSION check")
+        if len_param is None:
+            missing.append("a length parameter (no *len* param found)")
+        elif not re.search(
+                rf"(?:[<>]=?|[!=]=)\s*[^;]*\b{re.escape(len_param)}\b"
+                rf"|\b{re.escape(len_param)}\b\s*(?:[<>]=?|[!=]=)",
+                body):
+            missing.append(f"a comparison of {len_param!r}")
+        if missing:
+            findings.append(self.finding(
+                rel, start + 1,
+                f"{name}(): blob header under-validation — missing "
+                + ", ".join(missing)
+                + " (an unchecked program blob turns every downstream "
+                "offset into a wild read)"))
         return findings
 
     # -- rule 1 + 2: per function -------------------------------------

@@ -35,11 +35,13 @@ import tempfile
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SRC = os.path.join(ROOT, "klogs_tpu", "native", "_hostops.c")
 SAN_FLAGS = ["-fsanitize=address,undefined", "-fno-sanitize-recover=all"]
-# The sweep parity suite rides along so the GIL-released SIMD kernel
-# (unaligned loads, masked tails, hash probes over untrusted offsets)
-# is exercised under ASan/UBSan in every tier-1 run; its `slow` loops
+# The sweep + group-scan parity suites ride along so the GIL-released
+# kernels (unaligned loads, masked tails, hash probes over untrusted
+# offsets, the MultiDFA walk over an untrusted program blob) are
+# exercised under ASan/UBSan in every tier-1 run; their `slow` loops
 # are excluded to keep the gate fast.
-TEST_FILES = ["tests/test_native.py", "tests/test_native_sweep.py"]
+TEST_FILES = ["tests/test_native.py", "tests/test_native_sweep.py",
+              "tests/test_groupscan.py"]
 
 
 def _candidate_compilers() -> "list[str]":

@@ -1,0 +1,157 @@
+"""Differential fuzz of the batched native group scan (confirm stage).
+
+``group_scan`` in ``klogs_tpu/native/_hostops.c`` walks every (row,
+group) candidate cell of a slab through the MultiDFA program blob in
+one GIL-released call — group-major with early-out, memchr-accelerated
+start states, and an interleaved-lane walk. Its verdicts must equal,
+row for row, BOTH of:
+
+- the **python oracle**: pure-Python ``scan_python`` (the DFA scan's
+  reference loop) per DFA group plus ``match_lines`` for the
+  combined-re/re remainder, OR-gated by the same candidate matrix;
+- the **per-group-native path**: the pre-PR-14 dispatch loop
+  (``KLOGS_NATIVE_GROUPSCAN=off`` — gathered sub-frames through
+  ``dfa_scan``), which is also the engine's production fallback.
+
+Three-way equality on ADVERSARIAL inputs is what lets the fallback act
+as the kernel's parity oracle. Each trial builds a random pattern set
+(fuzz_sweep's generator: every factor tier, OR guards, unguarded
+always-candidate shapes), plants/splits factors across framed lines,
+then drives BOTH the engine's real sweep-derived candidate matrix and
+a RANDOM candidate matrix (the kernel must honor any gating the caller
+hands it — random matrices exercise early-out orderings, empty
+columns, and always-columns the sweep would never produce together).
+
+Usage: python tools/fuzz_groupscan.py [--trials N] [--seed S]
+Exit 1 on divergence (repro printed), 2 = SKIP without the native
+extension. A seeded ~40-trial subset runs in tier-1
+(tests/test_groupscan.py); this long loop is `slow` territory.
+"""
+
+import argparse
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from klogs_tpu.filters.base import frame_lines  # noqa: E402
+from klogs_tpu.filters.compiler.dfa import scan_python  # noqa: E402
+from tools.fuzz_sweep import rand_lines, rand_patterns  # noqa: E402
+
+
+def oracle_mask(filt, lines: "list[bytes]",
+                gm: np.ndarray) -> np.ndarray:
+    """Pure-Python reference: OR over groups of (candidate AND group
+    verdict), group verdicts via scan_python for DFA groups and the
+    group engine's own match_lines otherwise."""
+    B = len(lines)
+    out = np.zeros(B, dtype=bool)
+    for g, grp in enumerate(filt.groups):
+        if grp.kind == "dfa":
+            verd = np.asarray(scan_python(grp.filt.tables, lines),
+                              dtype=bool)
+        else:
+            verd = np.asarray(grp.filt.match_lines(lines), dtype=bool)
+        out |= gm[:, g] & verd
+    return out
+
+
+def run_trials(trials: int, seed: int, quiet: bool = True) -> int:
+    """Run ``trials`` three-way differential trials; returns the
+    number checked. Raises AssertionError with a repro line on the
+    first divergence. The caller owns KLOGS_NATIVE_GROUPSCAN
+    restoration."""
+    from klogs_tpu import native
+
+    if native.hostops is None or not hasattr(native.hostops,
+                                             "group_scan"):
+        raise RuntimeError("native extension unavailable")
+    from klogs_tpu.filters.indexed import IndexedFilter
+    from klogs_tpu.utils.env import read as env_read
+
+    rng = random.Random(seed)
+    saved = env_read("KLOGS_NATIVE_GROUPSCAN")
+    checked = 0
+    try:
+        for trial in range(trials):
+            pats = rand_patterns(rng)
+            try:
+                filt = IndexedFilter(
+                    pats, cache=False, sweep="host",
+                    max_group_patterns=rng.choice((2, 3, 32)))
+            except Exception:
+                continue  # outside the analyzable subset
+            if not filt._dfa_cols:
+                continue  # nothing for the batched kernel to do
+            lines = rand_lines(rng, pats)
+            payload, offsets, _ = frame_lines(lines)
+            offsets = np.asarray(offsets, dtype=np.int32)
+            B = len(lines)
+            G = len(filt.groups)
+            # The engine's real candidate matrix, then a random one:
+            # the kernel must honor ANY gating the caller hands it.
+            mats = [filt.index.group_candidates(payload, offsets,
+                                                impl="numpy")]
+            rand_gm = np.frombuffer(
+                bytes(rng.getrandbits(1) for _ in range(B * G)),
+                dtype=np.uint8).reshape(B, G).astype(bool)
+            if G and rng.random() < 0.5:
+                rand_gm = rand_gm.copy()
+                rand_gm[:, rng.randrange(G)] = True  # always-column
+            mats.append(rand_gm)
+            for which, gm in enumerate(mats):
+                expect = oracle_mask(filt, lines, gm)
+                got = {}
+                for mode in ("off", "native"):
+                    os.environ["KLOGS_NATIVE_GROUPSCAN"] = mode
+                    got[mode] = filt._scan_candidates(
+                        payload, offsets, np.ascontiguousarray(gm))
+                for mode, mask in got.items():
+                    assert np.array_equal(expect, mask), (
+                        f"DIVERGENCE: seed={seed} trial={trial} "
+                        f"matrix={'sweep' if which == 0 else 'random'} "
+                        f"mode={mode} patterns={pats!r} "
+                        f"lines={lines!r}\n"
+                        f"oracle:{expect.astype(int)}\n"
+                        f"{mode}:  {mask.astype(int)}")
+                checked += 1
+            if not quiet and trial and trial % 200 == 0:
+                print(f"  {trial} trials, {checked} checked",
+                      flush=True)
+    finally:
+        if saved is None:
+            os.environ.pop("KLOGS_NATIVE_GROUPSCAN", None)
+        else:
+            os.environ["KLOGS_NATIVE_GROUPSCAN"] = saved
+    return checked
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=1000)
+    ap.add_argument("--seed", type=int, default=None)
+    args = ap.parse_args()
+    seed = args.seed if args.seed is not None else int(time.time())
+    print(f"fuzz-groupscan: seed={seed} trials={args.trials}",
+          flush=True)
+    t0 = time.time()
+    try:
+        checked = run_trials(args.trials, seed, quiet=False)
+    except RuntimeError as e:
+        print(f"SKIP: {e}")
+        return 2
+    except AssertionError as e:
+        print(str(e), flush=True)
+        return 1
+    print(f"fuzz-groupscan OK: {checked} three-way comparisons across "
+          f"{args.trials} trials, {time.time() - t0:.0f}s, seed={seed}",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
