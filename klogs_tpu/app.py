@@ -300,10 +300,16 @@ def _write_stats_json(path: str, registry, pipeline) -> None:
     import json
 
     from klogs_tpu.obs import snapshot
+    from klogs_tpu.obs.profiler import refresh_process_metrics
 
+    # Final process-gauge refresh so the dump carries exit-time
+    # uptime/RSS, like a last scrape would.
+    refresh_process_metrics(registry)
     doc: dict = {"metrics": snapshot(registry)}
     if pipeline is not None:
         s = pipeline.stats
+        # p90 added next to the existing keys (additive only — the
+        # key layout is a golden consumers parse).
         doc["summary"] = {
             "lines_in": s.lines_in,
             "lines_matched": s.lines_matched,
@@ -311,13 +317,16 @@ def _write_stats_json(path: str, registry, pipeline) -> None:
             "lines_per_sec": s.lines_per_sec(),
             "batches": s.batches,
             "batch_latency_p50_s": s.percentile_latency_s(50),
+            "batch_latency_p90_s": s.percentile_latency_s(90),
             "batch_latency_p99_s": s.percentile_latency_s(99),
         }
         if s.has_service_latencies:
             doc["summary"].update({
                 "queue_p50_s": s.percentile_queue_s(50),
+                "queue_p90_s": s.percentile_queue_s(90),
                 "queue_p99_s": s.percentile_queue_s(99),
                 "device_p50_s": s.percentile_device_s(50),
+                "device_p90_s": s.percentile_device_s(90),
                 "device_p99_s": s.percentile_device_s(99),
             })
     try:
@@ -460,6 +469,20 @@ async def _run_async_inner(
         if obs_registry is not None:
             _trace.TRACER.bind_registry(obs_registry)
             _trace.RECORDER.bind_registry(obs_registry)
+        # Continuous utilization profiling (opt-in): --profile-json
+        # appends one snapshot per tick; KLOGS_PROFILE_SAMPLE alone
+        # also enables it (feeding /profile on --metrics-port without
+        # a file sink). KLOGS_PROFILE_SAMPLE=0 is the kill switch even
+        # against the explicit flag.
+        from klogs_tpu.obs.profiler import PROFILER
+
+        PROFILER.maybe_enable()
+        if opts.profile_json is not None and PROFILER.enable():
+            PROFILER.set_json_path(opts.profile_json)
+        if PROFILER.enabled and obs_registry is not None:
+            PROFILER.bind_registry(obs_registry)
+        prof_stop: asyncio.Event | None = None
+        prof_task: asyncio.Task | None = None
         # Resilience observability rides the same per-run registry:
         # fault firings, kube retry attempts (the backend exists before
         # the registry, hence the late bind), breaker state (bound in
@@ -472,6 +495,13 @@ async def _run_async_inner(
         pipeline = make_pipeline_for(opts, registry=obs_registry)
         inner_factory = make_inner_sink_factory(opts)
         try:
+            if PROFILER.enabled:
+                # Started inside this try so the finally below always
+                # reaps the ticker (a fatal during pipeline start must
+                # not leak the task into loop teardown).
+                prof_stop = asyncio.Event()
+                prof_task = asyncio.create_task(
+                    PROFILER.run_ticker(prof_stop))
             if pipeline is not None:
                 await pipeline.start()  # remote: verify patterns up front
                 pipeline.inner_factory = inner_factory
@@ -631,6 +661,15 @@ async def _run_async_inner(
             if pipeline is not None and opts.stats:
                 pipeline.print_summary()
             if opts.stats_json is not None:
+                if pipeline is not None:
+                    # Sharded remote tier: pull each endpoint's final
+                    # capacity advertisement so the dump carries the
+                    # fleet's offered/admitted totals (a short batch
+                    # run ends before the prober's refresh cadence).
+                    refresh = getattr(pipeline.service,
+                                      "refresh_capacity", None)
+                    if refresh is not None:
+                        await refresh()
                 _write_stats_json(opts.stats_json, obs_registry, pipeline)
             # Interrupted-but-graceful: everything is flushed and
             # reported, yet scripts still see the conventional 130.
@@ -639,6 +678,16 @@ async def _run_async_inner(
             # Close inside the loop even on error/Ctrl-C paths — an
             # unawaited grpc channel or in-flight batch task would be
             # destroyed pending at loop teardown.
+            if prof_task is not None:
+                # run_ticker's final tick completes the JSONL stream
+                # before the task returns.
+                if prof_stop is not None:
+                    prof_stop.set()
+                try:
+                    await prof_task
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
+                PROFILER.set_json_path(None)
             if metrics_srv is not None:
                 await metrics_srv.stop()
             if pipeline is not None:

@@ -56,6 +56,10 @@ New (north-star) flags, absent from the reference:
   --stats-json      one-shot JSON metrics dump at exit (non-server runs)
   --trace-json      per-batch trace spans as JSON lines (tracing +
                     flight recorder; see docs/OBSERVABILITY.md)
+  --profile-json    continuous pipeline utilization profiler: one JSON
+                    snapshot per tick (per-stage busy-seconds and
+                    utilization, queue/in-flight samples); same doc as
+                    /profile on --metrics-port
   --cluster         cluster backend: kube (real) | fake (hermetic demo)
 """
 
@@ -92,6 +96,7 @@ class Options:
     metrics_port: int | None = None
     stats_json: str | None = None
     trace_json: str | None = None
+    profile_json: str | None = None
     profile: str | None = None
     cluster: str = "kube"
     watch_new: bool = False
@@ -257,6 +262,19 @@ def build_parser() -> argparse.ArgumentParser:
         "degrade flight recorder — see docs/OBSERVABILITY.md",
     )
     p.add_argument(
+        "--profile-json",
+        default=None,
+        dest="profile_json",
+        metavar="PATH",
+        help="Continuous pipeline utilization profiling: append one "
+        "JSON snapshot per tick (per-stage busy-seconds and rolling "
+        "utilization folded from trace spans, plus queue-depth/"
+        "in-flight/executor samples) to PATH. The same snapshot "
+        "serves /profile on the --metrics-port sidecar; "
+        "KLOGS_PROFILE_SAMPLE pins the span-sampling rate (0 "
+        "disables). See docs/OBSERVABILITY.md",
+    )
+    p.add_argument(
         "-o",
         "--output",
         choices=["files", "stdout", "both"],
@@ -366,6 +384,7 @@ def parse_args(argv: list[str] | None = None) -> Options:
         metrics_port=ns.metrics_port,
         stats_json=ns.stats_json,
         trace_json=ns.trace_json,
+        profile_json=ns.profile_json,
         profile=ns.profile,
         cluster=ns.cluster,
         watch_new=ns.watch_new,

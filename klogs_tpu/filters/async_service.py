@@ -103,6 +103,27 @@ class AsyncFilterService:
         )
         self._coalesce_lines = coalesce_lines
         self._coalesce_delay_s = coalesce_delay_s
+        # Utilization-profiler probes (obs/profiler.py): the live
+        # queue-depth / in-flight / executor-saturation samples the
+        # /profile snapshot carries. Registered only on instrumented
+        # pipelines (stats present), dropped at close; name collisions
+        # (multi-set registries build one service per set over the
+        # SHARED pool) resolve last-writer-wins, which is the shared
+        # budget's one true value anyway.
+        self._probes: "dict[str, object]" = {}
+        if stats is not None:
+            from klogs_tpu.obs.profiler import PROFILER
+
+            self._probes = {
+                "coalescer.queue_depth":
+                    lambda: float(len(self._pending)),
+                "coalescer.pending_lines":
+                    lambda: float(self._pending_lines),
+                "device.in_flight_used": self._in_flight_used,
+                "device.fetch_queue": self._fetch_queue_depth,
+            }
+            for name, fn in self._probes.items():
+                PROFILER.add_probe(name, fn)
         # (payload, offsets, n_lines, future, enqueue_time) per caller.
         self._pending: list[tuple] = []
         self._pending_lines = 0
@@ -113,6 +134,29 @@ class AsyncFilterService:
         # caller future in its group.
         self._tasks: set[asyncio.Task] = set()
         self.batches_dispatched = 0  # for tests / stats
+
+    def _in_flight_used(self) -> float:
+        """Occupied in-flight dispatch slots (0 before first dispatch
+        creates the semaphore)."""
+        sem = self._sem
+        if sem is None:
+            return 0.0
+        return float(max(0, self._max_in_flight - sem._value))
+
+    def _fetch_queue_depth(self) -> float:
+        """Fetches waiting for a free executor worker — the executor-
+        saturation sample (>0 means every fetch worker is mid-round-
+        trip and dispatches queue behind them)."""
+        q = getattr(self._pool, "_work_queue", None)
+        return float(q.qsize()) if q is not None else 0.0
+
+    def _drop_probes(self) -> None:
+        if self._probes:
+            from klogs_tpu.obs.profiler import PROFILER
+
+            for name, fn in self._probes.items():
+                PROFILER.remove_probe(name, fn)  # type: ignore[arg-type]
+            self._probes = {}
 
     async def match(self, lines: list[bytes]) -> list[bool]:
         """Resolves with one verdict per line. Concurrent calls coalesce
@@ -294,6 +338,7 @@ class AsyncFilterService:
         then drain in-flight batch tasks, so no caller future is
         stranded and no task dies with the loop."""
         self._closed = True
+        self._drop_probes()
         if self._pending:
             self._kick(asyncio.get_running_loop())
         elif self._kick_handle is not None:
@@ -312,6 +357,7 @@ class AsyncFilterService:
 
     def close(self) -> None:
         self._closed = True
+        self._drop_probes()
         if self._kick_handle is not None:
             self._kick_handle.cancel()
             self._kick_handle = None

@@ -98,10 +98,16 @@ def snapshot(registry: "Registry") -> dict:
             labels = dict(zip(fam.labelnames, labelvalues))
             if fam.type == "histogram":
                 counts, total, n = child.snapshot()
+                # In-process reservoir percentiles ride every
+                # histogram sample (the --stats-json exit dump's exact
+                # quantiles, next to the bucketed approximation a
+                # remote scrape would have to settle for). Additive
+                # keys only — goldens over the existing layout hold.
                 sample = {"buckets": dict(zip(
                     (_fmt(float(b)) for b in child.buckets), counts)),
                     "sum": total, "count": n,
                     "p50": child.percentile(50),
+                    "p90": child.percentile(90),
                     "p99": child.percentile(99)}
             else:
                 sample = {"value": child.value}

@@ -96,14 +96,16 @@ class MetricsHTTPServer:
 
     def __init__(self, registry: "Registry", health: "Health | None" = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 tracer=None) -> None:
+                 tracer=None, profiler=None) -> None:
         self.registry = registry
         self.health = health
         self.host = host
         self.port = port
         # /traces serves this tracer's finished spans; None = the
-        # process-global one (a process runs one trace story).
+        # process-global one (a process runs one trace story). Same
+        # rule for /profile and the profiler.
         self.tracer = tracer
+        self.profiler = profiler
         self._server: asyncio.AbstractServer | None = None
 
     async def start(self) -> int:
@@ -135,6 +137,14 @@ class MetricsHTTPServer:
                                            _REQ_TIMEOUT_S)
                 if h in (b"\r\n", b"\n", b""):
                     break
+            if method == "GET" and path == "/metrics":
+                # Process-level gauges (uptime, RSS) refresh per
+                # scrape; the /proc reads are file I/O, so off the
+                # loop like every other blocking read here.
+                from klogs_tpu.obs.profiler import refresh_process_metrics
+
+                await asyncio.to_thread(refresh_process_metrics,
+                                        self.registry)
             status, ctype, body = self._route(method, path, query)
             head = (f"HTTP/1.1 {status}\r\n"
                     f"Content-Type: {ctype}\r\n"
@@ -177,6 +187,13 @@ class MetricsHTTPServer:
             tracer = self.tracer if self.tracer is not None else _trace.TRACER
             body = (json.dumps(tracer.traces_doc()) + "\n").encode()
             return ("200 OK", "application/json", body)
+        if path == "/profile":
+            from klogs_tpu.obs import profiler as _profiler
+
+            prof = (self.profiler if self.profiler is not None
+                    else _profiler.PROFILER)
+            body = (json.dumps(prof.profile_doc()) + "\n").encode()
+            return ("200 OK", "application/json", body)
         if path in ("/healthz", "/readyz"):
             if self.health is None:
                 return ("200 OK", "application/json",
@@ -187,4 +204,5 @@ class MetricsHTTPServer:
             return ("200 OK" if ok else "503 Service Unavailable",
                     "application/json", body)
         return ("404 Not Found", "text/plain; charset=utf-8",
-                b"try /metrics, /healthz, /readyz, or /traces\n")
+                b"try /metrics, /healthz, /readyz, /traces, or "
+                b"/profile\n")

@@ -59,6 +59,65 @@ SPECS: dict[str, dict] = {
     "klogs_build_info": _m(
         "gauge", "Constant 1, labeled with the build version.",
         labels=("version",), bounds={"version": "config"}),
+    "klogs_process_uptime_seconds": _m(
+        "gauge", "Seconds since this process started (refreshed per "
+        "/metrics scrape, --stats-json dump, and profiler tick — no "
+        "node exporter needed for headroom math)."),
+    "klogs_process_rss_bytes": _m(
+        "gauge", "Current resident set size of this process in bytes "
+        "(refreshed like klogs_process_uptime_seconds)."),
+
+    # -- pipeline profiler (obs/profiler.py) --------------------------
+    # The `stage` label is the fixed span-name catalog
+    # (obs.profiler.STAGES) — a code-chosen enum.
+    "klogs_profile_stage_busy_seconds_total": _m(
+        "counter", "Cumulative busy-seconds folded from finished "
+        "spans per pipeline stage (the profiler's utilization "
+        "numerator; synced once per tick).", labels=("stage",),
+        bounds={"stage": "enum"}),
+    "klogs_profile_stage_spans_total": _m(
+        "counter", "Finished spans folded per pipeline stage by the "
+        "profiler.", labels=("stage",), bounds={"stage": "enum"}),
+    "klogs_profile_stage_utilization": _m(
+        "gauge", "Rolling per-stage utilization over the last profiler "
+        "tick window: busy-seconds per wall-second, unbiased by the "
+        "trace sampling rate. May exceed 1.0 for stages that run "
+        "concurrently (N in-flight RPCs).", labels=("stage",),
+        bounds={"stage": "enum"}),
+
+    # -- fleet capacity (the autoscaling signal) ----------------------
+    # Server-side (filterd): unlabeled totals + the advertised
+    # headroom. Collector-side: the sharded client re-exports what each
+    # endpoint's Hello advertised, labeled by endpoint (the --remote
+    # fleet — deployment shape).
+    "klogs_fleet_offered_lines_total": _m(
+        "counter", "Lines that entered a match RPC on this filterd "
+        "(before tenancy admission) — the demand signal."),
+    "klogs_fleet_admitted_lines_total": _m(
+        "counter", "Lines that produced verdicts on this filterd "
+        "(past quota shed and the fair gate). offered - admitted is "
+        "the shed pressure an autoscaler should add capacity for."),
+    "klogs_fleet_headroom": _m(
+        "gauge", "This filterd's advertised headroom estimate in "
+        "[0, 1], by signal trust: 1 - admitted rate / envelope when "
+        "KLOGS_FLEET_CAPACITY_LPS calibrates one, else 1 - peak stage "
+        "utilization from the live profiler, else the committed "
+        "operating-point ceiling. Advertised through Hello; see "
+        "docs/OBSERVABILITY.md Fleet telemetry."),
+    "klogs_fleet_endpoint_headroom": _m(
+        "gauge", "Headroom last advertised by each filterd endpoint's "
+        "Hello, re-exported by the sharded client for an HPA to "
+        "consume.", labels=("endpoint",), bounds={"endpoint": "config"}),
+    "klogs_fleet_endpoint_offered_lines_total": _m(
+        "counter", "Offered-lines total last advertised by each "
+        "endpoint's Hello, re-exported collector-side (advanced by "
+        "observed deltas; a restarted server restarts its series).",
+        labels=("endpoint",), bounds={"endpoint": "config"}),
+    "klogs_fleet_endpoint_admitted_lines_total": _m(
+        "counter", "Admitted-lines total last advertised by each "
+        "endpoint's Hello, re-exported collector-side like the "
+        "offered twin.", labels=("endpoint",),
+        bounds={"endpoint": "config"}),
 
     # -- sink layer (FilteredSink / FilterStats view) -----------------
     "klogs_sink_lines_total": _m(

@@ -324,6 +324,24 @@ class Tracer:
         explicit KLOGS_TRACE_SAMPLE still wins."""
         self._sample = sample
 
+    def sample_rate(self) -> float:
+        """The effective head-sampling rate (env-resolved) — what the
+        profiler divides observed busy-seconds by to unbias stage
+        utilization."""
+        return self._rate()
+
+    def ensure_sample(self, rate: float) -> None:
+        """Raise the sampling rate to at least ``rate`` — the
+        profiler's enablement path (profiling needs spans to fold) —
+        UNLESS KLOGS_TRACE_SAMPLE explicitly pins one: an operator's
+        explicit rate, including 0, always wins."""
+        from klogs_tpu.utils.env import is_set
+
+        if is_set("KLOGS_TRACE_SAMPLE"):
+            return
+        if rate > self._rate():
+            self._sample = rate
+
     def enable_default(self) -> None:
         """Turn sampling fully on UNLESS KLOGS_TRACE_SAMPLE is set —
         the --trace-json ergonomics: asking for a trace file means you

@@ -490,6 +490,15 @@ class FanoutRunner:
         # truncating hundreds of files is disk I/O, and an in-process
         # metrics sidecar may already be serving on this loop.
         await asyncio.to_thread(self._create_all_files, jobs)
+        # Utilization-profiler probe: live open-stream count in the
+        # /profile snapshot (read only at tick time; dropped with the
+        # run so a finished runner cannot be sampled).
+        from klogs_tpu.obs.profiler import PROFILER
+
+        def _streams_probe() -> float:
+            return float(len(self._streams))
+
+        PROFILER.add_probe("fanout.active_streams", _streams_probe)
         tasks: list[asyncio.Task] = [
             asyncio.create_task(self._worker(j)) for j in jobs]
 
@@ -537,6 +546,7 @@ class FanoutRunner:
                         "pod discovery stopped unexpectedly: %s", e)
             if stop_task is not None:
                 stop_task.cancel()
+            PROFILER.remove_probe("fanout.active_streams", _streams_probe)
         try:
             return await asyncio.gather(*tasks)
         except Exception:

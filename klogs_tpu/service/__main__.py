@@ -57,6 +57,16 @@ def main() -> None:
                     "a collector's trace when its RPC carries the "
                     "traceparent metadata). Implies KLOGS_TRACE_SAMPLE=1 "
                     "unless that variable is set")
+    ap.add_argument("--profile-json", default=None, dest="profile_json",
+                    metavar="PATH",
+                    help="append one JSON line per profiler tick to "
+                    "PATH: per-stage busy-seconds/utilization, queue/"
+                    "in-flight samples, and the offered/admitted/"
+                    "headroom capacity block. Enables the continuous "
+                    "pipeline profiler (KLOGS_PROFILE_SAMPLE pins the "
+                    "span-sampling rate; 0 disables). The same "
+                    "snapshot serves /profile on --metrics-port "
+                    "(docs/OBSERVABILITY.md)")
     ap.add_argument("--metrics-host", default="127.0.0.1",
                     metavar="HOST",
                     help="metrics/health bind address. Cross-node "
@@ -85,7 +95,8 @@ def main() -> None:
                           exclude=ns.exclude,
                           metrics_port=ns.metrics_port,
                           metrics_host=ns.metrics_host,
-                          trace_json=ns.trace_json))
+                          trace_json=ns.trace_json,
+                          profile_json=ns.profile_json))
     except KeyboardInterrupt:
         pass
     except RegexSyntaxError as e:  # subclasses ValueError: catch first

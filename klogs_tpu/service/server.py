@@ -33,6 +33,7 @@ import grpc
 
 from klogs_tpu.filters.async_service import AsyncFilterService
 from klogs_tpu.obs import trace
+from klogs_tpu.obs.profiler import PROFILER, FleetCapacity
 from klogs_tpu.service import transport
 from klogs_tpu.version import BUILD_VERSION
 
@@ -182,6 +183,7 @@ class FilterServer:
 
             _trace.TRACER.bind_registry(self.registry)
             _trace.RECORDER.bind_registry(self.registry)
+            PROFILER.bind_registry(self.registry)
             self._stats = FilterStats(registry=self.registry)
             self._m_rpc = {
                 "req": self.registry.family("klogs_rpc_requests_total"),
@@ -196,6 +198,14 @@ class FilterServer:
             self.health.add_live_check(
                 "coalescer", lambda: self._service is None
                 or not self._service._closed)
+        # Fleet capacity accounting (offered vs admitted lines +
+        # headroom), advertised through Hello whether or not the
+        # metrics sidecar runs — the sharded client re-exports it
+        # per endpoint for the HPA scrape. The profiler carries it on
+        # /profile too (a later server instance rebinds, like the
+        # tracer's registry binding above).
+        self.capacity = FleetCapacity(registry=self.registry)
+        PROFILER.attach_capacity(self.capacity)
         # Multi-tenant registry (docs/TENANCY.md): content-addressed
         # pattern sets behind weighted-fair admission; the startup set
         # (when present) is adopted as a pinned default lane so legacy
@@ -383,11 +393,24 @@ class FilterServer:
             print(f"klogs filterd: warmup batch failed ({e}); "
                   "/readyz stays unready", flush=True)
 
+    def _capacity_keys(self) -> dict:
+        """The fleet-capacity advertisement every Hello carries, next
+        to metrics_port/device_sweep: the sharded client re-exports
+        these per endpoint (klogs_fleet_endpoint_*) and may weigh
+        routing by headroom later. Old clients ignore the keys."""
+        cap = self.capacity.doc()
+        return {
+            "headroom": cap["headroom"],
+            "fleet_offered_lines": cap["offered_lines"],
+            "fleet_admitted_lines": cap["admitted_lines"],
+        }
+
     async def _hello(self, request: bytes, context) -> bytes:
         await self._check_auth(context)
         if self.tenants is not None:
             return await self._hello_multi(request)
         return transport.pack({
+            **self._capacity_keys(),
             "patterns": self.patterns,
             "exclude": self.exclude,
             "ignore_case": self.ignore_case,
@@ -440,6 +463,7 @@ class FilterServer:
         if sp is not None and set_id is not None:
             sp.set_attr("tenant", set_id)
         return transport.pack({
+            **self._capacity_keys(),
             "patterns": patterns,
             "exclude": exclude,
             "ignore_case": ignore_case,
@@ -530,12 +554,17 @@ class FilterServer:
             # traceback.
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                                 f"bad match request: {e}")
+        # Capacity accounting: offered BEFORE admission, admitted only
+        # when verdicts came back — an admission shed (OverQuota abort)
+        # leaves the gap the autoscaling signal measures.
+        self.capacity.note_offered(len(lines))
         if self.tenants is not None:
             mask = await self._tenant_match(
                 set_id, context,
                 lambda lane: self.tenants.match(lane, lines))
         else:
             mask = await self._service.match(lines)
+        self.capacity.note_admitted(len(lines))
         return transport.encode_match_response(mask)
 
     async def _match_framed(self, request: bytes, context) -> bytes:
@@ -553,6 +582,10 @@ class FilterServer:
             # coalescer shared with other collectors.
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                                 f"bad framed request: {e}")
+        # Same offered/admitted discipline as _match (framed hot path:
+        # two integer adds per BATCH, nothing per line).
+        n_lines = max(len(offsets) - 1, 0)
+        self.capacity.note_offered(n_lines)
         if self.tenants is not None:
             mask = await self._tenant_match(
                 set_id, context,
@@ -560,6 +593,7 @@ class FilterServer:
                     lane, payload, offsets))
         else:
             mask = await self._service.match_framed(payload, offsets)
+        self.capacity.note_admitted(n_lines)
         return transport.encode_framed_response(mask)
 
     async def start(self) -> int:
@@ -700,6 +734,7 @@ def banner_line(server: "FilterServer", where: str, mode: str) -> str:
 async def serve(patterns: list[str], backend: str, host: str, port: int,
                 ignore_case: bool = False,
                 trace_json: "str | None" = None,
+                profile_json: "str | None" = None,
                 multi_set: bool = False, **security) -> None:
     if trace_json is not None:
         # Server-side batch tracing: spans root at rpc.server (or
@@ -710,10 +745,23 @@ async def serve(patterns: list[str], backend: str, host: str, port: int,
 
         _trace.TRACER.enable_default()
         _trace.TRACER.set_json_path(trace_json)
+    # Continuous utilization profiling: --profile-json turns it fully
+    # on (unless KLOGS_PROFILE_SAMPLE pins a rate — including 0, the
+    # kill switch); the env knob alone also enables it, feeding
+    # /profile on the metrics sidecar without a file sink.
+    PROFILER.maybe_enable()
+    if profile_json is not None and PROFILER.enable():
+        PROFILER.set_json_path(profile_json)
     server = FilterServer(patterns, backend, host=host, port=port,
                           ignore_case=ignore_case, multi_set=multi_set,
                           **security)
     bound = await server.start()
+    prof_stop: "asyncio.Event | None" = None
+    prof_task: "asyncio.Task | None" = None
+    if PROFILER.enabled:
+        prof_stop = asyncio.Event()
+        prof_task = asyncio.get_running_loop().create_task(
+            PROFILER.run_ticker(prof_stop))
     mode = "TLS" if server.tls_cert else "plaintext"
     if server.tls_client_ca:
         mode = "mTLS"
@@ -734,6 +782,16 @@ async def serve(patterns: list[str], backend: str, host: str, port: int,
         await server.wait()
     finally:
         await server.stop()
+        if prof_task is not None:
+            # Final tick lands inside run_ticker before it returns, so
+            # the JSONL stream ends with the complete picture.
+            if prof_stop is not None:
+                prof_stop.set()
+            try:
+                await prof_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            PROFILER.set_json_path(None)
         # A degrade trigger armed near shutdown may have no further
         # local root span to ride — write it before the process exits
         # (mirrors the collector-side teardown in app.py).

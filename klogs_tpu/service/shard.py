@@ -39,6 +39,7 @@ Mechanisms, in the order a batch meets them:
 import asyncio
 import bisect
 import hashlib
+import time
 from typing import Any, Awaitable, Callable, Iterable, Sequence
 
 from klogs_tpu.obs import trace
@@ -66,6 +67,11 @@ SHARD_MODES = ("round-robin", "hash")
 DEFAULT_HEDGE_S = 1.0
 DEFAULT_PROBE_INTERVAL_S = 1.0
 DEFAULT_PROBE_TIMEOUT_S = 1.0
+# How often the prober refreshes each endpoint's capacity
+# advertisement (headroom + offered/admitted totals from Hello) for
+# the collector-side klogs_fleet_endpoint_* re-export
+# (KLOGS_FLEET_REFRESH_S overrides).
+DEFAULT_CAPACITY_REFRESH_S = 5.0
 
 # Virtual nodes per endpoint on the consistent-hash ring: enough that
 # removing one of a handful of endpoints re-homes its keys roughly
@@ -132,7 +138,7 @@ class _Endpoint:
     client)."""
 
     __slots__ = ("target", "client", "ready", "readyz", "verified",
-                 "quarantined")
+                 "quarantined", "cap_offered", "cap_admitted", "cap_next")
 
     def __init__(self, target: str, client: Any) -> None:
         self.target = target
@@ -141,6 +147,12 @@ class _Endpoint:
         # must still route everywhere (breakers alone protect it).
         self.ready = True
         self.readyz: "tuple[str, int] | None" = None
+        # Last capacity totals this endpoint's Hello advertised (the
+        # collector-side counter re-export advances by deltas) and
+        # when the prober should refresh them next.
+        self.cap_offered: "int | None" = None
+        self.cap_admitted: "int | None" = None
+        self.cap_next = 0.0
         # verified False = the endpoint was unreachable during the
         # startup handshake: it must not receive traffic until a later
         # Hello proves its pattern set matches (the prober re-tries).
@@ -220,11 +232,25 @@ class ShardedFilterClient:
         self._m_reroutes: Any = None
         self._m_batches: Any = None
         self._m_ready: Any = None
+        self._m_cap_head: Any = None
+        self._m_cap_off: Any = None
+        self._m_cap_adm: Any = None
+        from klogs_tpu.utils.env import positive_float
+
+        self._cap_refresh_s = positive_float(
+            "KLOGS_FLEET_REFRESH_S", DEFAULT_CAPACITY_REFRESH_S,
+            exc=ServiceConfigError)
         if registry is not None:
             self._m_hedges = registry.family("klogs_shard_hedges_total")
             self._m_reroutes = registry.family("klogs_shard_reroutes_total")
             self._m_batches = registry.family("klogs_shard_batches_total")
             self._m_ready = registry.family("klogs_shard_endpoint_ready")
+            self._m_cap_head = registry.family(
+                "klogs_fleet_endpoint_headroom")
+            self._m_cap_off = registry.family(
+                "klogs_fleet_endpoint_offered_lines_total")
+            self._m_cap_adm = registry.family(
+                "klogs_fleet_endpoint_admitted_lines_total")
             for ep in self._endpoints:
                 self._m_ready.labels(endpoint=ep.target).set(1)
 
@@ -481,6 +507,7 @@ class ShardedFilterClient:
                 # must be registered there before the first batch.
                 to_register.append(ep)
             self._learn_readyz(ep, info)
+            self._note_capacity(ep, info)
         if not reachable:
             raise Unavailable(
                 "no filterd endpoint reachable at startup: "
@@ -511,6 +538,21 @@ class ShardedFilterClient:
                     raise res
         self._ensure_prober()
 
+    async def refresh_capacity(self) -> None:
+        """One fleet-wide capacity sweep (concurrent, bounded per
+        endpoint): refresh every routable endpoint's klogs_fleet_
+        endpoint_* series from a Hello NOW. The prober does this on
+        its own cadence for long-lived runs; a short batch run calls
+        it before its --stats-json exit dump so the fleet's
+        offered/admitted totals still land — the last scrape."""
+        if self._m_cap_head is None or self._expected is None:
+            return
+        await asyncio.gather(
+            *[self._refresh_capacity(ep) for ep in self._endpoints
+              if ep.verified and not ep.quarantined
+              and ep.breaker.state != BREAKER_OPEN],
+            return_exceptions=True)
+
     async def aclose(self) -> None:
         if self._probe_stop is not None:
             self._probe_stop.set()
@@ -536,6 +578,57 @@ class ShardedFilterClient:
             self._probe_task = None
         for ep in self._endpoints:
             ep.client.close()
+
+    # -- fleet capacity re-export -------------------------------------
+
+    def _note_capacity(self, ep: _Endpoint, info: dict) -> None:
+        """Fold one Hello's capacity advertisement into the per-
+        endpoint klogs_fleet_endpoint_* families: headroom is a gauge
+        (last advertised value), offered/admitted are counters
+        advanced by the observed delta — a restarted server (total
+        dropped) restarts its contribution from the new total rather
+        than poisoning the series with a negative increment."""
+        ep.cap_next = time.monotonic() + self._cap_refresh_s
+        if self._m_cap_head is None:
+            return
+        head = info.get("headroom")
+        if isinstance(head, (int, float)) and not isinstance(head, bool):
+            self._m_cap_head.labels(endpoint=ep.target).set(float(head))
+        for key, attr, fam in (
+                ("fleet_offered_lines", "cap_offered", self._m_cap_off),
+                ("fleet_admitted_lines", "cap_admitted", self._m_cap_adm)):
+            total = info.get(key)
+            if not isinstance(total, int) or isinstance(total, bool):
+                continue
+            last: "int | None" = getattr(ep, attr)
+            if last is not None and last // 2 < total < last:
+                # STALE, not a restart: two concurrent Hellos (prober
+                # cadence racing the exit-dump sweep) can land out of
+                # order, and re-counting a lifetime total as a fresh
+                # delta would spike the HPA's shed-pressure rate by
+                # the endpoint's whole history in one scrape. A real
+                # restart collapses the total towards zero; a slightly
+                # smaller total is the older in-flight answer — keep
+                # the newer state.
+                continue
+            delta = total - last if (last is not None
+                                     and total >= last) else total
+            if delta > 0:
+                fam.labels(endpoint=ep.target).inc(delta)
+            setattr(ep, attr, total)
+
+    async def _refresh_capacity(self, ep: _Endpoint) -> None:
+        """Prober-cadence capacity refresh: one bounded Hello against a
+        verified, breaker-closed endpoint. Still-down endpoints simply
+        wait for the next cycle (their gauges keep the last advertised
+        value; routing state is the prober's other jobs' concern)."""
+        try:
+            info = await asyncio.wait_for(ep.client.hello(),
+                                          timeout=self._probe_timeout_s)
+        except (Unavailable, asyncio.TimeoutError):
+            ep.cap_next = time.monotonic() + self._cap_refresh_s
+            return
+        self._note_capacity(ep, info)
 
     # -- readiness drain ----------------------------------------------
 
@@ -569,7 +662,8 @@ class ShardedFilterClient:
     def _ensure_prober(self) -> None:
         if (self._probe_task is None
                 and (any(ep.readyz for ep in self._endpoints)
-                     or any(not ep.verified for ep in self._endpoints))):
+                     or any(not ep.verified for ep in self._endpoints)
+                     or self._m_cap_head is not None)):
             if self._probe_stop is None:
                 self._probe_stop = asyncio.Event()
             self._probe_task = asyncio.get_running_loop().create_task(
@@ -605,6 +699,15 @@ class ShardedFilterClient:
                         await self._late_verify(ep)
                     elif ep.readyz is not None:
                         self._set_ready(ep, await self._probe_ready(ep))
+                    if (self._m_cap_head is not None
+                            and self._expected is not None
+                            and ep.verified
+                            and ep.breaker.state != BREAKER_OPEN
+                            and time.monotonic() >= ep.cap_next):
+                        # Capacity re-export cadence: refresh this
+                        # endpoint's headroom/offered/admitted gauges
+                        # from a bounded Hello (KLOGS_FLEET_REFRESH_S).
+                        await self._refresh_capacity(ep)
                 except asyncio.CancelledError:
                     raise
                 except Exception as e:  # noqa: BLE001
@@ -670,6 +773,7 @@ class ShardedFilterClient:
             self._m_ready.labels(endpoint=ep.target).set(1 if ep.ready
                                                          else 0)
         self._learn_readyz(ep, info)
+        self._note_capacity(ep, info)
         term.info("filterd %s verified; joining the rotation", ep.target)
 
     async def _probe_ready(self, ep: _Endpoint) -> bool:

@@ -132,6 +132,63 @@ def test_bench_k_axis_contract(tmp_path):
     assert rec["rows"][0]["sweep_impl"] in ("native", "numpy")
 
 
+def test_bench_fleet_contract(tmp_path):
+    """`tools/bench_fleet.py` writes the BENCH_FLEET payload: one row
+    per fleet size with per-stage utilization attribution + headroom,
+    plus the profiler-overhead block (the <2% budget measurement) —
+    smoke-sized here; the committed BENCH_FLEET.json is the real
+    1→8-endpoint curve with the K=1024 overhead row."""
+    out = tmp_path / "BENCH_FLEET.json"
+    env = dict(os.environ)
+    env.pop("KLOGS_PROFILE_SAMPLE", None)
+    env.pop("KLOGS_TRACE_SAMPLE", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "KLOGS_BENCH_FLEET_ENDPOINTS": "1,2",
+        "KLOGS_BENCH_FLEET_LINES": "24000",
+        "KLOGS_BENCH_FLEET_BATCH": "4096",
+        "KLOGS_BENCH_FLEET_CAP_LPS": "120000",
+        "KLOGS_BENCH_FLEET_K": "64",
+        "KLOGS_BENCH_FLEET_OVERHEAD_LINES": "6000",
+        "KLOGS_BENCH_REPEATS": "2",
+        "KLOGS_BENCH_FLEET_OUT": str(out),
+    })
+    res = subprocess.run(
+        [sys.executable, "tools/bench_fleet.py"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=300,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    assert rec["unit"] == "lines/sec"
+    assert rec["cpu_count"] >= 1
+    assert [r["endpoints"] for r in rec["rows"]] == [1, 2]
+    for row in rec["rows"]:
+        for key in ("lps", "n_lines", "batch_lines", "senders",
+                    "capacity_lps_per_endpoint", "stages", "bottleneck",
+                    "headroom"):
+            assert key in row, key
+        assert row["lps"] > 0
+        assert len(row["headroom"]) == row["endpoints"]
+        for h in row["headroom"]:
+            assert h is None or 0.0 <= h <= 1.0
+        # Per-stage utilization attribution: the simulated device's
+        # round trip must be visible as device.fetch busy time, and
+        # every attributed stage carries the full triple.
+        assert "device.fetch" in row["stages"]
+        for st in row["stages"].values():
+            assert st["busy_s"] >= 0 and st["spans"] > 0
+            assert st["utilization"] >= 0
+        assert row["bottleneck"] in row["stages"]
+    over = rec["overhead"]
+    for key in ("k", "n_lines", "profiler_off_lps", "profiler_on_lps",
+                "overhead_pct", "stages_folded"):
+        assert key in over, key
+    assert over["profiler_off_lps"] > 0 and over["profiler_on_lps"] > 0
+    # The folded stages prove the profiler actually rode the bench
+    # path (device.sweep/groupscan spans at K>=64).
+    assert "device.sweep" in over["stages_folded"]
+
+
 def test_graft_entry_contract():
     """__graft_entry__ is the second driver contract: entry() must give
     a jittable forward step + example args (compile-checked single-chip)

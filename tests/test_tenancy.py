@@ -391,10 +391,15 @@ def test_single_set_hello_stays_byte_identical():
         c = RemoteFilterClient(f"127.0.0.1:{port}")
         try:
             info = await c.hello()
+            # Registry keys (multi_set/sets/set/registered) must not
+            # leak into the single-set handshake; the capacity trio is
+            # advertised in BOTH modes by design (fleet telemetry).
             assert set(info) == {"patterns", "exclude", "ignore_case",
                                  "backend", "version", "framed",
                                  "metrics_port", "metrics_host",
-                                 "device_sweep"}
+                                 "device_sweep", "headroom",
+                                 "fleet_offered_lines",
+                                 "fleet_admitted_lines"}
         finally:
             await c.aclose()
             await server.stop()

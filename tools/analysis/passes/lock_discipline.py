@@ -45,6 +45,17 @@ SHARED_STATE: dict = {
         "Family": _decl("lock", "_lock", "_children"),
         "Registry": _decl("lock", "_lock", "_families"),
     },
+    "klogs_tpu/obs/profiler.py": {
+        # The span fold arrives from loop and executor threads; ticks
+        # run on a worker thread; probes register from the loop.
+        "PipelineProfiler": _decl("lock", "_lock", "_stages",
+                                  "_child_busy", "_util", "_probes",
+                                  "_last_tick", "_last_doc", "_synced"),
+        # Offered/admitted counted per RPC on the loop but read by
+        # Hello handlers and the profiler tick thread.
+        "FleetCapacity": _decl("lock", "_lock", "_offered", "_admitted",
+                               "_hist"),
+    },
     "klogs_tpu/filters/base.py": {
         # Written by the dispatch loop AND by sync fallback paths that
         # benches drive from plain threads.
