@@ -811,9 +811,9 @@ def test_cli_docs_real_tree_clean():
 # -- second-generation suite (core dataflow + fleet-era passes) --------
 
 def test_pass_count_floor():
-    """The suite advertises >= 14 registered rules (acceptance gate);
+    """The suite advertises >= 16 registered rules (acceptance gate);
     keep the floor explicit so a dropped registration fails loudly."""
-    assert len(all_passes()) >= 14
+    assert len(all_passes()) >= 16
 
 
 def test_reaching_defs_basic_and_branches():
@@ -1601,3 +1601,546 @@ def test_async_blocking_lambda_and_class_body_in_async(tmp_path):
         """})
     found = _active(root, "async-blocking")
     assert len(found) == 1 and "time.sleep" in found[0].message
+
+
+# -- third-generation suite (ABI conformance + interprocedural locks) --
+
+# A minimal packer/parser pair stating the SAME contracts as the real
+# tree (token names from the abi-conformance contract table), clean at
+# baseline; each mutation test perturbs exactly one contract fact and
+# asserts exactly one finding.
+_ABI_C = """\
+#include <stdint.h>
+
+#define SWEEP_MAGIC 0x4B535750
+#define SWEEP_VERSION 1
+
+enum { SH_MAGIC = 0, SH_VERSION, SH_F,
+       SH_NARROW = 3, SH_WIDE = 5, SH_TOTAL = 7, SH_WORDS = 8 };
+enum { ST_H = 0, ST_E };
+
+#define MDFA_MAGIC 0x4B4D4446
+#define MDFA_VERSION 1
+
+enum { MH_MAGIC = 0, MH_VERSION, MH_M, MH_TOTAL, MH_WORDS = 4 };
+enum { MD_NDFA = 0, MD_START, MD_TABLE_OFF, MD_WORDS = 3 };
+
+static int
+sweep_parse_tier(const int32_t *h)
+{
+    return h[ST_H] + h[ST_E];
+}
+
+static int
+sweep_parse_blob(const char *blob, int blen)
+{
+    const int32_t *h = (const int32_t *)blob;
+    if (h[SH_MAGIC] != SWEEP_MAGIC || h[SH_VERSION] != SWEEP_VERSION
+        || h[SH_TOTAL] != blen)
+        return 0;
+    if (h[SH_F] < 0)
+        return 0;
+    return sweep_parse_tier((const int32_t *)blob + SH_NARROW)
+         + sweep_parse_tier((const int32_t *)blob + SH_WIDE);
+}
+
+static int
+mdfa_parse_blob(const char *blob, int blen)
+{
+    const int32_t *h = (const int32_t *)blob;
+    int m;
+    if (h[MH_MAGIC] != MDFA_MAGIC || h[MH_VERSION] != MDFA_VERSION
+        || h[MH_TOTAL] != blen)
+        return 0;
+    for (m = 0; m < h[MH_M]; m++) {
+        const int32_t *d = h + MH_WORDS + m * MD_WORDS;
+        if (d[MD_NDFA] <= 0 || d[MD_START] < 0 || d[MD_TABLE_OFF] < 0)
+            return 0;
+    }
+    return 1;
+}
+"""
+
+_ABI_PY = """\
+import numpy as np
+
+_NATIVE_MAGIC = 0x4B535750
+_NATIVE_VERSION = 1
+_MDFA_MAGIC = 0x4B4D4446
+_MDFA_VERSION = 1
+_MDFA_HEADER_WORDS = 4
+_MDFA_DESC_WORDS = 3
+
+
+def native_sweep_blob(prog):
+    header = np.zeros(8, dtype=np.int32)
+    parts = []
+    pos = 32
+
+    def put(arr, dt):
+        nonlocal pos
+        b = np.ascontiguousarray(arr, dtype=dt).tobytes()
+        at = pos
+        parts.append(b)
+        pos += len(b)
+        return at
+
+    header[0] = _NATIVE_MAGIC
+    header[1] = _NATIVE_VERSION
+    header[2] = len(prog.fac)
+    for base, tier in ((3, prog.narrow), (5, prog.wide)):
+        header[base + 0] = len(tier.keys)
+        header[base + 1] = put(tier.keys, "<u4")
+    header[7] = pos
+    return header.astype("<i4").tobytes() + b"".join(parts)
+
+
+def multidfa_blob(tables):
+    m_count = len(tables)
+    header = np.zeros(_MDFA_HEADER_WORDS + _MDFA_DESC_WORDS * m_count,
+                      dtype=np.int32)
+    pos = 0
+    for m, t in enumerate(tables):
+        d = _MDFA_HEADER_WORDS + _MDFA_DESC_WORDS * m
+        header[d + 0] = t.n
+        header[d + 1] = t.start
+        header[d + 2] = pos
+        pos += t.size
+    header[0] = _MDFA_MAGIC
+    header[1] = _MDFA_VERSION
+    header[2] = m_count
+    header[3] = pos
+    return header.tobytes()
+"""
+
+
+def _abi_tree(tmp_path, c_subst=None, py_subst=None):
+    c, py = _ABI_C, _ABI_PY
+    if c_subst is not None:
+        old, new = c_subst
+        assert old in c, old
+        c = c.replace(old, new)
+    if py_subst is not None:
+        old, new = py_subst
+        assert old in py, old
+        py = py.replace(old, new)
+    return _tree(tmp_path, {
+        "klogs_tpu/native/_hostops.c": c,
+        "klogs_tpu/filters/compiler/index.py": py,
+    })
+
+
+def test_abi_conformance_clean_pair(tmp_path):
+    root = _abi_tree(tmp_path)
+    assert _active(root, "abi-conformance") == []
+
+
+def test_abi_conformance_real_tree_clean():
+    assert _active(REPO, "abi-conformance") == []
+
+
+def test_abi_conformance_absent_contract_out_of_scope(tmp_path):
+    """Fixture trees for other passes (no native blob surfaces) must
+    not trip the contract table."""
+    root = _tree(tmp_path, {"klogs_tpu/service/x.py": "X = 1\n"})
+    assert _active(root, "abi-conformance") == []
+
+
+def test_abi_conformance_magic_drift(tmp_path):
+    root = _abi_tree(tmp_path, py_subst=(
+        "_NATIVE_MAGIC = 0x4B535750", "_NATIVE_MAGIC = 0x4B535751"))
+    found = _active(root, "abi-conformance")
+    assert len(found) == 1, [f.message for f in found]
+    assert "magic disagrees" in found[0].message
+    assert "0x4B535751" in found[0].message
+
+
+def test_abi_conformance_version_drift(tmp_path):
+    root = _abi_tree(tmp_path, c_subst=(
+        "#define MDFA_VERSION 1", "#define MDFA_VERSION 2"))
+    found = _active(root, "abi-conformance")
+    assert len(found) == 1, [f.message for f in found]
+    assert "version disagrees" in found[0].message
+
+
+def test_abi_conformance_header_word_count_drift_py(tmp_path):
+    root = _abi_tree(tmp_path, py_subst=(
+        "np.zeros(8, dtype=np.int32)", "np.zeros(9, dtype=np.int32)"))
+    found = _active(root, "abi-conformance")
+    assert len(found) == 1, [f.message for f in found]
+    assert "header word count disagrees" in found[0].message
+
+
+def test_abi_conformance_header_word_count_drift_c(tmp_path):
+    root = _abi_tree(tmp_path, c_subst=("SH_WORDS = 8", "SH_WORDS = 9"))
+    found = _active(root, "abi-conformance")
+    assert len(found) == 1, [f.message for f in found]
+    assert "header word count disagrees" in found[0].message
+
+
+def test_abi_conformance_descriptor_stride_drift(tmp_path):
+    root = _abi_tree(tmp_path, py_subst=(
+        "_MDFA_DESC_WORDS = 3", "_MDFA_DESC_WORDS = 4"))
+    found = _active(root, "abi-conformance")
+    assert len(found) == 1, [f.message for f in found]
+    assert "descriptor stride disagrees" in found[0].message
+
+
+def test_abi_conformance_unvalidated_header_word(tmp_path):
+    """Parser stops validating a packed word -> exactly one finding
+    pointing at the pack site (the word can now drift unnoticed)."""
+    root = _abi_tree(tmp_path, c_subst=(
+        "    if (h[SH_F] < 0)\n        return 0;\n", ""))
+    found = _active(root, "abi-conformance")
+    assert len(found) == 1, [f.message for f in found]
+    assert "packed but never read" in found[0].message
+    assert "header word 2" in found[0].message
+    assert found[0].path == "klogs_tpu/filters/compiler/index.py"
+
+
+def test_abi_conformance_unpacked_header_word(tmp_path):
+    """Packer stops writing a word the parser reads -> the parser
+    trusts uninitialized bytes; one finding at the parse fn."""
+    root = _abi_tree(tmp_path, py_subst=(
+        "    header[2] = len(prog.fac)\n", ""))
+    found = _active(root, "abi-conformance")
+    assert len(found) == 1, [f.message for f in found]
+    assert "never packed" in found[0].message
+    assert found[0].path == "klogs_tpu/native/_hostops.c"
+
+
+def test_abi_conformance_endianness_drift(tmp_path):
+    root = _abi_tree(tmp_path, py_subst=(
+        'put(tier.keys, "<u4")', 'put(tier.keys, "u4")'))
+    found = _active(root, "abi-conformance")
+    assert len(found) == 1, [f.message for f in found]
+    assert "little-endian" in found[0].message
+
+
+def test_abi_conformance_header_astype_dropped(tmp_path):
+    root = _abi_tree(tmp_path, py_subst=(
+        'header.astype("<i4").tobytes()', "header.tobytes()"))
+    found = _active(root, "abi-conformance")
+    assert len(found) == 1, [f.message for f in found]
+    assert "astype" in found[0].message
+
+
+def test_abi_conformance_one_sided_rename(tmp_path):
+    """A renamed packer (constants survive) is ONE one-sided finding,
+    not a cascade of per-word coverage noise; same for the C side."""
+    root = _abi_tree(tmp_path, py_subst=(
+        "def multidfa_blob(", "def multidfa_blob_v2("))
+    found = _active(root, "abi-conformance")
+    assert len(found) == 1, [f.message for f in found]
+    assert "one-sided" in found[0].message
+
+    root2 = _abi_tree(tmp_path / "c", c_subst=(
+        "mdfa_parse_blob(const char", "mdfa_parse_blob_v2(const char"))
+    found2 = _active(root2, "abi-conformance")
+    assert len(found2) == 1, [f.message for f in found2]
+    assert "one-sided" in found2[0].message
+
+
+def test_abi_conformance_deleted_constant(tmp_path):
+    root = _abi_tree(tmp_path, c_subst=(
+        "#define SWEEP_MAGIC 0x4B535750\n", ""))
+    found = _active(root, "abi-conformance")
+    assert len(found) == 1, [f.message for f in found]
+    assert "SWEEP_MAGIC" in found[0].message
+
+
+def test_abi_conformance_suppression(tmp_path):
+    root = _abi_tree(
+        tmp_path,
+        py_subst=("_NATIVE_MAGIC = 0x4B535750",
+                  "_NATIVE_MAGIC = 0x4B535751"
+                  "  # klogs: ignore[abi-conformance]"))
+    report = run(root, rules=["abi-conformance"])
+    assert report.active == []
+    assert len(report.suppressed) == 1
+
+
+# -- interprocedural lock-discipline ----------------------------------
+
+def _lock_passes(root):
+    """(old-pass findings, new-pass findings), stale-decl noise
+    filtered (fixtures define a single declared class per file)."""
+    from tools.analysis.passes.lock_discipline import LockDisciplinePass
+
+    old = run(root, passes=[LockDisciplinePass(interprocedural=False)])
+    new = run(root, passes=[LockDisciplinePass()])
+    assert not old.errors and not new.errors, (old.errors, new.errors)
+    return ([f for f in old.active if "stale" not in f.message],
+            [f for f in new.active if "stale" not in f.message])
+
+
+def test_lock_helper_param_hole_old_silent_new_loud(tmp_path):
+    """THE cross-function shape the intraprocedural pass provably
+    misses: the declared field is mutated through a helper's
+    parameter, so no `self.<field>` mutation exists lexically at the
+    unlocked site."""
+    root = _tree(tmp_path, {"klogs_tpu/service/tenancy.py": """
+        import threading
+
+        class PatternSetRegistry:
+            def __init__(self):
+                self._mut = threading.Lock()
+                self._sets = {}
+                self._building = {}
+
+            def _merge(self, d, k, v):
+                d[k] = v
+
+            def adopt(self, k, v):
+                self._merge(self._sets, k, v)
+
+            def ok(self, k, v):
+                with self._mut:
+                    self._building[k] = v
+        """})
+    old, new = _lock_passes(root)
+    assert old == [], [f.message for f in old]
+    assert len(new) == 1, [f.message for f in new]
+    assert "_sets" in new[0].message
+    assert "helper" in new[0].message
+
+
+def test_lock_helper_param_under_lock_is_clean(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/service/tenancy.py": """
+        import threading
+
+        class PatternSetRegistry:
+            def __init__(self):
+                self._mut = threading.Lock()
+                self._sets = {}
+                self._building = {}
+
+            def _merge(self, d, k, v):
+                d[k] = v
+
+            def adopt(self, k, v):
+                with self._mut:
+                    self._merge(self._sets, k, v)
+                    self._building[k] = v
+        """})
+    old, new = _lock_passes(root)
+    assert old == [] and new == []
+
+
+def test_lock_alias_mutation(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/service/tenancy.py": """
+        import threading
+
+        class PatternSetRegistry:
+            def __init__(self):
+                self._mut = threading.Lock()
+                self._sets = {}
+                self._building = {}
+
+            def evict(self, k):
+                s = self._sets
+                s.pop(k, None)
+                with self._mut:
+                    self._building.clear()
+        """})
+    old, new = _lock_passes(root)
+    assert old == [], [f.message for f in old]
+    assert len(new) == 1, [f.message for f in new]
+    assert "_sets" in new[0].message and "alias" in new[0].message
+
+
+def test_await_under_lock(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/service/tenancy.py": """
+        import threading
+
+        class PatternSetRegistry:
+            def __init__(self):
+                self._mut = threading.Lock()
+                self._sets = {}
+                self._building = {}
+
+            async def register(self, k, v):
+                with self._mut:
+                    self._sets[k] = v
+                    await v.build()
+                self._building.pop(k, None)
+        """})
+    old, new = _lock_passes(root)
+    # the old pass sees only the unlocked _building.pop mutation
+    assert len(old) == 1 and "_building" in old[0].message
+    awaits = [f for f in new if "await while holding" in f.message]
+    assert len(awaits) == 1, [f.message for f in new]
+    assert "self._mut" in awaits[0].message
+
+
+def test_locked_helper_waiver(tmp_path):
+    """A private helper whose every call site holds the lock is clean
+    under the interprocedural pass (the old lexical pass flags it —
+    precision, not just recall)."""
+    root = _tree(tmp_path, {"klogs_tpu/service/tenancy.py": """
+        import threading
+
+        class PatternSetRegistry:
+            def __init__(self):
+                self._mut = threading.Lock()
+                self._sets = {}
+                self._building = {}
+
+            def _install(self, k, v):
+                self._sets[k] = v
+                self._building.pop(k, None)
+
+            def register(self, k, v):
+                with self._mut:
+                    self._install(k, v)
+        """})
+    old, new = _lock_passes(root)
+    assert len(old) == 2, [f.message for f in old]
+    assert new == [], [f.message for f in new]
+
+
+def test_locked_helper_waiver_denied_on_unlocked_site(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/service/tenancy.py": """
+        import threading
+
+        class PatternSetRegistry:
+            def __init__(self):
+                self._mut = threading.Lock()
+                self._sets = {}
+                self._building = {}
+
+            def _install(self, k, v):
+                self._sets[k] = v
+
+            def register(self, k, v):
+                with self._mut:
+                    self._install(k, v)
+
+            def sneak(self, k, v):
+                self._install(k, v)
+
+            def touch(self):
+                with self._mut:
+                    self._building.clear()
+        """})
+    _, new = _lock_passes(root)
+    assert len(new) == 1, [f.message for f in new]
+    assert "_install" in new[0].message
+
+
+def test_locked_helper_waiver_denied_when_spawned(tmp_path):
+    """A helper handed to a spawn primitive runs in a context where
+    the caller's lock is NOT held — lexically-locked call sites must
+    not waive it."""
+    root = _tree(tmp_path, {"klogs_tpu/service/tenancy.py": """
+        import threading
+
+        class PatternSetRegistry:
+            def __init__(self):
+                self._mut = threading.Lock()
+                self._sets = {}
+                self._building = {}
+
+            def _install(self):
+                self._sets.clear()
+
+            def register(self):
+                with self._mut:
+                    self._install()
+                    threading.Thread(target=self._install).start()
+                    self._building.clear()
+        """})
+    _, new = _lock_passes(root)
+    assert len(new) == 1, [f.message for f in new]
+    assert "_install" in new[0].message and "_sets" in new[0].message
+
+
+def test_lock_order_inversion(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/service/tenancy.py": """
+        import threading
+
+        class PatternSetRegistry:
+            def __init__(self):
+                self._mut = threading.Lock()
+                self._lock = threading.Lock()
+                self._sets = {}
+                self._building = {}
+
+            def a(self):
+                with self._mut:
+                    with self._lock:
+                        self._sets.clear()
+
+            def b(self):
+                with self._lock:
+                    with self._mut:
+                        self._building.clear()
+        """})
+    old, new = _lock_passes(root)
+    assert old == [], [f.message for f in old]
+    inversions = [f for f in new if "inversion" in f.message]
+    assert len(inversions) == 1, [f.message for f in new]
+    assert "_lock" in inversions[0].message
+    assert "_mut" in inversions[0].message
+
+
+# -- per-pass wall time + soft budget ----------------------------------
+
+def test_timings_in_json_output():
+    import json as _json
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analysis", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = _json.loads(proc.stdout)
+    timings = doc["timings_s"]
+    assert "total" in timings and timings["total"] > 0
+    assert "abi-conformance" in timings
+    assert "lock-discipline" in timings
+    # per-pass times sum to <= total (total includes fold/sort)
+    assert sum(v for k, v in timings.items() if k != "total") \
+        <= timings["total"] + 1e-6
+
+
+def test_budget_soft_warning_does_not_change_exit(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analysis",
+         "--budget-s", "0.000001"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "soft budget" in proc.stderr
+    assert "slowest pass" in proc.stderr
+
+    proc2 = subprocess.run(
+        [sys.executable, "-m", "tools.analysis", "--budget-s", "9999"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc2.returncode == 0
+    assert "soft budget" not in proc2.stderr
+
+
+def test_timings_human_flag():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analysis", "--timings"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert " ms" in proc.stdout
+    assert "abi-conformance" in proc.stdout
+
+
+# -- TSan gate ---------------------------------------------------------
+
+def test_native_tsan_gate():
+    """tools/build_native_asan.py --tsan builds _hostops.c with
+    -fsanitize=thread and re-runs the threaded group-scan + sweep
+    reentrancy tests against that binary (halt_on_error=1: the first
+    data race fails the run). Skips loudly where no TSan-capable
+    toolchain exists (exit 2)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.build_native_asan", "--tsan"],
+        cwd=REPO, capture_output=True, text=True, timeout=480)
+    if proc.returncode == 2:
+        pytest.skip(f"sanitizer toolchain unavailable: "
+                    f"{proc.stdout.strip().splitlines()[-1]}")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK: native parity tests passed under TSan" in proc.stdout

@@ -35,6 +35,16 @@ def main(argv: "list[str] | None" = None) -> int:
                          "unchanged")
     ap.add_argument("--list", action="store_true", dest="list_rules",
                     help="list registered rules and exit")
+    ap.add_argument("--timings", action="store_true",
+                    help="print per-pass wall time (always present in "
+                         "--json output as timings_s)")
+    ap.add_argument("--budget-s", type=float, default=30.0,
+                    metavar="SECONDS",
+                    help="soft wall-time budget for the whole run "
+                         "(default 30): exceeding it prints a warning "
+                         "to stderr but does NOT change the exit code "
+                         "— tier-1 rides a hard time gate, so analysis "
+                         "growth must stay visibly accounted")
     ns = ap.parse_args(argv)
 
     passes = all_passes()
@@ -62,10 +72,26 @@ def main(argv: "list[str] | None" = None) -> int:
             print(f.format())
         for e in report.errors:
             print(f"analysis error: {e}", file=sys.stderr)
+        if ns.timings:
+            for rule, secs in sorted(report.timings.items(),
+                                     key=lambda kv: -kv[1]):
+                if rule != "total":
+                    print(f"  {rule:20s} {secs * 1000:8.1f} ms")
         n_rules = len(rules) if rules is not None else len(passes)
         print(f"tools.analysis: {len(report.active)} finding(s), "
               f"{len(report.suppressed)} suppressed, "
-              f"{n_rules} rule(s) checked")
+              f"{n_rules} rule(s) checked in "
+              f"{report.timings.get('total', 0.0):.2f}s")
+    total = report.timings.get("total", 0.0)
+    if ns.budget_s and total > ns.budget_s:
+        slowest = max(
+            ((r, s) for r, s in report.timings.items() if r != "total"),
+            key=lambda kv: kv[1], default=("-", 0.0))
+        print(f"tools.analysis: WARNING: run took {total:.1f}s, over "
+              f"the {ns.budget_s:g}s soft budget (slowest pass: "
+              f"{slowest[0]} at {slowest[1]:.1f}s) — trim the pass or "
+              "raise --budget-s consciously; tier-1 rides a hard "
+              "time gate", file=sys.stderr)
     return report.exit_code
 
 

@@ -45,6 +45,7 @@ import ast
 import json
 import os
 import re
+import time
 from dataclasses import asdict, dataclass, field
 from typing import Any, Iterable, Iterator
 
@@ -216,6 +217,59 @@ class CallGraph:
                 name = self.index.callee_name(call)
                 if name is not None and name in seeds:
                     yield caller, call, name, seeds[name]
+
+
+# Spawn primitives that start a NEW execution context: the callable
+# they receive runs later, on the loop or on another thread, with none
+# of the spawner's lexical state (locks held, loop affinity) carried
+# over. ``(dotted-suffix, argument position)``; position -1 means the
+# ``target=`` keyword (threading.Thread).
+_SPAWN_SITES: "tuple[tuple[str, int], ...]" = (
+    ("create_task", 0),
+    ("ensure_future", 0),
+    ("to_thread", 0),
+    ("run_in_executor", 1),
+    ("submit", 0),
+    ("Thread", -1),
+)
+
+
+def spawn_target_names(index: ModuleIndex) -> set[str]:
+    """Names of functions/methods handed to a spawn primitive anywhere
+    in the module (``create_task(self.f(...))`` spawns a call result,
+    so the target there is the inner call's callee). A name in this
+    set runs in its own execution context: lexical facts about its
+    call sites (a caller's ``with`` block, loop affinity) must not be
+    credited to it."""
+    out: set[str] = set()
+
+    def _target_name(node: ast.AST) -> "str | None":
+        if isinstance(node, ast.Call):  # create_task(self.f())
+            return ModuleIndex.callee_name(node)
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Attribute):
+            return node.attr
+        return None
+
+    for info in index.functions:
+        for call in (n for n in ast.walk(info.node)
+                     if isinstance(n, ast.Call)):
+            spelled = dotted(call.func)
+            for suffix, pos in _SPAWN_SITES:
+                if not (spelled == suffix
+                        or spelled.endswith(f".{suffix}")):
+                    continue
+                arg: "ast.AST | None" = None
+                if pos == -1:
+                    arg = next((kw.value for kw in call.keywords
+                                if kw.arg == "target"), None)
+                elif len(call.args) > pos:
+                    arg = call.args[pos]
+                name = _target_name(arg) if arg is not None else None
+                if name is not None:
+                    out.add(name)
+    return out
 
 
 class ReachingDefs:
@@ -597,6 +651,10 @@ class Pass:
 class Report:
     findings: list[Finding] = field(default_factory=list)
     errors: list[str] = field(default_factory=list)
+    # Wall-clock per pass (seconds, rule-keyed) plus the whole run
+    # under "total" — the analysis suite rides tier-1 against a hard
+    # time budget, so growth must stay visibly accounted.
+    timings: "dict[str, float]" = field(default_factory=dict)
 
     @property
     def active(self) -> list[Finding]:
@@ -619,6 +677,8 @@ class Report:
                     "active": len(self.active),
                     "suppressed": len(self.suppressed),
                 },
+                "timings_s": {k: round(v, 4)
+                              for k, v in self.timings.items()},
             },
             indent=1,
         )
@@ -704,6 +764,7 @@ def run(root: str, rules: "list[str] | None" = None,
                     used.add((f.path, hit[0], hit[1]))
             report.findings.append(f)
 
+    t_run = time.perf_counter()
     post: list[Pass] = []
     for p in passes:
         if rules is not None and p.rule not in rules:
@@ -712,18 +773,25 @@ def run(root: str, rules: "list[str] | None" = None,
         if type(p).run_post is not Pass.run_post:
             post.append(p)
             continue
+        t0 = time.perf_counter()
         try:
             found = p.run(project)
         except Exception as e:  # noqa: BLE001 - analyzer must not lie
             report.errors.append(f"pass {p.rule} crashed: {e!r}")
             continue
+        finally:
+            report.timings[p.rule] = time.perf_counter() - t0
         _fold(found)
     for p in post:
+        t0 = time.perf_counter()
         try:
             found = p.run_post(project, report, executed, used)
         except Exception as e:  # noqa: BLE001
             report.errors.append(f"pass {p.rule} crashed: {e!r}")
             continue
+        finally:
+            report.timings[p.rule] = time.perf_counter() - t0
         _fold(found)
     report.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    report.timings["total"] = time.perf_counter() - t_run
     return report

@@ -7,6 +7,7 @@ audit last.
 
 
 def all_passes():
+    from tools.analysis.passes.abi_conformance import AbiConformancePass
     from tools.analysis.passes.async_blocking import AsyncBlockingPass
     from tools.analysis.passes.cli_docs import CliDocsPass
     from tools.analysis.passes.dispatch_parity import DispatchParityPass
@@ -40,6 +41,7 @@ def all_passes():
         WireTokensPass(),
         MetricCardinalityPass(),
         NativeTierPass(),
+        AbiConformancePass(),
         MetricsDocsPass(),
         CliDocsPass(),
         SuppressionAuditPass(),

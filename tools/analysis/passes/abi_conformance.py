@@ -1,0 +1,585 @@
+"""abi-conformance: the Python blob packers and the C blob parsers
+agree on every contract fact of the hand-packed native ABIs.
+
+The engine crosses the Python/C boundary through two packed-bytes
+ABIs: the SIMD sweep program (``FactorIndex.native_sweep_blob`` ->
+``sweep_parse_blob``) and the MultiDFA group-scan program
+(``multidfa_blob`` -> ``mdfa_parse_blob``). Each side states the
+layout independently — enum word indexes and ``#define`` magics in
+``_hostops.c``, header-index assignments and module constants in
+``filters/compiler/index.py`` — so a Fat-Teddy-style ABI bump that
+touches only one side compiles, imports, and then corrupts every scan
+whose payload happens not to trip the parser's bounds checks. This
+pass extracts the contract facts from BOTH sides and diffs them, so
+one-sided drift fails tier-1 instead:
+
+- **magic / version values** — C ``*_MAGIC``/``*_VERSION`` defines vs
+  the packer module's constants; a missing constant on either side is
+  itself a finding (a renamed token must not vacate the gate).
+- **header word counts and descriptor strides** — C ``SH_WORDS`` /
+  ``MH_WORDS`` / ``MD_WORDS`` enum values vs the packer's
+  ``np.zeros(...)`` header allocation and stride constants.
+- **word coverage** — every header/descriptor word the packer writes
+  must be read by the parser (an unread word is an unvalidated header
+  word: the parser cannot notice it drifting), and every word the
+  parser reads must be written (a read of an unpacked word trusts
+  uninitialized garbage). Tier sub-headers (``SH_NARROW``/``SH_WIDE``
+  bases x ``ST_*`` offsets) are expanded to absolute indexes on both
+  sides first; a base-offset mismatch is reported once, not per word.
+- **dtype / endianness** — a little-endian contract (the sweep blob)
+  must serialize every multi-byte array with an explicit ``<`` dtype
+  and the header via ``astype("<i4")``; the header allocation must be
+  int32 on any contract (the C side casts to ``const int32_t *``).
+
+The C extractor is a lexical lexer reusing the native-tier pass's
+comment-stripping and function-walking machinery (a lint, not a C
+front end); the Python extractor walks the packer's AST. Facts that
+cannot be extracted because a declared file/function is missing on one
+side while the other side exists are findings too; trees containing
+neither side (fixture trees for other passes) are silently out of
+scope.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from tools.analysis.core import Finding, Pass, Project, dotted
+from tools.analysis.passes.native_tier import (
+    NATIVE_DIR,
+    c_functions,
+    strip_comments,
+)
+
+PACKER_FILE = "klogs_tpu/filters/compiler/index.py"
+
+
+@dataclass(frozen=True)
+class BlobContract:
+    """One packer<->parser ABI. Token names are declared here — the
+    declaration table doctrine (SHARED_STATE, wire-token owners): the
+    act of adding a blob ABI is the act of declaring its contract."""
+
+    name: str                     # human tag in messages
+    c_magic: str                  # e.g. SWEEP_MAGIC
+    c_version: str                # e.g. SWEEP_VERSION
+    c_header_words: str           # e.g. SH_WORDS
+    c_header_prefix: str          # header-index enum prefix, e.g. SH_
+    c_parse_fn: str               # e.g. sweep_parse_blob
+    py_magic: str                 # e.g. _NATIVE_MAGIC
+    py_version: str               # e.g. _NATIVE_VERSION
+    py_packer: str                # e.g. native_sweep_blob
+    endian: str                   # "little" | "native"
+    c_desc_words: "str | None" = None    # e.g. MD_WORDS
+    c_desc_prefix: "str | None" = None   # e.g. MD_
+    py_header_words: "str | None" = None  # e.g. _MDFA_HEADER_WORDS
+    py_desc_words: "str | None" = None   # e.g. _MDFA_DESC_WORDS
+    c_tier_fn: "str | None" = None       # e.g. sweep_parse_tier
+    c_tier_prefix: "str | None" = None   # e.g. ST_
+    c_tier_bases: "tuple[str, ...]" = ()  # e.g. (SH_NARROW, SH_WIDE)
+
+
+CONTRACTS: "tuple[BlobContract, ...]" = (
+    BlobContract(
+        name="sweep",
+        c_magic="SWEEP_MAGIC", c_version="SWEEP_VERSION",
+        c_header_words="SH_WORDS", c_header_prefix="SH_",
+        c_parse_fn="sweep_parse_blob",
+        py_magic="_NATIVE_MAGIC", py_version="_NATIVE_VERSION",
+        py_packer="native_sweep_blob",
+        endian="little",
+        c_tier_fn="sweep_parse_tier", c_tier_prefix="ST_",
+        c_tier_bases=("SH_NARROW", "SH_WIDE"),
+    ),
+    BlobContract(
+        name="mdfa",
+        c_magic="MDFA_MAGIC", c_version="MDFA_VERSION",
+        c_header_words="MH_WORDS", c_header_prefix="MH_",
+        c_parse_fn="mdfa_parse_blob",
+        py_magic="_MDFA_MAGIC", py_version="_MDFA_VERSION",
+        py_packer="multidfa_blob",
+        endian="native",
+        c_desc_words="MD_WORDS", c_desc_prefix="MD_",
+        py_header_words="_MDFA_HEADER_WORDS",
+        py_desc_words="_MDFA_DESC_WORDS",
+    ),
+)
+
+# Word indexes the C header enums name but the parser reads via
+# pointer arithmetic rather than subscripts are NOT exempted — only
+# genuinely reserved words (neither packed nor read on either side)
+# stay silent. Words-count tokens themselves (``*_WORDS``) are layout
+# facts, not header indexes.
+_DEFINE_RE = re.compile(
+    r"^\s*#\s*define\s+(\w+)\s+(0[xX][0-9a-fA-F]+|\d+)\b")
+_ENUM_RE = re.compile(r"\benum\b[^{;]*\{([^}]*)\}", re.S)
+_SUBSCRIPT_RE = re.compile(r"\w+\[\s*([A-Za-z_]\w*)\s*\]")
+
+
+@dataclass
+class CFacts:
+    """Contract facts lexed out of the native C sources."""
+
+    consts: "dict[str, tuple[int, str, int]]" = field(
+        default_factory=dict)  # name -> (value, relpath, line)
+    # fn name -> (relpath, start line, set of subscript tokens)
+    fn_reads: "dict[str, tuple[str, int, set[str]]]" = field(
+        default_factory=dict)
+
+    def value(self, name: str) -> "int | None":
+        hit = self.consts.get(name)
+        return hit[0] if hit else None
+
+    def line(self, name: str) -> "tuple[str, int] | None":
+        hit = self.consts.get(name)
+        return (hit[1], hit[2]) if hit else None
+
+
+def _parse_int(tok: str) -> "int | None":
+    try:
+        return int(tok, 0)
+    except ValueError:
+        return None
+
+
+def _lex_c_file(rel: str, text: str, facts: CFacts) -> None:
+    stripped = strip_comments(text)
+    lines = stripped.splitlines()
+    for i, ln in enumerate(lines):
+        m = _DEFINE_RE.match(ln)
+        if m:
+            val = _parse_int(m.group(2))
+            if val is not None:
+                facts.consts[m.group(1)] = (val, rel, i + 1)
+    for m in _ENUM_RE.finditer(stripped):
+        at = stripped.count("\n", 0, m.start()) + 1
+        counter = 0
+        for entry in m.group(1).split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            if "=" in entry:
+                name, _, valtok = entry.partition("=")
+                val = _parse_int(valtok.strip())
+                if val is None:
+                    continue
+                counter = val
+            else:
+                name = entry
+            name = name.strip()
+            if re.fullmatch(r"[A-Za-z_]\w*", name):
+                facts.consts.setdefault(name, (counter, rel, at))
+            counter += 1
+    for fname, start, end in c_functions(lines):
+        body = "\n".join(lines[start:end + 1])
+        toks = set(_SUBSCRIPT_RE.findall(body))
+        facts.fn_reads.setdefault(fname, (rel, start + 1, toks))
+
+
+def _prefix_reads(facts: CFacts, fn: str, prefix: str) -> "set[int]":
+    """Header-word indexes ``fn`` reads via ``x[PREFIXNAME]``
+    subscripts, resolved through the lexed constant map."""
+    hit = facts.fn_reads.get(fn)
+    if hit is None:
+        return set()
+    out: "set[int]" = set()
+    for tok in hit[2]:
+        if tok.startswith(prefix):
+            val = facts.value(tok)
+            if val is not None:
+                out.add(val)
+    return out
+
+
+@dataclass
+class PackerFacts:
+    """Contract facts extracted from one packer function's AST."""
+
+    found: bool = False
+    lineno: int = 0
+    header_words: "int | None" = None        # np.zeros size (resolved)
+    desc_words: "int | None" = None          # stride in zeros/ offsets
+    header_dtype_ok: bool = True
+    direct_writes: "dict[int, int]" = field(default_factory=dict)
+    # base-name keyed relative writes: k -> line
+    tier_writes: "dict[int, int]" = field(default_factory=dict)
+    tier_bases: "tuple[int, ...]" = ()
+    desc_writes: "dict[int, int]" = field(default_factory=dict)
+    put_dtypes: "list[tuple[str, int]]" = field(default_factory=list)
+    astype_lt: bool = False                  # astype("<i4")-style seen
+
+
+def _const_int(node: "ast.AST | None",
+               consts: "dict[str, tuple[int, int]]") -> "int | None":
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    if isinstance(node, ast.Name):
+        hit = consts.get(node.id)
+        return hit[0] if hit else None
+    return None
+
+
+def _module_int_consts(tree: ast.AST) -> "dict[str, tuple[int, int]]":
+    out: "dict[str, tuple[int, int]]" = {}
+    for node in ast.iter_child_nodes(tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, int)):
+            out[node.targets[0].id] = (node.value.value, node.lineno)
+    return out
+
+
+def _extract_packer(fn: "ast.FunctionDef | ast.AsyncFunctionDef",
+                    consts: "dict[str, tuple[int, int]]") -> PackerFacts:
+    pf = PackerFacts(found=True, lineno=fn.lineno)
+    tier_base_names: "dict[str, tuple[int, ...]]" = {}
+    desc_base_names: "set[str]" = set()
+    # First walk: every np.zeros-assigned local is a header candidate
+    # (the packer also zeros scratch arrays — teddy masks, blooms); the
+    # header is the candidate with the most word-indexed writes.
+    zeros_calls: "dict[str, ast.Call]" = {}
+    write_counts: "dict[str, int]" = {}
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and dotted(node.value.func).endswith("zeros")
+                and node.value.args):
+            zeros_calls.setdefault(node.targets[0].id, node.value)
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Name)
+                        and not isinstance(t.slice, (ast.Tuple, ast.Slice))):
+                    write_counts[t.value.id] = (
+                        write_counts.get(t.value.id, 0) + 1)
+    if not zeros_calls:
+        return pf
+    header_name = max(zeros_calls,
+                      key=lambda n: (write_counts.get(n, 0) + (n == "header"),
+                                     -zeros_calls[n].func.lineno))
+    zeros = zeros_calls[header_name]
+    size = zeros.args[0]
+    if isinstance(size, ast.BinOp) and isinstance(size.op, ast.Add):
+        pf.header_words = _const_int(size.left, consts)
+        if (isinstance(size.right, ast.BinOp)
+                and isinstance(size.right.op, ast.Mult)):
+            pf.desc_words = (
+                _const_int(size.right.left, consts)
+                if _const_int(size.right.left, consts) is not None
+                else _const_int(size.right.right, consts))
+    else:
+        pf.header_words = _const_int(size, consts)
+    dt = next((kw.value for kw in zeros.keywords
+               if kw.arg == "dtype"), None)
+    if dt is not None:
+        spelled = (dotted(dt) or
+                   (dt.value if isinstance(dt, ast.Constant)
+                    and isinstance(dt.value, str) else ""))
+        pf.header_dtype_ok = str(spelled).endswith(
+            ("int32", "i4", "<i4"))
+    for node in ast.walk(fn):
+        # d = _HEADER_WORDS + _DESC_WORDS * m  (descriptor base)
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.BinOp)
+                and isinstance(node.value.op, ast.Add)
+                and isinstance(node.value.right, ast.BinOp)
+                and isinstance(node.value.right.op, ast.Mult)):
+            desc_base_names.add(node.targets[0].id)
+        # for base, ... in ((13, ...), (22, ...)):  (tier bases)
+        if (isinstance(node, ast.For)
+                and isinstance(node.iter, ast.Tuple)):
+            names = (node.target.elts
+                     if isinstance(node.target, ast.Tuple)
+                     else [node.target])
+            if names and isinstance(names[0], ast.Name):
+                bases: "list[int]" = []
+                for el in node.iter.elts:
+                    first = (el.elts[0]
+                             if isinstance(el, ast.Tuple) and el.elts
+                             else el)
+                    v = _const_int(first, consts)
+                    if v is not None:
+                        bases.append(v)
+                if bases:
+                    tier_base_names[names[0].id] = tuple(bases)
+        # header[IDX] = ...
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if not (isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == header_name):
+                    continue
+                idx = t.slice
+                direct = _const_int(idx, consts)
+                if direct is not None:
+                    pf.direct_writes.setdefault(direct, node.lineno)
+                elif (isinstance(idx, ast.BinOp)
+                        and isinstance(idx.op, ast.Add)
+                        and isinstance(idx.left, ast.Name)):
+                    k = _const_int(idx.right, consts)
+                    if k is None:
+                        continue
+                    if idx.left.id in tier_base_names:
+                        pf.tier_writes.setdefault(k, node.lineno)
+                        pf.tier_bases = tier_base_names[idx.left.id]
+                    elif idx.left.id in desc_base_names:
+                        pf.desc_writes.setdefault(k, node.lineno)
+        # put(arr, "<u4") dtype discipline / header.astype("<i4")
+        if isinstance(node, ast.Call):
+            if (isinstance(node.func, ast.Name) and node.func.id == "put"
+                    and len(node.args) >= 2
+                    and isinstance(node.args[1], ast.Constant)
+                    and isinstance(node.args[1].value, str)):
+                pf.put_dtypes.append((node.args[1].value, node.lineno))
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "astype" and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and node.args[0].value.startswith("<")):
+                pf.astype_lt = True
+    return pf
+
+
+class AbiConformancePass(Pass):
+    rule = "abi-conformance"
+    doc = ("the Python blob packers and the C blob parsers state the "
+           "same ABI: magic/version values, header word counts, "
+           "descriptor strides, word coverage, endianness")
+
+    def run(self, project: Project) -> "list[Finding]":
+        import os
+
+        facts = CFacts()
+        native = os.path.join(project.root, *NATIVE_DIR.split("/"))
+        if os.path.isdir(native):
+            for fn in sorted(os.listdir(native)):
+                if fn.endswith(".c"):
+                    rel = f"{NATIVE_DIR}/{fn}"
+                    text = project.read_text(rel)
+                    if text is not None:
+                        _lex_c_file(rel, text, facts)
+        sf = project.file(PACKER_FILE)
+        findings: "list[Finding]" = []
+        for contract in CONTRACTS:
+            findings.extend(self._check(contract, facts, sf))
+        return findings
+
+    # -- one contract --------------------------------------------------
+
+    def _check(self, ct: BlobContract, facts: CFacts,
+               sf: "object | None") -> "list[Finding]":
+        c_has = (ct.c_magic in facts.consts
+                 or ct.c_parse_fn in facts.fn_reads)
+        py_consts: "dict[str, tuple[int, int]]" = {}
+        pf = PackerFacts()
+        if sf is not None:
+            tree = sf.tree  # type: ignore[attr-defined]
+            py_consts = _module_int_consts(tree)
+            index = sf.index  # type: ignore[attr-defined]
+            fns = index.functions_named(ct.py_packer)
+            if fns:
+                pf = _extract_packer(fns[0].node, py_consts)
+        py_has = pf.found or ct.py_magic in py_consts
+        if not c_has and not py_has:
+            return []  # contract absent from this tree: out of scope
+        findings: "list[Finding]" = []
+        if not c_has or not py_has:
+            side = "C parser" if not c_has else "Python packer"
+            findings.append(self.finding(
+                PACKER_FILE if py_has else f"{NATIVE_DIR}/_hostops.c",
+                pf.lineno if py_has else 0,
+                f"{ct.name}: one-sided blob contract — the {side} side "
+                f"({ct.c_parse_fn if not c_has else ct.py_packer}, "
+                f"{ct.c_magic if not c_has else ct.py_magic}) was not "
+                "found; a renamed ABI surface must update the contract "
+                "table, not vacate the gate"))
+            return findings
+        findings.extend(self._check_value(
+            ct, "magic", ct.c_magic, ct.py_magic, facts, py_consts,
+            hexa=True))
+        findings.extend(self._check_value(
+            ct, "version", ct.c_version, ct.py_version, facts, py_consts))
+        # A missing function on one side (renamed packer / parse fn
+        # while the constants survive) is ONE one-sided finding, not a
+        # cascade of per-word coverage findings against an empty set.
+        if ct.c_parse_fn not in facts.fn_reads:
+            findings.append(self.finding(
+                f"{NATIVE_DIR}/_hostops.c", 0,
+                f"{ct.name}: one-sided blob contract — C parse "
+                f"function {ct.c_parse_fn}() was not found; a renamed "
+                "ABI surface must update the contract table, not "
+                "vacate the gate"))
+            return findings
+        if not pf.found:
+            findings.append(self.finding(
+                PACKER_FILE, 0,
+                f"{ct.name}: one-sided blob contract — Python packer "
+                f"{ct.py_packer}() was not found; a renamed ABI "
+                "surface must update the contract table, not vacate "
+                "the gate"))
+            return findings
+        findings.extend(self._check_words(ct, facts, py_consts, pf))
+        findings.extend(self._check_coverage(ct, facts, pf))
+        findings.extend(self._check_endian(ct, pf))
+        return findings
+
+    def _check_value(self, ct: BlobContract, what: str, c_tok: str,
+                     py_tok: str, facts: CFacts,
+                     py_consts: "dict[str, tuple[int, int]]", *,
+                     hexa: bool = False) -> "list[Finding]":
+        cv = facts.value(c_tok)
+        pv = py_consts.get(py_tok)
+        if cv is None or pv is None:
+            missing = c_tok if cv is None else py_tok
+            where = (facts.line(c_tok) if cv is None else None)
+            return [self.finding(
+                where[0] if where else PACKER_FILE,
+                where[1] if where else 0,
+                f"{ct.name}: contract constant {missing!r} not found — "
+                f"one-sided {what} (the other side still packs/parses "
+                "it)")]
+        if cv != pv[0]:
+            fmt = (lambda v: f"0x{v:X}") if hexa else str
+            return [self.finding(
+                PACKER_FILE, pv[1],
+                f"{ct.name}: {what} disagrees — C {c_tok}="
+                f"{fmt(cv)} vs Python {py_tok}={fmt(pv[0])} (blobs "
+                "packed by one side are rejected or misread by the "
+                "other)")]
+        return []
+
+    def _check_words(self, ct: BlobContract, facts: CFacts,
+                     py_consts: "dict[str, tuple[int, int]]",
+                     pf: PackerFacts) -> "list[Finding]":
+        findings: "list[Finding]" = []
+        c_words = facts.value(ct.c_header_words)
+        py_words: "int | None"
+        py_line = pf.lineno
+        if ct.py_header_words is not None:
+            hit = py_consts.get(ct.py_header_words)
+            py_words = hit[0] if hit else pf.header_words
+            if hit:
+                py_line = hit[1]
+        else:
+            py_words = pf.header_words
+        if c_words is not None and py_words is not None \
+                and c_words != py_words:
+            findings.append(self.finding(
+                PACKER_FILE, py_line,
+                f"{ct.name}: header word count disagrees — C "
+                f"{ct.c_header_words}={c_words} vs packer header of "
+                f"{py_words} words (every offset after the header "
+                "shifts)"))
+        if ct.c_desc_words is not None:
+            c_desc = facts.value(ct.c_desc_words)
+            py_desc: "int | None" = None
+            d_line = pf.lineno
+            if ct.py_desc_words is not None:
+                hit = py_consts.get(ct.py_desc_words)
+                if hit:
+                    py_desc, d_line = hit
+            if py_desc is None:
+                py_desc = pf.desc_words
+            if c_desc is not None and py_desc is not None \
+                    and c_desc != py_desc:
+                findings.append(self.finding(
+                    PACKER_FILE, d_line,
+                    f"{ct.name}: descriptor stride disagrees — C "
+                    f"{ct.c_desc_words}={c_desc} vs Python "
+                    f"{ct.py_desc_words}={py_desc} (every member after "
+                    "the first is misread)"))
+        if not pf.header_dtype_ok:
+            findings.append(self.finding(
+                PACKER_FILE, pf.lineno,
+                f"{ct.name}: packer header is not int32 — the C side "
+                "reinterprets the header as const int32_t *"))
+        return findings
+
+    def _check_coverage(self, ct: BlobContract, facts: CFacts,
+                        pf: PackerFacts) -> "list[Finding]":
+        findings: "list[Finding]" = []
+        c_reads = _prefix_reads(facts, ct.c_parse_fn, ct.c_header_prefix)
+        py_writes: "dict[int, int]" = dict(pf.direct_writes)
+        # Tier sub-headers: expand both sides to absolute indexes.
+        if ct.c_tier_fn is not None and ct.c_tier_prefix is not None:
+            tier_reads = _prefix_reads(facts, ct.c_tier_fn,
+                                       ct.c_tier_prefix)
+            c_bases = tuple(
+                v for v in (facts.value(b) for b in ct.c_tier_bases)
+                if v is not None)
+            if pf.tier_writes and set(c_bases) != set(pf.tier_bases):
+                findings.append(self.finding(
+                    PACKER_FILE, min(pf.tier_writes.values()),
+                    f"{ct.name}: tier base offsets disagree — C "
+                    f"{'/'.join(ct.c_tier_bases)}={sorted(c_bases)} vs "
+                    f"packer bases {sorted(pf.tier_bases)}"))
+                # Judge per-word coverage against the C bases so a base
+                # drift reports once, not nine times per tier.
+            bases = c_bases
+            for b in bases:
+                for r in tier_reads:
+                    c_reads.add(b + r)
+                for k, ln in pf.tier_writes.items():
+                    py_writes.setdefault(b + k, ln)
+        for i in sorted(set(py_writes) - c_reads):
+            findings.append(self.finding(
+                PACKER_FILE, py_writes[i],
+                f"{ct.name}: header word {i} is packed but never read "
+                f"by {ct.c_parse_fn}() — an unvalidated header word "
+                "cannot be noticed drifting"))
+        hit = facts.fn_reads.get(ct.c_parse_fn)
+        c_rel, c_line = (hit[0], hit[1]) if hit else (
+            f"{NATIVE_DIR}/_hostops.c", 0)
+        for i in sorted(c_reads - set(py_writes)):
+            findings.append(self.finding(
+                c_rel, c_line,
+                f"{ct.name}: header word {i} is read by "
+                f"{ct.c_parse_fn}() but never packed — the parser "
+                "trusts uninitialized bytes"))
+        # Descriptor words (relative indexes, uniform stride).
+        if ct.c_desc_prefix is not None:
+            d_reads = _prefix_reads(facts, ct.c_parse_fn,
+                                    ct.c_desc_prefix)
+            d_words = facts.value(ct.c_desc_words or "")
+            if d_words is not None:
+                d_reads = {r for r in d_reads if r < d_words}
+            for i in sorted(set(pf.desc_writes) - d_reads):
+                findings.append(self.finding(
+                    PACKER_FILE, pf.desc_writes[i],
+                    f"{ct.name}: descriptor word {i} is packed but "
+                    f"never read by {ct.c_parse_fn}() — an unvalidated "
+                    "header word cannot be noticed drifting"))
+            for i in sorted(d_reads - set(pf.desc_writes)):
+                findings.append(self.finding(
+                    c_rel, c_line,
+                    f"{ct.name}: descriptor word {i} is read by "
+                    f"{ct.c_parse_fn}() but never packed — the parser "
+                    "trusts uninitialized bytes"))
+        return findings
+
+    def _check_endian(self, ct: BlobContract,
+                      pf: PackerFacts) -> "list[Finding]":
+        if ct.endian != "little" or not pf.found:
+            return []
+        findings: "list[Finding]" = []
+        for dt, ln in pf.put_dtypes:
+            if dt.startswith("<") or dt in ("u1", "i1", "b", "B"):
+                continue
+            findings.append(self.finding(
+                PACKER_FILE, ln,
+                f"{ct.name}: array serialized as {dt!r} without an "
+                "explicit little-endian dtype — the blob ABI is '<' "
+                "for every multi-byte array (a big-endian host would "
+                "pack a blob the kernel misreads)"))
+        if pf.put_dtypes and not pf.astype_lt:
+            findings.append(self.finding(
+                PACKER_FILE, pf.lineno,
+                f"{ct.name}: header is serialized without an explicit "
+                "little-endian astype('<i4') — the header must not "
+                "depend on host byte order"))
+        return findings

@@ -1,5 +1,6 @@
 """lock-discipline: declared shared state is only mutated under its
-declared lock (or only from the event loop, for loop-confined fields).
+declared lock (or only from the event loop, for loop-confined fields)
+— proven ACROSS call boundaries, not just lexically.
 
 The obs registry is scraped from the sidecar's loop while pipeline
 threads record into it, and the engine's degrade flags are flipped by
@@ -8,19 +9,53 @@ dispatch (loop) thread reads them — exactly the cross-thread shape
 that produced PR 3's poisoned-coalescer class of bug. The shared
 fields and their locks are declared in ``SHARED_STATE`` below; the
 pass then proves every *mutation* of a declared field in its class
-is lexically inside ``with self.<lock>:`` (kind ``lock``) or inside an
+is under ``with self.<lock>:`` (kind ``lock``) or inside an
 ``async def`` method (kind ``loop`` — loop-confined state must never
 be touched from a sync method, which executor threads can reach).
 
-``__init__`` is exempt: construction happens-before sharing. Reads are
-deliberately out of scope — the invariant that bit us is torn/lost
-*writes*.
+Interprocedural rules (the second-generation upgrade; each is scoped
+to what name-keyed, one-level resolution can honestly prove):
+
+- **locked-helper waiver** — a private helper mutating a field outside
+  a lexical ``with`` is clean iff EVERY intra-class call site holds
+  the declared lock and the helper is never handed to a spawn
+  primitive (``create_task``/``to_thread``/``submit``/``Thread``/
+  ``run_in_executor`` — a spawned callable runs in a new execution
+  context where the caller's lock is NOT held).
+- **helper-parameter mutation** — ``self._merge(self._sets, ...)``
+  outside the lock, where ``_merge`` mutates that parameter, is a
+  mutation of ``_sets`` the old lexical walk could not see: per-module
+  function summaries record which bare parameters each function
+  mutates, and call sites passing a declared field into a mutated
+  parameter are checked against the site's lock state.
+- **alias mutation** — ``s = self._sets`` then ``s.pop(...)`` outside
+  the lock mutates the shared dict through a local name.
+- **await-under-lock** — ``await`` while holding a declared sync lock
+  parks the coroutine WITH the lock held: every pipeline thread
+  touching that state blocks for the duration of the awaited I/O, and
+  a second coroutine acquiring the same lock deadlocks the loop.
+- **lock-order inversion** — two declared locks of one class acquired
+  in both nesting orders anywhere in the file is a two-thread
+  deadlock waiting for load.
+
+``__init__`` is exempt: construction happens-before sharing. Reads
+are deliberately out of scope — the invariant that bit us is
+torn/lost *writes*. ``LockDisciplinePass(interprocedural=False)``
+preserves the first-generation lexical-only behavior (the mutation
+self-tests assert the old pass is silent on the cross-function holes
+the new one reports).
 """
 
 import ast
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
-from tools.analysis.core import Finding, Pass, Project, SourceFile
+from tools.analysis.core import (
+    Finding,
+    Pass,
+    Project,
+    SourceFile,
+    spawn_target_names,
+)
 
 
 @dataclass(frozen=True)
@@ -98,6 +133,33 @@ def _self_attr(node: ast.AST, fields: frozenset) -> "str | None":
     return None
 
 
+def _name_mutation(node: ast.AST, names: "set[str]") -> "str | None":
+    """Local name whose REFERENT this node mutates (``x[k] = v``,
+    ``x.attr = v``, ``x.append(v)``, ``del x[k]``) — plain rebinding
+    ``x = v`` is NOT a mutation of the old referent."""
+    if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            for el in (t.elts if isinstance(t, ast.Tuple) else [t]):
+                if (isinstance(el, (ast.Subscript, ast.Attribute))
+                        and isinstance(el.value, ast.Name)
+                        and el.value.id in names):
+                    return el.value.id
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            if (isinstance(t, (ast.Subscript, ast.Attribute))
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id in names):
+                return t.value.id
+    elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        if (node.func.attr in _MUTATORS
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in names):
+            return node.func.value.id
+    return None
+
+
 def _mutated_field(node: ast.AST, fields: frozenset) -> "str | None":
     """Declared field this node mutates, if any. Only Assign/AugAssign/
     AnnAssign/Delete/Call nodes can mutate, so each mutation reports
@@ -128,22 +190,81 @@ def _mutated_field(node: ast.AST, fields: frozenset) -> "str | None":
     return None
 
 
-def _holds_lock(node: "ast.With | ast.AsyncWith", lock: str) -> bool:
+def _with_locks(node: "ast.With | ast.AsyncWith",
+                candidates: "set[str]") -> "list[str]":
+    """Declared self-lock names this with-statement acquires."""
+    out: "list[str]" = []
     for item in node.items:
         ctx = item.context_expr
         if isinstance(ctx, ast.Call):  # e.g. contextlib wrappers
             ctx = ctx.func
         if (isinstance(ctx, ast.Attribute)
                 and isinstance(ctx.value, ast.Name)
-                and ctx.value.id == "self" and ctx.attr == lock):
-            return True
-    return False
+                and ctx.value.id == "self" and ctx.attr in candidates):
+            out.append(ctx.attr)
+    return out
+
+
+def _param_mutations(index: "object") -> "dict[str, set[str]]":
+    """Per-module function summaries: function name -> names of its
+    OWN bare parameters whose referent the body mutates. The summary
+    is what makes ``self._merge(self._sets, k)`` checkable at the call
+    site: ``_merge`` mutating its first parameter means the caller is
+    mutating whatever it passed there."""
+    out: "dict[str, set[str]]" = {}
+    for info in index.functions:  # type: ignore[attr-defined]
+        fn = info.node
+        params = {a.arg for a in (*fn.args.posonlyargs, *fn.args.args,
+                                  *fn.args.kwonlyargs)} - {"self"}
+        if not params:
+            continue
+        mutated: "set[str]" = set()
+        for node in ast.walk(fn):
+            name = _name_mutation(node, params)
+            if name is not None:
+                mutated.add(name)
+        if mutated:
+            out.setdefault(info.name, set()).update(mutated)
+    return out
+
+
+def _param_names(fn: "ast.FunctionDef | ast.AsyncFunctionDef",
+                 is_method: bool) -> "list[str]":
+    names = [a.arg for a in (*fn.args.posonlyargs, *fn.args.args)]
+    if is_method and names and names[0] == "self":
+        names = names[1:]
+    return names
+
+
+@dataclass
+class _MethodFacts:
+    """Everything one walk of a method collects for the verdict phase."""
+
+    # (field, line, how) mutations with the lexical lock state at site
+    mutations: "list[tuple[str, int, bool, str]]" = field(
+        default_factory=list)
+    # helper call sites: name -> list of (line, locked)
+    calls: "dict[str, list[tuple[int, bool]]]" = field(
+        default_factory=dict)
+    # Await nodes while holding a declared lock: (line, lock)
+    awaits_locked: "list[tuple[int, str]]" = field(default_factory=list)
+    # ordered acquisitions while already holding: (outer, inner, line)
+    lock_edges: "list[tuple[str, str, int]]" = field(default_factory=list)
 
 
 class LockDisciplinePass(Pass):
     rule = "lock-discipline"
     doc = ("declared shared fields are mutated only under their "
-           "declared lock / only from the event loop")
+           "declared lock (held across helper calls too) / only from "
+           "the event loop; no await or lock-order inversion under a "
+           "declared lock")
+
+    def __init__(self, interprocedural: bool = True):
+        self.interprocedural = interprocedural
+        # Per-file module context for the call-site checks; set in
+        # run() before each file is visited.
+        self._param_muts: "dict[str, set[str]]" = {}
+        self._index: "object" = None
 
     def run(self, project: Project) -> list[Finding]:
         findings: list[Finding] = []
@@ -151,13 +272,20 @@ class LockDisciplinePass(Pass):
             sf = project.file(relpath)
             if sf is None:
                 continue
+            param_muts: "dict[str, set[str]]" = {}
+            spawned: "set[str]" = set()
+            if self.interprocedural:
+                param_muts = _param_mutations(sf.index)
+                spawned = spawn_target_names(sf.index)
+            self._param_muts = param_muts
+            self._index = sf.index
             seen = set()
             # The cached ModuleIndex already collected every ClassDef.
             for node in sf.index.classes:
                 if node.name in classes:
                     seen.add(node.name)
                     self._check_class(sf, node, classes[node.name],
-                                      findings)
+                                      param_muts, spawned, findings)
             # A declaration the tree no longer matches is a silently
             # vacuous gate (renamed class/field escapes all checks) —
             # fail loudly so the table is updated with the refactor.
@@ -170,65 +298,188 @@ class LockDisciplinePass(Pass):
         return findings
 
     def _check_class(self, sf: SourceFile, cls: ast.ClassDef, decl: Decl,
-                     findings: list) -> None:
+                     param_muts: "dict[str, set[str]]",
+                     spawned: "set[str]", findings: list) -> None:
         present = {n.attr for n in ast.walk(cls)
                    if isinstance(n, ast.Attribute)
                    and isinstance(n.value, ast.Name)
                    and n.value.id == "self"}
-        for field in sorted(decl.fields - present):
+        for fname in sorted(decl.fields - present):
             findings.append(self.finding(
                 sf.relpath, cls.lineno,
-                f"{cls.name}.{field} is declared in SHARED_STATE but "
+                f"{cls.name}.{fname} is declared in SHARED_STATE but "
                 "never referenced in the class — the lock-discipline "
                 "table is stale (renamed field escapes the gate)"))
-        for method in cls.body:
-            if not isinstance(method, (ast.FunctionDef,
-                                       ast.AsyncFunctionDef)):
-                continue
+        # Every self-lock the class's with-statements may acquire: the
+        # declared lock plus any other class's declared lock name (for
+        # order-inversion edges when one class nests two disciplines).
+        locks = {decl.lock} if decl.lock else set()
+        locks |= {d.lock for per_file in SHARED_STATE.values()
+                  for d in per_file.values() if d.lock}
+        methods = [m for m in cls.body
+                   if isinstance(m, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        facts: "dict[str, _MethodFacts]" = {}
+        for method in methods:
+            mf = _MethodFacts()
+            is_async = isinstance(method, ast.AsyncFunctionDef)
+            aliases: "dict[str, str]" = {}  # local name -> field
+            for stmt in method.body:
+                self._collect(stmt, decl, locks, [], is_async, mf,
+                              aliases)
+            facts[method.name] = mf
+
+        # Verdicts. The locked-helper waiver needs ALL call sites, so
+        # it runs after collection: an unlocked mutation in a private
+        # helper is waived iff every intra-class call site holds the
+        # lock and the helper never escapes to a spawn primitive.
+        for method in methods:
             if method.name == "__init__":
                 continue
+            mf = facts[method.name]
             is_async = isinstance(method, ast.AsyncFunctionDef)
-            for stmt in method.body:
-                self._visit(sf, cls, method, stmt, decl,
-                            locked=False, is_async=is_async,
-                            findings=findings)
+            sites = [s for other, f in facts.items() if other != "__init__"
+                     for s in f.calls.get(method.name, [])]
+            waived = (self.interprocedural and decl.kind == "lock"
+                      and method.name.startswith("_")
+                      and bool(sites)
+                      and all(locked for _, locked in sites)
+                      and method.name not in spawned)
+            for fname, line, locked, how in mf.mutations:
+                if locked:
+                    continue
+                if decl.kind == "lock":
+                    if waived and how in ("direct", "alias"):
+                        continue
+                    suffix = {
+                        "direct": "",
+                        "alias": " (mutated through a local alias)",
+                        "param": " (passed into a helper that mutates "
+                                 "its parameter)",
+                    }[how]
+                    findings.append(self.finding(
+                        sf.relpath, line,
+                        f"{cls.name}.{fname} is declared shared but "
+                        f"mutated in {method.name}() outside "
+                        f"'with self.{decl.lock}:'{suffix}"))
+                elif decl.kind == "loop" and not is_async:
+                    findings.append(self.finding(
+                        sf.relpath, line,
+                        f"{cls.name}.{fname} is declared "
+                        "event-loop-confined but mutated in sync "
+                        f"method {method.name}() (reachable from "
+                        "executor threads)"))
+            if not self.interprocedural:
+                continue
+            for line, lock in mf.awaits_locked:
+                findings.append(self.finding(
+                    sf.relpath, line,
+                    f"await while holding self.{lock} in "
+                    f"{cls.name}.{method.name}() — a sync lock held "
+                    "across a suspension point blocks every thread "
+                    "and coroutine contending for it (loop deadlock "
+                    "if another task acquires the same lock)"))
+        if self.interprocedural:
+            edges: "dict[tuple[str, str], int]" = {}
+            for mf in facts.values():
+                for outer, inner, line in mf.lock_edges:
+                    edges.setdefault((outer, inner), line)
+            for (a, b), line in sorted(edges.items()):
+                if a < b and (b, a) in edges:
+                    findings.append(self.finding(
+                        sf.relpath, max(line, edges[(b, a)]),
+                        f"lock-order inversion in {cls.name}: "
+                        f"self.{a} and self.{b} are acquired in both "
+                        f"nesting orders (lines {line} and "
+                        f"{edges[(b, a)]}) — two threads taking them "
+                        "in opposite order deadlock"))
 
-    def _visit(self, sf, cls, method, node, decl: Decl, locked: bool,
-               is_async: bool, findings: list) -> None:
-        field = _mutated_field(node, decl.fields)
-        if field is not None:
-            if decl.kind == "lock" and not locked:
-                findings.append(self.finding(
-                    sf.relpath, node.lineno,
-                    f"{cls.name}.{field} is declared shared but mutated "
-                    f"in {method.name}() outside "
-                    f"'with self.{decl.lock}:'"))
-            elif decl.kind == "loop" and not is_async:
-                findings.append(self.finding(
-                    sf.relpath, node.lineno,
-                    f"{cls.name}.{field} is declared event-loop-confined "
-                    f"but mutated in sync method {method.name}() "
-                    "(reachable from executor threads)"))
+    def _collect(self, node: ast.AST, decl: Decl, locks: "set[str]",
+                 held: "list[str]", is_async: bool,
+                 mf: _MethodFacts, aliases: "dict[str, str] | None" = None,
+                 ) -> None:
+        if aliases is None:
+            aliases = {}
+        locked = decl.lock is not None and decl.lock in held
+        fname = _mutated_field(node, decl.fields)
+        if fname is not None:
+            mf.mutations.append((fname, node.lineno, locked, "direct"))
+        if self.interprocedural:
+            # s = self._sets  (alias birth); s = anything-else kills it
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                f = _self_attr(node.value, decl.fields)
+                if f is not None:
+                    aliases[node.targets[0].id] = f
+                else:
+                    aliases.pop(node.targets[0].id, None)
+            alias = _name_mutation(node, set(aliases))
+            if alias is not None:
+                mf.mutations.append(
+                    (aliases[alias], node.lineno, locked, "alias"))
+            if isinstance(node, ast.Await):
+                for lock in held:
+                    mf.awaits_locked.append((node.lineno, lock))
+        if isinstance(node, ast.Call):
+            callee = None
+            if (isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"):
+                callee = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                callee = node.func.id
+            if callee is not None:
+                mf.calls.setdefault(callee, []).append(
+                    (node.lineno, locked))
+                if self.interprocedural:
+                    self._check_callsite(node, callee, decl, locked, mf)
         if isinstance(node, (ast.With, ast.AsyncWith)):
-            inner = locked or (decl.lock is not None
-                               and _holds_lock(node, decl.lock))
+            acquired = _with_locks(node, locks)
             for item in node.items:
-                self._visit(sf, cls, method, item.context_expr, decl,
-                            locked, is_async, findings)
+                self._collect(item.context_expr, decl, locks, held,
+                              is_async, mf, aliases)
+            for lock in acquired:
+                for outer in held:
+                    if outer != lock:
+                        mf.lock_edges.append((outer, lock, node.lineno))
+            inner = held + acquired
             for stmt in node.body:
-                self._visit(sf, cls, method, stmt, decl, inner, is_async,
-                            findings)
+                self._collect(stmt, decl, locks, inner, is_async, mf,
+                              aliases)
             return
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             # A nested def is a new execution context: the enclosing
             # lock is NOT held when it eventually runs (retry closures
             # are exactly this trap), and a nested sync def may run off
-            # the loop.
+            # the loop. Aliases don't cross either: the closure runs
+            # after the binding may have moved on.
             nested_async = isinstance(node, ast.AsyncFunctionDef)
             for stmt in node.body:
-                self._visit(sf, cls, method, stmt, decl, False,
-                            nested_async, findings)
+                self._collect(stmt, decl, locks, [], nested_async, mf, {})
             return
         for child in ast.iter_child_nodes(node):
-            self._visit(sf, cls, method, child, decl, locked, is_async,
-                        findings)
+            self._collect(child, decl, locks, held, is_async, mf, aliases)
+
+    def _check_callsite(self, call: ast.Call, callee: str, decl: Decl,
+                        locked: bool, mf: _MethodFacts) -> None:
+        """helper-parameter mutation: ``self._merge(self._sets, ...)``
+        where ``_merge`` mutates its first parameter is a mutation of
+        ``_sets`` at this site."""
+        param_muts = self._param_muts.get(callee)
+        if not param_muts:
+            return
+        fn_infos = self._index.functions_named(  # type: ignore[attr-defined]
+            callee)
+        if not fn_infos:
+            return
+        info = fn_infos[0]
+        params = _param_names(info.node, info.cls is not None)
+        for i, arg in enumerate(call.args):
+            f = _self_attr(arg, decl.fields)
+            if f is not None and i < len(params) \
+                    and params[i] in param_muts:
+                mf.mutations.append((f, call.lineno, locked, "param"))
+        for kw in call.keywords:
+            f = _self_attr(kw.value, decl.fields)
+            if f is not None and kw.arg in param_muts:
+                mf.mutations.append((f, call.lineno, locked, "param"))

@@ -62,7 +62,12 @@ _GIL_API_RE = re.compile(r"\bPy_?[A-Z]\w*")
 _COMMENT_RE = re.compile(r"/\*.*?\*/", re.S)
 
 
-def _strip_comments(text: str) -> str:
+# The two lexer helpers below are the project's shared C front end:
+# abi_conformance builds its fact tables on the same comment-stripped,
+# function-split view, so both passes agree on line numbers and on
+# what counts as a function body.
+
+def strip_comments(text: str) -> str:
     """Blank out comments preserving line structure (so line numbers
     in findings stay true)."""
     def blank(m: "re.Match[str]") -> str:
@@ -72,7 +77,7 @@ def _strip_comments(text: str) -> str:
     return re.sub(r"//[^\n]*", "", text)
 
 
-def _functions(lines: "list[str]") -> "Iterator[tuple[str, int, int]]":
+def c_functions(lines: "list[str]") -> "Iterator[tuple[str, int, int]]":
     """(name, start line idx, end line idx) for each top-level C
     function — a body is delimited by a ``{`` at column 0 and its
     matching ``}`` at column 0."""
@@ -111,14 +116,14 @@ class NativeTierPass(Pass):
             text = project.read_text(rel)
             if text is None:
                 continue
-            findings.extend(self._check_c(rel, _strip_comments(text)))
+            findings.extend(self._check_c(rel, strip_comments(text)))
         return findings
 
     def _check_c(self, rel: str, text: str) -> list[Finding]:
         findings: list[Finding] = []
         lines = text.splitlines()
         findings.extend(self._check_gil_blocks(rel, lines))
-        for name, start, end in _functions(lines):
+        for name, start, end in c_functions(lines):
             findings.extend(
                 self._check_function(rel, name, lines, start, end))
             if name.endswith("_parse_blob"):

@@ -1,18 +1,30 @@
-"""Sanitizer build target for the native extension (docs/NATIVE.md).
+"""Sanitizer build targets for the native extension (docs/NATIVE.md).
 
-Compiles ``klogs_tpu/native/_hostops.c`` with
-``-fsanitize=address,undefined -fno-sanitize-recover=all`` and runs the
-existing native parity tests against THAT binary, so a buffer slip or
-UB in the C hot loops aborts the test run instead of corrupting memory
-quietly. This is the dynamic half of the native analysis tier (the
-static half is the ``native-tier`` pass in ``tools/analysis``); the
-SIMD sweep port (ROADMAP item 2) must land green under it.
+Two modes over the same harness:
 
-Mechanics: the host ``python`` binary is NOT sanitized, so the ASan
-runtime is LD_PRELOADed (``$CC -print-file-name=...``) and leak
-detection is disabled (CPython's interned allocations look like leaks
-at exit). The sanitized .so is pinned via ``KLOGS_NATIVE_SO`` — the
-loader raises if the pin fails to load, so a sanitizer run can never
+- **ASan/UBSan** (default): compiles ``klogs_tpu/native/_hostops.c``
+  with ``-fsanitize=address,undefined -fno-sanitize-recover=all`` and
+  runs the native parity tests against THAT binary, so a buffer slip
+  or UB in the C hot loops aborts the test run instead of corrupting
+  memory quietly.
+- **TSan** (``--tsan``): rebuilds with ``-fsanitize=thread`` and runs
+  the *threaded* suites — the ``KLOGS_HOST_THREADS`` row-sliced
+  group scan and the GIL-released sweep reentrancy tests — so the
+  "disjoint verdict ranges, no races by construction" claim about the
+  pthread workers is a dynamically tested invariant, not a comment.
+
+This is the dynamic half of the native analysis tier (the static half
+is the ``native-tier`` + ``abi-conformance`` passes in
+``tools/analysis``); new kernels must land green under both modes.
+
+Mechanics: the host ``python`` binary is NOT sanitized, so the
+sanitizer runtime is LD_PRELOADed (``$CC -print-file-name=...``).
+Under ASan leak detection is disabled (CPython's interned allocations
+look like leaks at exit); under TSan ``halt_on_error=1`` turns the
+first race report into a non-zero exit. Races are reported only for
+accesses the instrumented .so makes — exactly the surface we own.
+The sanitized .so is pinned via ``KLOGS_NATIVE_SO`` — the loader
+raises if the pin fails to load, so a sanitizer run can never
 silently green-light the pure-Python fallback.
 
 Exit codes: 0 = built (and tests passed, unless --no-run-tests);
@@ -21,7 +33,8 @@ environment — printed loudly, the tier-1 wrapper turns it into a
 pytest skip); 1 = build or test failure.
 
 Usage:
-    python -m tools.build_native_asan [--no-run-tests] [--out PATH]
+    python -m tools.build_native_asan [--tsan] [--no-run-tests]
+                                      [--out PATH]
 """
 
 import argparse
@@ -34,7 +47,8 @@ import tempfile
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SRC = os.path.join(ROOT, "klogs_tpu", "native", "_hostops.c")
-SAN_FLAGS = ["-fsanitize=address,undefined", "-fno-sanitize-recover=all"]
+ASAN_FLAGS = ["-fsanitize=address,undefined", "-fno-sanitize-recover=all"]
+TSAN_FLAGS = ["-fsanitize=thread"]
 # The sweep + group-scan parity suites ride along so the GIL-released
 # kernels (unaligned loads, masked tails, hash probes over untrusted
 # offsets, the MultiDFA walk over an untrusted program blob) are
@@ -42,6 +56,19 @@ SAN_FLAGS = ["-fsanitize=address,undefined", "-fno-sanitize-recover=all"]
 # are excluded to keep the gate fast.
 TEST_FILES = ["tests/test_native.py", "tests/test_native_sweep.py",
               "tests/test_groupscan.py"]
+# TSan mode runs the tests that actually take the multi-threaded
+# paths, by node id: the row-sliced group scan drives the pthread
+# worker pool against one shared MultiDFA program, and the sweep
+# reentrancy tests overlap GIL-released kernel calls from Python
+# threads over one shared blob. (test_threaded_rows_parity is marked
+# slow for the plain gate, so node ids — not ``-m "not slow"`` — are
+# the selection here; the genuinely minutes-long speedup benches stay
+# out.)
+TSAN_TEST_IDS = [
+    "tests/test_groupscan.py::test_threaded_rows_parity",
+    "tests/test_native_sweep.py::test_packed_tables_shared_across_threads",
+    "tests/test_native_sweep.py::test_gil_released_during_sweep",
+]
 
 
 def _candidate_compilers() -> "list[str]":
@@ -52,14 +79,14 @@ def _candidate_compilers() -> "list[str]":
     return seen
 
 
-def _supports_sanitizers(cc: str) -> bool:
+def _supports_flags(cc: str, flags: "list[str]") -> bool:
     """Probe-compile an empty TU with the sanitizer flags."""
     with tempfile.TemporaryDirectory() as td:
         probe = os.path.join(td, "probe.c")
         with open(probe, "w") as f:
             f.write("int main(void) { return 0; }\n")
         res = subprocess.run(
-            [cc, *SAN_FLAGS, probe, "-o", os.path.join(td, "probe")],
+            [cc, *flags, probe, "-o", os.path.join(td, "probe")],
             capture_output=True, timeout=60)
         return res.returncode == 0
 
@@ -86,18 +113,27 @@ def _asan_runtime(cc: str) -> "str | None":
         "libclang_rt.asan.so"])
 
 
+def _tsan_runtime(cc: str) -> "str | None":
+    import platform
+
+    return _find_runtime(cc, [
+        "libtsan.so",
+        f"libclang_rt.tsan-{platform.machine()}.so",
+        "libclang_rt.tsan.so"])
+
+
 def _stdcxx_runtime(cc: str) -> "str | None":
     """libstdc++ must ride the SAME LD_PRELOAD: python itself doesn't
-    link it, so ASan's __cxa_throw interceptor would otherwise resolve
-    its real_ pointer to NULL and abort the first time any bundled C++
-    extension (jaxlib's MLIR bindings) throws."""
+    link it, so the sanitizer's __cxa_throw interceptor would
+    otherwise resolve its real_ pointer to NULL and abort the first
+    time any bundled C++ extension (jaxlib's MLIR bindings) throws."""
     return _find_runtime(cc, ["libstdc++.so.6", "libstdc++.so",
                               "libc++.so.1", "libc++.so"])
 
 
-def build(cc: str, out: str) -> bool:
+def build(cc: str, out: str, flags: "list[str]") -> bool:
     include = sysconfig.get_paths()["include"]
-    cmd = [cc, "-g", "-O1", "-fno-omit-frame-pointer", *SAN_FLAGS,
+    cmd = [cc, "-g", "-O1", "-fno-omit-frame-pointer", *flags,
            "-shared", "-fPIC", "-pthread", f"-I{include}", SRC,
            "-o", out]
     print(f"build: {' '.join(cmd)}")
@@ -108,17 +144,26 @@ def build(cc: str, out: str) -> bool:
     return True
 
 
-def run_tests(out: str, preload: str) -> int:
+def run_tests(out: str, preload: str, tsan: bool) -> int:
     env = dict(os.environ)
     env["LD_PRELOAD"] = preload
     env["KLOGS_NATIVE_SO"] = out
     env.pop("KLOGS_NO_NATIVE", None)
     env.setdefault("JAX_PLATFORMS", "cpu")
-    # CPython "leaks" its interned state at exit; halt_on_error stays
-    # on for real findings via -fno-sanitize-recover.
-    env["ASAN_OPTIONS"] = "detect_leaks=0"
-    cmd = [sys.executable, "-m", "pytest", *TEST_FILES, "-q",
-           "-m", "not slow", "-p", "no:cacheprovider"]
+    if tsan:
+        # First data-race report fails the run; second_deadlock_stack
+        # makes lock-inversion reports actionable.
+        env["TSAN_OPTIONS"] = "halt_on_error=1 second_deadlock_stack=1"
+        # The threaded tests pin their own KLOGS_HOST_THREADS via
+        # monkeypatch; nothing to set here.
+        cmd = [sys.executable, "-m", "pytest", *TSAN_TEST_IDS, "-q",
+               "-p", "no:cacheprovider"]
+    else:
+        # CPython "leaks" its interned state at exit; halt_on_error
+        # stays on for real findings via -fno-sanitize-recover.
+        env["ASAN_OPTIONS"] = "detect_leaks=0"
+        cmd = [sys.executable, "-m", "pytest", *TEST_FILES, "-q",
+               "-m", "not slow", "-p", "no:cacheprovider"]
     print(f"test: LD_PRELOAD={preload!r} "
           f"KLOGS_NATIVE_SO={out} {' '.join(cmd)}")
     return subprocess.run(cmd, cwd=ROOT, env=env, timeout=600).returncode
@@ -127,52 +172,61 @@ def run_tests(out: str, preload: str) -> int:
 def main(argv: "list[str] | None" = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.build_native_asan",
-        description="ASan/UBSan build + parity-test run for _hostops.c")
+        description="sanitizer build + parity-test run for _hostops.c "
+                    "(ASan/UBSan by default, ThreadSanitizer with "
+                    "--tsan)")
+    ap.add_argument("--tsan", action="store_true",
+                    help="build with -fsanitize=thread and run the "
+                         "threaded group-scan/sweep tests instead of "
+                         "the full parity suite")
     ap.add_argument("--out", default=None,
                     help="output .so path (default: temp dir)")
     ap.add_argument("--no-run-tests", action="store_true",
                     help="build only")
     ns = ap.parse_args(argv)
 
+    mode = "TSan" if ns.tsan else "ASan/UBSan"
+    flags = TSAN_FLAGS if ns.tsan else ASAN_FLAGS
     if not os.path.exists(SRC):
         print(f"SKIP: {SRC} not found")
         return 2
     chosen = None
     for cc in _candidate_compilers():
-        if _supports_sanitizers(cc):
+        if _supports_flags(cc, flags):
             chosen = cc
             break
     if chosen is None:
-        print("SKIP: no compiler supporting -fsanitize=address,"
-              "undefined found (tried CC/clang/gcc/cc) — the sanitizer "
-              "tier needs clang or gcc with libasan/libubsan")
+        print(f"SKIP: no compiler supporting {' '.join(flags)} found "
+              "(tried CC/clang/gcc/cc) — the sanitizer tier needs "
+              "clang or gcc with the runtime libraries")
         return 2
-    asan = _asan_runtime(chosen)
-    if asan is None:
-        print(f"SKIP: {chosen} supports the flags but no ASan runtime "
-              "library was found to LD_PRELOAD")
+    runtime = _tsan_runtime(chosen) if ns.tsan else _asan_runtime(chosen)
+    if runtime is None:
+        print(f"SKIP: {chosen} supports the flags but no {mode} "
+              "runtime library was found to LD_PRELOAD")
         return 2
     stdcxx = _stdcxx_runtime(chosen)
-    preload = f"{asan} {stdcxx}" if stdcxx else asan
+    preload = f"{runtime} {stdcxx}" if stdcxx else runtime
 
     out = ns.out
     owned_dir = None
     if out is None:
-        owned_dir = tempfile.mkdtemp(prefix="klogs-asan-")
-        out = os.path.join(owned_dir, "_hostops_asan.so")
+        owned_dir = tempfile.mkdtemp(prefix="klogs-san-")
+        suffix = "tsan" if ns.tsan else "asan"
+        out = os.path.join(owned_dir, f"_hostops_{suffix}.so")
     try:
-        if not build(chosen, out):
-            print("FAIL: sanitizer build failed")
+        if not build(chosen, out, flags):
+            print(f"FAIL: {mode} build failed")
             return 1
         print(f"built {out} with {chosen}")
         if ns.no_run_tests:
             return 0
-        rc = run_tests(out, preload)
+        rc = run_tests(out, preload, ns.tsan)
         if rc != 0:
-            print(f"FAIL: native parity tests failed under ASan/UBSan "
+            print(f"FAIL: native parity tests failed under {mode} "
                   f"(rc={rc})")
             return 1
-        print("OK: native parity tests passed under ASan/UBSan")
+        print(f"OK: native parity tests passed under {mode}")
         return 0
     finally:
         if owned_dir is not None:
