@@ -115,7 +115,7 @@ def make_patterns(k: int) -> "list[str]":
     return out[:k]
 
 
-_SIMD_NAMES = {0: "scalar", 1: "ssse3", 2: "avx2"}
+_SIMD_NAMES = {0: "scalar", 1: "ssse3", 2: "avx2", 3: "avx512"}
 
 
 def _cpu_model() -> str:
@@ -166,6 +166,15 @@ def bench_sweep_rows(filt, payload: bytes, offsets, k: int,
         "simd": None,
         "backend": None,
         "pack_lps": None,
+        # Stage-1 bucket mode and its survivor fraction (survivors /
+        # scanned positions) — native rows only; the 8-vs-16 A/B pair
+        # below quantifies the fat-Teddy cut on the same warmed index.
+        "buckets": None,
+        "survivor_ratio": None,
+        # Sweep-stage rows time the index call directly — the slab
+        # pipeline (KLOGS_SWEEP_PIPELINE) never runs here, so the
+        # stage numbers stay schedule-independent.
+        "pipeline_depth": 1,
     }
 
     def best_of(run):
@@ -191,18 +200,55 @@ def bench_sweep_rows(filt, payload: bytes, offsets, k: int,
     if (_native.hostops is not None
             and hasattr(_native.hostops, "sweep_candidates")
             and level is not None):
-        nat_lps, gm_nat = best_of(
-            lambda: filt.index.group_candidates(payload, offsets,
-                                                impl="native"))
+        from klogs_tpu.filters.compiler.index import native_sweep_buckets
+
         simd = _SIMD_NAMES.get(
             int(_native.hostops.sweep_simd_level(int(level))), "scalar")
-        parity = bool(np.array_equal(gm_ref, gm_nat))
-        rows.append(dict(base, sweep_impl="native",
-                         sweep_lps=round(nat_lps, 1),
-                         vs_numpy=round(nat_lps / numpy_lps, 2)
-                         if numpy_lps else None,
-                         parity=parity, simd=simd))
-        msg += f" native[{simd}]={nat_lps:,.0f} l/s parity={parity}"
+
+        def native_row(pin=None):
+            """One native-sweep row. ``pin`` pins KLOGS_SWEEP_BUCKETS
+            (saved/restored) so the 8-vs-16 stage-1 A/B runs on the
+            SAME warmed index — the blob cache keys by bucket count."""
+            saved = env_read("KLOGS_SWEEP_BUCKETS")
+            if pin is not None:
+                os.environ["KLOGS_SWEEP_BUCKETS"] = str(pin)
+            try:
+                buckets = native_sweep_buckets(filt.index.n_factors)
+                lps, gm = best_of(
+                    lambda: filt.index.group_candidates(
+                        payload, offsets, impl="native"))
+                st = filt.index.last_sweep_stats or {}
+                ratio = (st["survivors"] / st["positions"]
+                         if st.get("positions") else None)
+                return dict(
+                    base, sweep_impl="native",
+                    sweep_lps=round(lps, 1),
+                    vs_numpy=round(lps / numpy_lps, 2)
+                    if numpy_lps else None,
+                    parity=bool(np.array_equal(gm_ref, gm)),
+                    simd=simd, buckets=buckets,
+                    survivor_ratio=round(ratio, 5)
+                    if ratio is not None else None)
+            finally:
+                if pin is not None:
+                    if saved is None:
+                        os.environ.pop("KLOGS_SWEEP_BUCKETS", None)
+                    else:
+                        os.environ["KLOGS_SWEEP_BUCKETS"] = saved
+
+        nat = native_row()
+        rows.append(nat)
+        msg += (f" native[{simd},{nat['buckets']}b]="
+                f"{nat['sweep_lps']:,.0f} l/s parity={nat['parity']}")
+        if nat["buckets"] == 16:
+            # Fat-K corpora get the thin-kernel comparison row: same
+            # index, same corpus, 8 buckets pinned — the survivor_ratio
+            # pair is the measured fat-Teddy narrowing win.
+            thin = native_row(pin=8)
+            rows.append(thin)
+            msg += (f" native[8b]={thin['sweep_lps']:,.0f} l/s "
+                    f"survivors {thin['survivor_ratio']}"
+                    f"->{nat['survivor_ratio']}")
     else:
         msg += " native=unavailable (no toolchain or KLOGS_NATIVE_SIMD=off)"
 
@@ -342,8 +388,11 @@ def bench_k_axis(ks=None, n_lines: "int | None" = None,
             "n_lines": len(lines),
             # Which narrowing implementation the host engine actually
             # ran (native vs numpy): K rows are only comparable across
-            # machines when this matches.
+            # machines when this matches. pipeline_depth is the slab
+            # schedule the e2e row ran (1 = serial; KLOGS_SWEEP_PIPELINE
+            # auto resolves per host core count).
             "sweep_impl": filt.index.last_impl,
+            "pipeline_depth": filt._pipe_depth,
             # Per-stage seconds across the indexed measurement's
             # repeats, plus which confirm implementation ran — the
             # next PR reads where the remaining time goes.

@@ -348,6 +348,8 @@ def split_frame(payload: bytes, offsets) -> list[bytes]:
 
         return hostops.split_frame(
             payload, np.ascontiguousarray(offsets, dtype=np.int32), n)
+    if not isinstance(payload, bytes):
+        payload = bytes(payload)  # memoryview slab: slices must be bytes
     return [payload[offsets[i]:offsets[i + 1]] for i in range(n)]
 
 

@@ -208,6 +208,85 @@ def factors_from_ast(node: object) -> "list[bytes]":
 # sweep entries; the pattern stays unindexed (always-candidate).
 MAX_GUARD_FACTORS = 8
 
+# Bounded class enumeration: a run of small byte classes has a small
+# finite language ("5[12]\d " is 20 four-byte literals), and that
+# language is an OR-guard — every match contains exactly one member.
+# Without it, any class inside the literal chain breaks extraction and
+# the pattern degrades to always-candidate even when its language is
+# nearly literal. Enumerated guards may exceed MAX_GUARD_FACTORS (that
+# cap prices human-written alternations, whose branches are broad);
+# enumerated members are same-length siblings differing in one class
+# byte, so the family is as rare as its rarest member times the class
+# size — priced by _ENUM_GUARD_MAX instead.
+_ENUM_SET_MAX = 16    # widest byte class worth enumerating
+_ENUM_GUARD_MAX = 32  # total literals per enumerated family
+_ENUM_MIN_LEN = 4     # one full narrow probe window (index.NARROW)
+
+
+def _enum_lits(node: object) -> "list[bytes] | None":
+    """The node's full byte language when finite and small, else None.
+    Zero-width nodes contribute the empty string (transparent)."""
+    if isinstance(node, (Epsilon, Boundary)):
+        return [b""]
+    if isinstance(node, Sym):
+        if node.sentinel is not None:
+            return [b""]
+        if len(node.bytes_) > _ENUM_SET_MAX:
+            return None
+        return [bytes([b]) for b in sorted(node.bytes_)]
+    if isinstance(node, Cat):
+        acc = [b""]
+        for part in node.parts:
+            sub = _enum_lits(part)
+            if sub is None:
+                return None
+            acc = [a + s for a in acc for s in sub]
+            if len(acc) > _ENUM_GUARD_MAX:
+                return None
+        return acc
+    if isinstance(node, Alt):
+        out: "list[bytes]" = []
+        for part in node.parts:
+            sub = _enum_lits(part)
+            if sub is None:
+                return None
+            out.extend(sub)
+            if len(out) > _ENUM_GUARD_MAX:
+                return None
+        return out
+    return None  # Star / unknown: unbounded or nullable
+
+
+def _enum_guard(parts: "list[object]", banned: "object | None"
+                ) -> "list[bytes] | None":
+    """Best enumerated OR-guard over contiguous runs of enumerable
+    parts. A match of the Cat contains a match of parts[i:j]
+    consecutively, hence contains one member of that run's (finite)
+    language — so each run's literal set is a valid OR-guard; the
+    best-scored one wins."""
+    best: "list[bytes] | None" = None
+    best_score = 0.0
+    for i in range(len(parts)):
+        lits = [b""]
+        for part in parts[i:]:
+            sub = _enum_lits(part)
+            if sub is None:
+                break
+            nxt = [a + s for a in lits for s in sub]
+            if len(nxt) > _ENUM_GUARD_MAX:
+                break
+            lits = nxt
+            fam = [_trunc_pref(f) for f in lits]
+            if (any(len(f) < _ENUM_MIN_LEN for f in fam)
+                    or len(set(fam)) != len(fam)
+                    or (banned is not None and any(banned(f)
+                                                   for f in fam))):
+                continue
+            score = max(factor_score(f) for f in fam)
+            if best is None or score < best_score:
+                best, best_score = fam, score
+    return best
+
 
 def guard_factors(node: object,
                   banned: "object | None" = None
@@ -262,6 +341,11 @@ def guard_factors(node: object,
             score = max(factor_score(f) for f in sub)
             if best is None or score < best_score:
                 best, best_score = sub, score
+        enum = _enum_guard(list(node.parts), banned)
+        if enum is not None:
+            score = max(factor_score(f) for f in enum)
+            if best is None or score < best_score:
+                best = enum
         return best
     return None
 

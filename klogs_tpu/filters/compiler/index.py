@@ -158,19 +158,45 @@ def _code_at(f: bytes, at: int) -> int:
 # Teddy stage-1 nibble masks. Exact verification makes all three
 # implementations (numpy / native / device) produce identical masks.
 _NATIVE_MAGIC = 0x4B535750
-_NATIVE_VERSION = 1
-_TEDDY_BUCKETS = 8
+_NATIVE_VERSION = 2
 _TEDDY_M = 4
+# Fat-Teddy threshold: below this many factors the 8-bucket plane is
+# not saturated and the thin kernel (one shuffle chain) wins; at or
+# above it the blob packs a second bucket plane (16 buckets) and the
+# kernel pays one extra shuffle chain for roughly half the stage-1
+# survivors. KLOGS_SWEEP_BUCKETS=8|16 pins the mode for parity
+# fuzzing and A/B benches.
+_FAT_FACTOR_MIN = 64
+_BUCKET_CHOICES = ("auto", "8", "16")
 # KLOGS_NATIVE_SIMD: stage-1 implementation override. "auto" resolves
 # to the best CPU level at call time; "off" forces the numpy sweep
 # (the extension stays loaded for the other hot loops). "sse2" is
 # accepted as an alias for the ssse3 tier (the kernel clamps to what
-# the CPU really has, so it can only degrade to scalar, never fault).
+# the CPU really has, so it can only degrade — avx512 on a
+# non-AVX-512 box runs avx2/ssse3/scalar, never faults).
 _SIMD_CHOICES: "dict[str, int | None]" = {
-    "auto": -1, "avx2": 2, "ssse3": 1, "sse2": 1, "scalar": 0,
-    "off": None,
+    "auto": -1, "avx512": 3, "avx2": 2, "ssse3": 1, "sse2": 1,
+    "scalar": 0, "off": None,
 }
 _warned_no_native = False
+
+
+def native_sweep_buckets(n_factors: int) -> int:
+    """Resolved stage-1 bucket count (8 or 16) for an index with
+    ``n_factors`` factors: KLOGS_SWEEP_BUCKETS when pinned, else the
+    _FAT_FACTOR_MIN threshold (strict dialect — a typo'd pin silently
+    benching the wrong bucket mode would poison every A/B row)."""
+    from klogs_tpu.utils.env import read
+
+    raw = read("KLOGS_SWEEP_BUCKETS", "auto") or "auto"
+    mode = raw.strip().lower()
+    if mode not in _BUCKET_CHOICES:
+        raise ValueError(
+            f"KLOGS_SWEEP_BUCKETS={raw!r}: expected one of "
+            f"{', '.join(_BUCKET_CHOICES)}")
+    if mode == "auto":
+        return 16 if n_factors >= _FAT_FACTOR_MIN else 8
+    return int(mode)
 
 # KLOGS_NATIVE_GROUPSCAN: the batched MultiDFA group-scan stage of the
 # indexed engine (group_scan in _hostops.c). "auto" = native when the
@@ -280,7 +306,8 @@ def multidfa_blob(tables: "list[DFATables]",
 
 
 def native_simd_level() -> "int | None":
-    """Parsed KLOGS_NATIVE_SIMD: -1 auto, 0/1/2 a pinned stage-1 tier,
+    """Parsed KLOGS_NATIVE_SIMD: -1 auto, 0/1/2/3 a pinned stage-1
+    tier (scalar/ssse3/avx2/avx512),
     None = native sweep disabled. Malformed values raise naming the
     knob (strict dialect: a typo'd SIMD pin silently timing the wrong
     path would poison every benchmark row)."""
@@ -397,10 +424,21 @@ class FactorIndex:
             self.guarded[pids] = True
         self._group_of = np.asarray(plan.group_of, dtype=np.int32)
         self._sweep_prog: "Optional[SweepProgram]" = None
-        self._native_blob: "Optional[bytes]" = None
+        # Keyed by bucket count (8/16): fuzzing and A/B benches pin
+        # KLOGS_SWEEP_BUCKETS between calls on one index, so each
+        # resolved mode keeps its own immutable blob.
+        self._native_blobs: "dict[int, bytes]" = {}
         # Which implementation produced the last group_candidates mask
         # ("native" or "numpy"; the device path reports itself).
         self.last_impl = "numpy"
+        # Stage-1 survivor telemetry of the last NATIVE sweep
+        # ({"survivors", "positions"}; None before the first one),
+        # and the kernel-folded column reduction of the last native
+        # group_candidates call ((colsums, candidate_lines); None
+        # whenever the numpy oracle ran instead).
+        self.last_sweep_stats: "Optional[dict[str, int]]" = None
+        self._native_reduce: \
+            "Optional[tuple[np.ndarray, int]]" = None
 
         # Stage-1 union bloom (one gather gates everything) + per-tier
         # discrimination blooms consulted only at surviving positions.
@@ -532,7 +570,7 @@ class FactorIndex:
         out: "list[tuple[int, np.ndarray]]" = []
         if n < GRAM or (self._wide is None and self._narrow is None):
             return out
-        buf = payload + bytes(8)
+        buf = bytes(payload) + bytes(8)  # payload may be a memoryview
         buf_arr = np.frombuffer(buf, dtype=np.uint8)
         # Stage 1: one union-bloom gather + one nonzero over the whole
         # payload; everything tier-specific runs on survivors only.
@@ -619,6 +657,7 @@ class FactorIndex:
                 f"impl={impl!r}: expected native, numpy or None")
         B = len(offsets) - 1
         gm = None
+        self._native_reduce = None
         if impl != "numpy":
             gm = self._native_candidates(payload, offsets,
                                          required=impl == "native")
@@ -635,10 +674,15 @@ class FactorIndex:
         # scan ordering, AND — when some column is full, the common
         # case with an always-candidate group — the line count, which
         # would otherwise cost a second multi-MB reduction per batch.
-        colsums = gm.sum(axis=0, dtype=np.int64)
-        cand_lines = (B if B and len(colsums)
-                      and int(colsums.max()) == B
-                      else int(gm.any(axis=1).sum()) if B else 0)
+        # The native kernel already folded it into the sweep (extended
+        # stats buffer); only the numpy oracle pays the gm pass.
+        if self._native_reduce is not None:
+            colsums, cand_lines = self._native_reduce
+        else:
+            colsums = gm.sum(axis=0, dtype=np.int64)
+            cand_lines = (B if B and len(colsums)
+                          and int(colsums.max()) == B
+                          else int(gm.any(axis=1).sum()) if B else 0)
         self.last_stats = SweepStats(
             lines=B, groups=self.n_groups,
             candidate_cells=int(colsums.sum()),
@@ -646,24 +690,126 @@ class FactorIndex:
             col_cells=colsums)
         return gm
 
+    def native_ready(self) -> bool:
+        """True when the native SIMD sweep will serve the next
+        group_candidates call (cheap probe, no sweep) — callers size
+        slabs by it (filters/indexed.py NATIVE_SLAB_LINES)."""
+        from klogs_tpu.native import hostops
+
+        return (native_simd_level() is not None and hostops is not None
+                and hasattr(hostops, "sweep_candidates"))
+
+    def group_candidates_packed(self, payload: bytes,
+                                offsets: np.ndarray
+                                ) -> "np.ndarray | None":
+        """The sweep's RAW u32[B, ceil(G/32)] group bitset (bit g&31 of
+        word g>>5 = group g candidacy, always-candidate bits pre-set),
+        or None when the native kernel is unavailable — callers fall
+        back to :meth:`group_candidates`. Same ``last_stats`` /
+        ``last_impl`` bookkeeping as the bool form. The packed words
+        feed the native group_scan's packed mode zero-copy, so the
+        per-slab unpackbits (measured ~1 ms on a 64k-row slab at
+        K=1024) disappears from the fast path entirely."""
+        B = len(offsets) - 1
+        self._native_reduce = None
+        bits = self._native_packed(payload, offsets, required=False)
+        if bits is None:
+            return None
+        self.last_impl = "native"
+        colsums, cand_lines = self._native_reduce
+        self.last_stats = SweepStats(
+            lines=B, groups=self.n_groups,
+            candidate_cells=int(colsums.sum()),
+            candidate_lines=cand_lines,
+            col_cells=colsums)
+        return bits
+
     def _native_candidates(self, payload: bytes, offsets: np.ndarray,
                            required: bool = False) -> "np.ndarray | None":
-        """One native-kernel sweep, or None when the fallback should
-        run. The packed blob is built once per index and shared
-        read-only across threads (the kernel releases the GIL for the
-        whole scan)."""
-        global _warned_no_native
+        """One native-kernel sweep unpacked to [B, G] bool, or None
+        when the fallback should run."""
+        bits = self._native_packed(payload, offsets, required)
+        if bits is None:
+            return None
+        # count= keeps the unpack a single contiguous [B, G] pass and
+        # the bool view is free — no slice + astype copy per slab.
+        gm = np.unpackbits(bits.view(np.uint8), axis=1,
+                           bitorder="little", count=self.n_groups)
+        return gm.view(bool)
+
+    def sweep_packed_stateless(self, payload: bytes,
+                               offsets: np.ndarray
+                               ) -> "tuple | None":
+        """One native-kernel sweep with NO shared-state side effects:
+        returns (bits u32[B, W], colsums i64[G], cand_lines,
+        survivors, positions), or None when the kernel is unavailable.
+
+        This is the slab pipeline's prefetch stage
+        (filters/indexed.py): a worker thread may run it on slab i+1
+        while the main thread confirms slab i — the program blob is
+        immutable bytes, the stats buffer is call-local, and the
+        kernel releases the GIL for the whole scan, so the only
+        ordering rule left is that the CALLER folds results into
+        ``last_stats``/tallies in slab order (``adopt_sweep``)."""
         level = native_simd_level()
         from klogs_tpu.native import hostops
 
-        ready = (level is not None and hostops is not None
-                 and hasattr(hostops, "sweep_candidates"))
-        if not ready:
+        if (level is None or hostops is None
+                or not hasattr(hostops, "sweep_candidates")):
+            return None
+        off = np.ascontiguousarray(offsets, dtype=np.int32)
+        B = len(off) - 1
+        W = (self.n_groups + 31) // 32
+        if B <= 0:
+            return (np.zeros((0, W), dtype="<u4"),
+                    np.zeros(self.n_groups, dtype=np.int64), 0, 0, 0)
+        # Call-local stats buffer (the kernel may drop the GIL, so it
+        # must never be shared across in-flight sweeps). Extended
+        # layout u64[3 + 32*W]: [survivors, positions, candidate
+        # lines, per-bit column sums] — the kernel folds the column
+        # reduction into a ctz walk of the packed mask, replacing a
+        # measured ~4-6 ms/slab strided numpy pass at K=1024.
+        stats = np.zeros(3 + 32 * W, dtype=np.uint64)
+        raw = hostops.sweep_candidates(
+            self.native_sweep_blob(), payload, off, B, int(level),
+            stats)
+        return (np.frombuffer(raw, dtype="<u4").reshape(B, -1),
+                stats[3:3 + self.n_groups].astype(np.int64),
+                int(stats[2]), int(stats[0]), int(stats[1]))
+
+    def adopt_sweep(self, res: tuple, B: int) -> np.ndarray:
+        """Fold a ``sweep_packed_stateless`` result into the index's
+        bookkeeping (``last_stats``/``last_impl``/``last_sweep_stats``)
+        — called on the MAIN thread in slab order, so pipelined stats
+        are byte-identical to the serial schedule's. Returns the packed
+        bits."""
+        bits, colsums, cand_lines, survivors, positions = res
+        self.last_sweep_stats = {"survivors": survivors,
+                                 "positions": positions}
+        self._native_reduce = (colsums, cand_lines)
+        self.last_impl = "native"
+        self.last_stats = SweepStats(
+            lines=B, groups=self.n_groups,
+            candidate_cells=int(colsums.sum()),
+            candidate_lines=cand_lines,
+            col_cells=colsums)
+        return bits
+
+    def _native_packed(self, payload: bytes, offsets: np.ndarray,
+                       required: bool = False) -> "np.ndarray | None":
+        """One native-kernel sweep in the kernel's packed u32 form, or
+        None when the fallback should run (sets ``_native_reduce`` as
+        a side effect when it runs). The packed blob is built once per
+        index and shared read-only across threads (the kernel releases
+        the GIL for the whole scan)."""
+        global _warned_no_native
+        res = self.sweep_packed_stateless(payload, offsets)
+        if res is None:
             if required:
                 raise RuntimeError(
                     "native sweep unavailable (extension not loaded or "
                     "KLOGS_NATIVE_SIMD=off)")
-            if level is not None and not _warned_no_native:
+            if native_simd_level() is not None and not _warned_no_native:
                 # Loud, once: a fleet silently narrowing 5-10x slower
                 # than provisioned is a capacity incident, not a detail.
                 _warned_no_native = True
@@ -673,16 +819,11 @@ class FactorIndex:
                     "native SIMD sweep unavailable (no C toolchain?); "
                     "narrowing on the numpy sweep for this process")
             return None
-        off = np.ascontiguousarray(offsets, dtype=np.int32)
-        B = len(off) - 1
-        if B <= 0:
-            return np.zeros((0, self.n_groups), dtype=bool)
-        raw = hostops.sweep_candidates(
-            self.native_sweep_blob(), payload, off, B, int(level))
-        bits = np.frombuffer(raw, dtype="<u4").reshape(B, -1)
-        gm = np.unpackbits(bits.view(np.uint8), axis=1,
-                           bitorder="little")[:, :self.n_groups]
-        return gm.astype(bool)
+        bits, colsums, cand_lines, survivors, positions = res
+        self.last_sweep_stats = {"survivors": survivors,
+                                 "positions": positions}
+        self._native_reduce = (colsums, cand_lines)
+        return bits
 
     def native_sweep_blob(self) -> bytes:
         """The native kernel's table blob: the default SweepProgram's
@@ -690,32 +831,50 @@ class FactorIndex:
         (offsets into the blob; layout mirrored by the enums at the
         top of the sweep section in _hostops.c), plus the Teddy
         stage-1 nibble masks — _TEDDY_M (4) window bytes x {low, high}
-        nibble x 16 entries of 8-bucket bitmasks (128 bytes) — and the
-        64 KiB union bloom. Built once per index, cached
-        like ``_sweep_prog``; the blob is plain bytes, so it is
-        immutable and thread-shareable by construction."""
-        if self._native_blob is not None:
-            return self._native_blob
+        nibble x 16 entries of bucket bitmasks per plane (128 bytes) —
+        and the 64 KiB union bloom. Big indexes (see
+        ``native_sweep_buckets``) pack a SECOND bucket plane: 16
+        buckets split across two independent AND-chains, header words
+        SH_BUCKETS/SH_TEDDY2_OFF, version 2. Cached per resolved
+        bucket mode like ``_sweep_prog``; the blob is plain bytes, so
+        it is immutable and thread-shareable by construction."""
+        buckets = native_sweep_buckets(len(self.factors))
+        cached = self._native_blobs.get(buckets)
+        if cached is not None:
+            return cached
         prog = self.sweep_program()
         # Stage-1 tables: 4-deep Teddy nibble masks over each factor's
         # anchored window (a 3-byte factor's 4th window byte is the
         # don't-care extension -> wildcard in position 3), plus the
         # union bloom (fold16 of every probe code of both tiers) the
         # confirm consults before any hash probe.
-        teddy = np.zeros((_TEDDY_M, 2, 16), dtype=np.uint8)
+        #
+        # Bucket assignment clusters factor families: factors are
+        # ranked by their DISTINCT window bytes (sorted, so shared
+        # guard-literal prefixes from groups.py land adjacent) and the
+        # rank range is cut into equal bucket slices. Identical
+        # windows always share a bucket, and unrelated families stop
+        # diluting each other's nibble predicates — the confirm stage
+        # verifies exactly, so assignment only moves the stage-1
+        # false-positive rate, never the mask.
+        teddy = np.zeros((2, _TEDDY_M, 2, 16), dtype=np.uint8)
         bloom = np.zeros(1 << _BLOOM_BITS, dtype=np.uint8)
+        windows = [f[at:at + _TEDDY_M]
+                   for (tier, at), f in zip(self._probes, self.factors)]
+        rank = {w: i for i, w in enumerate(sorted(set(windows)))}
+        n_win = max(1, len(rank))
         for fi, f in enumerate(self.factors):
             tier, at = self._probes[fi]
-            w = f[at:at + _TEDDY_M]
-            bucket = np.uint8(
-                1 << ((w[0] ^ (w[1] * 7) ^ (w[2] * 31)) % _TEDDY_BUCKETS))
+            w = windows[fi]
+            plane, bit = divmod(rank[w] * buckets // n_win, 8)
+            bucket = np.uint8(1 << bit)
             for j in range(_TEDDY_M):
                 if j < len(w):
-                    teddy[j, 0, w[j] & 15] |= bucket
-                    teddy[j, 1, w[j] >> 4] |= bucket
+                    teddy[plane, j, 0, w[j] & 15] |= bucket
+                    teddy[plane, j, 1, w[j] >> 4] |= bucket
                 else:
-                    teddy[j, 0, :] |= bucket
-                    teddy[j, 1, :] |= bucket
+                    teddy[plane, j, 0, :] |= bucket
+                    teddy[plane, j, 1, :] |= bucket
             # Probe codes are the LITTLE-endian window codes of the
             # packed tiers (sweep_program's le_code), independent of
             # host byte order — same fold as the kernel's confirm.
@@ -728,7 +887,7 @@ class FactorIndex:
                     code = int.from_bytes(f + bytes([ext]), "little")
                     bloom[((code * _FIB) & 0xFFFFFFFF) >> 16] = 1
 
-        header = np.zeros(32, dtype=np.int32)
+        header = np.zeros(34, dtype=np.int32)
         parts: "list[bytes]" = []
         pos = len(header.tobytes())
 
@@ -750,7 +909,7 @@ class FactorIndex:
         header[3] = prog.fac_words.shape[1]
         header[4] = len(prog.always_mask)
         header[5] = prog.n_groups
-        header[6] = put(teddy.reshape(-1), "u1")
+        header[6] = put(teddy[0].reshape(-1), "u1")
         header[7] = put(bloom, "u1")
         header[8] = put(prog.always_mask, "<u4")
         header[9] = put(prog.fac_len, "<i4")
@@ -767,10 +926,15 @@ class FactorIndex:
             header[base + 6] = put(tier.bucket_start, "<i4")
             header[base + 7] = put(tier.fid, "<i4")
             header[base + 8] = put(tier.anchor, "<i4")
+        header[32] = buckets
+        # The parser REQUIRES a zero second-plane offset in 8-bucket
+        # mode (abi-conformance: no packed-but-unread words).
+        header[33] = put(teddy[1].reshape(-1), "u1") if buckets == 16 else 0
         header[31] = pos
-        self._native_blob = header.astype("<i4").tobytes() + b"".join(parts)
-        assert len(self._native_blob) == pos
-        return self._native_blob
+        blob = header.astype("<i4").tobytes() + b"".join(parts)
+        assert len(blob) == pos
+        self._native_blobs[buckets] = blob
+        return blob
 
     def pattern_candidates(self, payload: bytes,
                            offsets: np.ndarray) -> np.ndarray:

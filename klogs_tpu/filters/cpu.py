@@ -65,12 +65,20 @@ class CombinedRegexFilter(LogFilter):
         return [search(line.rstrip(b"\n")) is not None for line in lines]
 
 
+class DFAStateOverflow(ValueError):
+    """Subset construction exceeded the state budget — the pattern set
+    is DFA-compilable, just not together. Callers that can split the
+    set (the indexed engine's group builder) retry on halves; anything
+    else treats it as the generic DFAFilter failure."""
+
+
 class DFAFilter(LogFilter):
     """Determinized union automaton + native flat-table scan.
 
     Raises ValueError (or RegexSyntaxError) when the pattern set is
-    outside the compiler subset or the subset construction exceeds
-    ``max_states`` — callers fall back to CombinedRegexFilter."""
+    outside the compiler subset, or DFAStateOverflow when the subset
+    construction exceeds ``max_states`` — callers fall back to
+    CombinedRegexFilter (or bisect, see DFAStateOverflow)."""
 
     def __init__(self, patterns: list[str], ignore_case: bool = False,
                  max_states: int | None = None, cache: bool = True,
@@ -96,7 +104,7 @@ class DFAFilter(LogFilter):
                                            ignore_case=ignore_case),
                           max_states or DEFAULT_MAX_STATES)
         if t is None:
-            raise ValueError(
+            raise DFAStateOverflow(
                 f"DFA for {len(patterns)} pattern(s) exceeds "
                 f"{max_states or DEFAULT_MAX_STATES} states")
         self._t = t

@@ -44,6 +44,13 @@ GROUP_MAX_STATES = 8192
 # Lines per sweep slab: bounds the sweep's transient numpy arrays
 # (~16 bytes per payload byte) regardless of caller batch size.
 SLAB_LINES = 65536
+# The native packed path holds only the sweep's u32 bitset
+# (4*ceil(G/32) bytes per line) plus verdict bytes per slab, so it
+# affords a 4x larger slab — fewer per-slab python round-trips and
+# kernel warmups (a measured ~10% e2e win at K=1024 on the BENCH_K
+# 100k-line corpus, where 2 slabs become 1). The numpy fallback and
+# the device sweep keep the small bound above.
+NATIVE_SLAB_LINES = 262144
 # Device-sweep row-width cap: a slab holding a line longer than this
 # sweeps on the host instead (padding every row to a jumbo line's
 # width would swamp the device pass; long lines are rare in log
@@ -85,11 +92,16 @@ class _Group:
             _GROUP_REF_RE,
             CombinedRegexFilter,
             DFAFilter,
+            DFAStateOverflow,
             RegexFilter,
         )
 
         self.members = members
         self.patterns = patterns
+        # True when the DFA failed on the STATE BUDGET alone: the set
+        # is compilable, just not together — the group builder bisects
+        # those instead of degrading every member to combined-re.
+        self.split_hint = False
         try:
             self.filt: LogFilter = DFAFilter(
                 patterns, ignore_case=ignore_case,
@@ -97,6 +109,8 @@ class _Group:
                 cache_events=on_cache_event)
             self.kind = "dfa"
             return
+        except DFAStateOverflow:
+            self.split_hint = True
         except Exception:
             pass
         if any(_GROUP_REF_RE.search(p) for p in patterns):
@@ -112,6 +126,28 @@ class _Group:
         except _re.error:
             self.filt = RegexFilter(patterns, ignore_case=ignore_case)
             self.kind = "re"
+
+
+def _build_groups(members: "list[int]", patterns: "list[str]",
+                  ignore_case: bool, cache: bool,
+                  on_cache_event: Any) -> "list[_Group]":
+    """Compile one planned group, bisecting on DFA state overflow.
+
+    Half the union usually fits the budget (subset construction grows
+    superlinearly in the union automaton), and every half that does
+    rides the batched native group_scan instead of degrading the WHOLE
+    group to the per-line combined-re path — a measured ~8 us/row
+    confirm tail at K=256 (BENCH_K merge_s 0.58 s vs 0.04 s of
+    group_scan). Singletons that still overflow genuinely degrade."""
+    grp = _Group(members, [patterns[i] for i in members], ignore_case,
+                 cache, on_cache_event)
+    if grp.kind == "dfa" or not grp.split_hint or len(members) < 2:
+        return [grp]
+    mid = (len(members) + 1) // 2
+    return (_build_groups(members[:mid], patterns, ignore_case, cache,
+                          on_cache_event)
+            + _build_groups(members[mid:], patterns, ignore_case,
+                            cache, on_cache_event))
 
 
 class IndexedFilter(LogFilter):
@@ -163,15 +199,33 @@ class IndexedFilter(LogFilter):
         self.plan: GroupPlan = plan_groups(
             self.infos, max_group_patterns=max_group_patterns,
             max_group_positions=max_group_positions)
-        self.index = FactorIndex(self.infos, self.plan)
         for info in self.infos:
             self._m_clauses.observe(info.clauses)
             self._m_factors.observe(info.factors)
-        self.groups = [
-            _Group(members, [patterns[i] for i in members], ignore_case,
-                   cache, self._on_cache_event)
-            for members in self.plan.groups
-        ]
+        # Compile groups, bisecting any whose union DFA overflows the
+        # state budget (_build_groups); when a split happened, the plan
+        # is re-derived so the index's group columns stay 1:1 with the
+        # compiled groups.
+        always = set(int(g) for g in self.plan.always_groups)
+        self.groups = []
+        split_members: "list[list[int]]" = []
+        split_always: "list[int]" = []
+        for g, members in enumerate(self.plan.groups):
+            for grp in _build_groups(members, patterns, ignore_case,
+                                     cache, self._on_cache_event):
+                if g in always:
+                    split_always.append(len(split_members))
+                split_members.append(grp.members)
+                self.groups.append(grp)
+        if len(split_members) != len(self.plan.groups):
+            group_of = np.zeros(len(self.infos), dtype=np.int32)
+            for gi, members in enumerate(split_members):
+                for p in members:
+                    group_of[p] = gi
+            self.plan = GroupPlan(groups=split_members,
+                                  group_of=group_of,
+                                  always_groups=tuple(split_always))
+        self.index = FactorIndex(self.infos, self.plan)
         self._m_groups.set(len(self.groups))
         # Group partition for the confirm stage: DFA-backed groups ride
         # the batched MultiDFA native scan (one group_scan call per
@@ -235,6 +289,10 @@ class IndexedFilter(LogFilter):
         # the parity oracle, so the verdicts cannot change).
         self._sweep_path = "host"
         self._sweep_tables: Any = None
+        # Slab pipeline depth (KLOGS_SWEEP_PIPELINE): in-flight slabs
+        # per frame, 1 = the serial schedule. Parsed once per filter —
+        # the knob is deployment config, not per-batch state.
+        self._pipe_depth = _sweep_pipeline_depth()
         if sweep != "host":
             self._init_device_sweep(sweep)
 
@@ -319,28 +377,121 @@ class IndexedFilter(LogFilter):
                      offsets: np.ndarray) -> np.ndarray:
         n = len(offsets) - 1
         out = np.zeros(n, dtype=bool)
-        for lo in range(0, n, SLAB_LINES):
-            hi = min(n, lo + SLAB_LINES)
+        # Zero-copy slab views: a bytes slice would copy the whole
+        # slab (~8 MB, ~1 ms/dispatch at 100k lines); every consumer
+        # downstream (native "y*" parsers, np.frombuffer, re) takes
+        # any buffer object.
+        view = memoryview(payload)
+        slab = SLAB_LINES
+        native = (self.narrow and not self.bypassed
+                  and self._sweep_path != "device"
+                  and self.index.native_ready())
+        if native:
+            slab = NATIVE_SLAB_LINES
+        if native and self._pipe_depth >= 2 and n > slab:
+            self._match_frame_pipelined(view, offsets, out, slab)
+            return out
+        for lo in range(0, n, slab):
+            hi = min(n, lo + slab)
             base = int(offsets[lo])
             sub_off = (offsets[lo:hi + 1] - base).astype(np.int32)
-            sub_pay = payload[base:int(offsets[hi])]
+            sub_pay = view[base:int(offsets[hi])]
             out[lo:hi] = self._match_slab(sub_pay, sub_off)
         return out
 
-    def _match_slab(self, payload: bytes,
-                    offsets: np.ndarray) -> np.ndarray:
+    def _match_frame_pipelined(self, view: memoryview,
+                               offsets: np.ndarray, out: np.ndarray,
+                               slab: int) -> None:
+        """Bounded slab pipeline (KLOGS_SWEEP_PIPELINE): a small worker
+        pool sweeps slabs i+1..i+depth-1 while the main thread confirms
+        slab i. Safe because the prefetched stage is stateless
+        (FactorIndex.sweep_packed_stateless: immutable program blob,
+        call-local stats buffer, kernel drops the GIL for the whole
+        scan) and EVERY shared mutation — stats folds, adaptive
+        bypass/re-guard probes, verdict writes — stays on the main
+        thread in slab order, so verdicts and cumulative stats are
+        byte-identical to the serial schedule (the off path below is
+        the parity oracle).
+
+        An adaptive flip mid-frame (bypass, or a re-guard swapping
+        ``self.index``) invalidates in-flight prefetches — they swept
+        the OLD index's program — so the rest of the frame finishes on
+        the serial path, which re-reads the adaptive state per slab."""
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+
+        n = len(offsets) - 1
+        index = self.index
+        # Build the shared read-only blobs on the MAIN thread before a
+        # worker can race their lazy, unlocked caches.
+        index.native_sweep_blob()
+        if len(self._dfa_cols):
+            self._multidfa()
+        bounds = [(lo, min(n, lo + slab)) for lo in range(0, n, slab)]
+
+        def subframe(lo: int, hi: int):
+            base = int(offsets[lo])
+            sub_off = (offsets[lo:hi + 1] - base).astype(np.int32)
+            return view[base:int(offsets[hi])], sub_off
+
+        def guard_state():
+            return (self.bypassed, self._reguarded, id(self.index),
+                    self._sweep_path)
+
+        state = guard_state()
+        pending: "deque" = deque()
+        nxt = 0
+        with ThreadPoolExecutor(
+                max_workers=self._pipe_depth - 1) as pool:
+            for i, (lo, hi) in enumerate(bounds):
+                # Keep up to depth slabs in flight: this one (about to
+                # confirm) plus depth-1 prefetched sweeps.
+                while nxt < len(bounds) and nxt - i < self._pipe_depth:
+                    sp, so = subframe(*bounds[nxt])
+                    pending.append(pool.submit(
+                        index.sweep_packed_stateless, sp, so))
+                    nxt += 1
+                sp, so = subframe(lo, hi)
+                out[lo:hi] = self._match_slab(
+                    sp, so, prefetched=pending.popleft().result())
+                if guard_state() != state:
+                    for f in pending:
+                        f.cancel()
+                    pending.clear()
+                    for lo2, hi2 in bounds[i + 1:]:
+                        sp, so = subframe(lo2, hi2)
+                        out[lo2:hi2] = self._match_slab(sp, so)
+                    return
+
+    def _match_slab(self, payload: bytes, offsets: np.ndarray,
+                    prefetched: "tuple | None" = None) -> np.ndarray:
         B = len(offsets) - 1
         if self.narrow and not self.bypassed:
             t0 = time.perf_counter()
             path = "host"
             gm = None
+            packed = None
             with trace.TRACER.span("device.sweep", lines=B) as sp:
                 if self._sweep_path == "device":
                     gm = self._device_candidates(payload, offsets)
                     if gm is not None:
                         path = "device"
                 if gm is None:
-                    gm = self.index.group_candidates(payload, offsets)
+                    # Keep the sweep's packed bit words when the native
+                    # kernel ran: the packed group_scan consumes them
+                    # zero-copy, so neither the per-slab unpackbits nor
+                    # the bool matrix ever materializes on the fast
+                    # path. A pipelined caller hands the sweep result
+                    # in pre-computed; folding it here keeps the stats
+                    # in slab order.
+                    if prefetched is not None:
+                        packed = self.index.adopt_sweep(prefetched, B)
+                    else:
+                        packed = self.index.group_candidates_packed(
+                            payload, offsets)
+                    if packed is None:
+                        gm = self.index.group_candidates(payload,
+                                                         offsets)
                 sp.set_attr("path", path)
             G = len(self.groups)
             if path == "host":
@@ -377,7 +528,9 @@ class IndexedFilter(LogFilter):
             colsums = (self.index.last_stats.col_cells
                        if path == "host" else None)
             return self._scan_candidates(payload, offsets, gm,
-                                         colsums=colsums)
+                                         colsums=colsums,
+                                         cand_lines=cand_lines,
+                                         packed=packed)
         gm = np.ones((B, len(self.groups)), dtype=bool)
         self.swept_lines += B
         self.swept_cells += B * len(self.groups)
@@ -388,8 +541,10 @@ class IndexedFilter(LogFilter):
             colsums=np.full(len(self.groups), B, dtype=np.int64))
 
     def _scan_candidates(self, payload: bytes, offsets: np.ndarray,
-                         gm: np.ndarray,
-                         colsums: "np.ndarray | None" = None
+                         gm: "np.ndarray | None",
+                         colsums: "np.ndarray | None" = None,
+                         cand_lines: "int | None" = None,
+                         packed: "np.ndarray | None" = None
                          ) -> np.ndarray:
         """The confirm stage: run each line's candidate groups until
         one accepts. DFA-backed groups go through ONE batched native
@@ -399,7 +554,11 @@ class IndexedFilter(LogFilter):
         by construction since every (row, group) verdict is the same
         DFA table walk). The combined-re/re remainder always takes the
         per-group path, after the DFA groups so it inherits their
-        accepts as early-outs."""
+        accepts as early-outs.
+
+        ``packed`` (with ``gm=None``) is the sweep's raw u32 bitset;
+        the native group_scan reads it directly and the bool matrix is
+        only materialized if the Python fallback has to run."""
         B = len(offsets) - 1
         out = np.zeros(B, dtype=bool)
         arr = np.frombuffer(payload, dtype=np.uint8)
@@ -411,23 +570,44 @@ class IndexedFilter(LogFilter):
                                groups=len(self.groups)) as sp:
             scanned: "int | None" = None
             if self._dfa_cols and B:
-                gm = np.ascontiguousarray(gm)
+                if gm is not None:
+                    gm = np.ascontiguousarray(gm)
                 # Per-member candidate counts drive the scan order
                 # (most selective first) and the rows-in figure; the
-                # sweep's own column reduction is reused when it ran.
+                # sweep's own column reduction is reused when it ran
+                # (the engine always passes it alongside packed bits —
+                # the unpack below only serves direct test callers).
                 if colsums is None:
+                    if gm is None:
+                        gm = np.unpackbits(packed.view(np.uint8),
+                                           axis=1, bitorder="little",
+                                           count=len(self.groups)
+                                           ).view(bool)
                     colsums = gm.sum(axis=0, dtype=np.int64)
                 dsum = colsums[self._dfa_cols_arr]
+                # Lines entering confirm: the sweep's C-side count
+                # when it ran (re-reducing a multi-MB bool matrix here
+                # costs ~4ms/slab); the tiny overcount from rest-only
+                # candidate rows is irrelevant to the gauge.
                 rows_in = (B if len(dsum) and int(dsum.max()) == B
+                           else cand_lines if cand_lines is not None
                            else int(gm[:, self._dfa_cols]
                                     .any(axis=1).sum()))
-                scanned = self._groupscan_native(payload, offsets, gm,
-                                                 dsum, out)
+                scanned = self._groupscan_native(
+                    payload, offsets,
+                    gm if packed is None else packed, dsum, out,
+                    packed=packed is not None)
             if scanned is None:
+                if gm is None:
+                    gm = np.unpackbits(packed.view(np.uint8), axis=1,
+                                       bitorder="little",
+                                       count=len(self.groups)
+                                       ).view(bool)
                 scanned = 0
                 for g in self._dfa_cols:
-                    scanned += self._scan_group(g, gm, out, payload,
-                                                offsets, arr, lens)
+                    scanned += self._scan_group(g, gm[:, g], out,
+                                                payload, offsets, arr,
+                                                lens)
             else:
                 impl = "native"
             dt = time.perf_counter() - t0
@@ -443,18 +623,24 @@ class IndexedFilter(LogFilter):
             m_s.observe(dt)
         t1 = time.perf_counter()
         for g in self._rest_cols:
-            self._scan_group(g, gm, out, payload, offsets, arr, lens)
+            # Packed fast path: extract just this group's column (one
+            # shift+mask over B words) instead of unpacking the whole
+            # bitset for a handful of rest groups.
+            col = (gm[:, g] if gm is not None
+                   else ((packed[:, g >> 5] >> np.uint32(g & 31))
+                         & np.uint32(1)).astype(bool))
+            self._scan_group(g, col, out, payload, offsets, arr, lens)
         self.stage_s["merge"] += time.perf_counter() - t1
         return out
 
-    def _scan_group(self, g: int, gm: np.ndarray, out: np.ndarray,
+    def _scan_group(self, g: int, col: np.ndarray, out: np.ndarray,
                     payload: bytes, offsets: np.ndarray,
                     arr: np.ndarray, lens: np.ndarray) -> int:
-        """One group's engine over its candidate rows not yet accepted
-        (the per-group path). Returns the number of rows scanned."""
+        """One group's engine over its candidate rows (``col``, bool
+        [B]) not yet accepted (the per-group path). Returns the number
+        of rows scanned."""
         grp = self.groups[g]
         B = len(out)
-        col = gm[:, g]
         if not col.any():
             return 0
         rows = np.nonzero(col & ~out)[0]  # already-kept rows skip
@@ -504,16 +690,21 @@ class IndexedFilter(LogFilter):
         return self._mdfa_blob
 
     def _groupscan_native(self, payload: bytes, offsets: np.ndarray,
-                          gm: np.ndarray, dsum: np.ndarray,
-                          out: np.ndarray) -> "int | None":
+                          cand: np.ndarray, dsum: np.ndarray,
+                          out: np.ndarray,
+                          packed: bool = False) -> "int | None":
         """One batched group_scan call over every (row, DFA-group)
         candidate cell, writing verdicts into ``out`` in place (native
-        kernel in _hostops.c; monotonic 0->1 writes only). ``gm`` is
-        passed WHOLE — zero copies — with a stride + member-column map;
-        ``dsum`` is the per-DFA-member candidate count. Returns the
-        scanned-cell count, or None when the per-group Python loop
-        should run instead (KLOGS_NATIVE_GROUPSCAN=off, no toolchain,
-        or a previous kernel failure)."""
+        kernel in _hostops.c; monotonic 0->1 writes only). ``cand`` is
+        passed WHOLE — zero copies — with a stride + member-column
+        map: the bool [B, G] matrix, or with ``packed=True`` the
+        sweep's raw u32[B, ceil(G/32)] bitset (the kernel indexes bit
+        cols[m] instead of byte column cols[m], so the same
+        ``_dfa_cols_arr`` serves both shapes). ``dsum`` is the
+        per-DFA-member candidate count. Returns the scanned-cell
+        count, or None when the per-group Python loop should run
+        instead (KLOGS_NATIVE_GROUPSCAN=off, no toolchain, or a
+        previous kernel failure)."""
         from klogs_tpu.filters.compiler.index import (
             native_groupscan_mode,
         )
@@ -547,8 +738,9 @@ class IndexedFilter(LogFilter):
         off = np.ascontiguousarray(offsets, dtype=np.int32)
         try:
             return int(hostops.group_scan(
-                self._multidfa(), payload, off, len(off) - 1, gm,
-                gm.shape[1], self._dfa_cols_arr, order, out))
+                self._multidfa(), payload, off, len(off) - 1, cand,
+                cand.shape[1], self._dfa_cols_arr, order, out,
+                1 if packed else 0))
         except Exception as e:
             if mode == "native":
                 raise
@@ -754,6 +946,40 @@ def _env_float(name: str, default: float) -> float:
     from klogs_tpu.utils.env import nonneg_float
 
     return nonneg_float(name, default)
+
+
+def _sweep_pipeline_depth() -> int:
+    """KLOGS_SWEEP_PIPELINE -> in-flight slab count (1 = serial).
+
+    ``auto`` (the default) keeps depth 2 on multi-core hosts and the
+    serial schedule on 1-core ones: overlap needs a second core to run
+    the sweep kernel's GIL-free scan beside the confirm stage; on one
+    core the pipeline is pure thread-switch overhead. ``off`` (or 0/1)
+    pins the serial schedule — the parity oracle. An explicit integer
+    pins the depth, clamped to 4 (the win saturates at one slab of
+    prefetch because the confirm stage is main-thread-bound).
+    Malformed values raise — the strict dialect, same as the other
+    index knobs."""
+    import os
+
+    from klogs_tpu.utils.env import read
+
+    raw = read("KLOGS_SWEEP_PIPELINE", "auto")
+    val = str(raw).strip().lower()
+    if val in ("off", "0", "1"):
+        return 1
+    if val == "auto":
+        return 2 if (os.cpu_count() or 1) >= 2 else 1
+    try:
+        depth = int(val)
+    except ValueError:
+        raise ValueError(
+            f"KLOGS_SWEEP_PIPELINE={raw!r}: expected auto, off, or an "
+            "integer pipeline depth") from None
+    if depth < 0:
+        raise ValueError(
+            f"KLOGS_SWEEP_PIPELINE={raw!r}: depth must be >= 0")
+    return min(depth, 4)
 
 
 def _gather_frame(arr: np.ndarray, offsets: np.ndarray, lens: np.ndarray,

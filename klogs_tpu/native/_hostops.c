@@ -1140,22 +1140,45 @@ join_kept(PyObject *self, PyObject *args)
  * the device sweeps (the three-way parity oracle in
  * tests/test_native_sweep.py).
  *
- * Dispatch: AVX2 (32-wide) -> SSSE3 (16-wide) -> portable scalar
- * (256-entry byte LUTs), resolved at runtime from CPUID and clamped
- * by the caller's `simd` argument (KLOGS_NATIVE_SIMD, parsed in
- * Python). The whole scan — offsets validation, padded copy, stage 1,
- * confirms — runs inside Py_BEGIN_ALLOW_THREADS over borrowed
- * read-only buffers and call-local scratch: the coalescer's fetch
- * pool overlaps sweeps with packing and device fetches, and the
- * packed tables are shareable across threads (no statics touched).
+ * Stage 1 comes in two widths (v2 blobs, SH_BUCKETS): the classic
+ * 8-bucket plane, and a "fat Teddy" 16-bucket mode (the Hyperscan
+ * trick) where a SECOND nibble-mask plane (SH_TEDDY2_OFF) carries
+ * buckets 8..15 and a position survives when EITHER plane's AND-chain
+ * is nonzero — twice the bucket resolution for one extra shuffle
+ * chain, chosen at blob-build time when the factor count would
+ * otherwise saturate 8 buckets (FactorIndex.native_sweep_blob).
+ *
+ * Dispatch: AVX-512BW (64-wide) -> AVX2 (32-wide) -> SSSE3 (16-wide)
+ * -> portable scalar (256-entry byte LUTs), resolved at runtime from
+ * CPUID and clamped by the caller's `simd` argument
+ * (KLOGS_NATIVE_SIMD, parsed in Python). The whole scan — offsets
+ * validation, padded copy, stage 1, confirms — runs inside
+ * Py_BEGIN_ALLOW_THREADS over borrowed read-only buffers and
+ * call-local scratch: the indexed engine's slab pipeline and the
+ * coalescer's fetch pool overlap sweeps with group scans, packing and
+ * device fetches, and the packed tables are shareable across threads
+ * (no statics touched). The optional trailing stats buffer
+ * (u64[2] = survivors, positions) is written back only after the
+ * scan, under the GIL.
  */
 
 #define SWEEP_MAGIC 0x4B535750  /* "PWSK" little-endian */
-#define SWEEP_VERSION 1
+#define SWEEP_VERSION 2
 #define SWEEP_FIB 2654435761u
-#define SWEEP_PAD 64            /* zero tail: widest SIMD load + code/verify overreach */
+#define SWEEP_PAD 128           /* zero tail: widest SIMD load + code/verify overreach */
+/* The SIMD kernels scan the source buffer IN PLACE (no full-payload
+ * copy): positions below n - SWEEP_TAIL are proven in-bounds for
+ * every load the scan and confirm paths issue (widest block 64 + 3
+ * shifted planes, 8-byte confirm code, 27-byte verify reach), and the
+ * last SWEEP_TAIL positions re-scan from a small zero-padded stack
+ * copy with SWEEP_TAIL_LEFT bytes of left context for anchored
+ * factor verifies reaching back from a tail position. */
+#define SWEEP_TAIL 128
+#define SWEEP_TAIL_LEFT 32
 
-/* Header word indexes (i32 each; see FactorIndex.native_sweep_blob). */
+/* Header word indexes (i32 each; see FactorIndex.native_sweep_blob).
+ * v2 appends SH_BUCKETS/SH_TEDDY2_OFF after SH_TOTAL so every v1
+ * word keeps its index. */
 enum {
     SH_MAGIC = 0, SH_VERSION, SH_F, SH_NW, SH_GW, SH_G,
     SH_TEDDY_OFF, SH_BLOOM_OFF, SH_ALWAYS_OFF, SH_FACLEN_OFF,
@@ -1163,7 +1186,9 @@ enum {
     SH_NARROW = 13,             /* 9 words per tier */
     SH_WIDE = 22,
     SH_TOTAL = 31,
-    SH_WORDS = 32,
+    SH_BUCKETS = 32,            /* 8 or 16 (fat Teddy) */
+    SH_TEDDY2_OFF = 33,         /* second bucket plane; 0 when 8-bucket */
+    SH_WORDS = 34,
 };
 #define SWEEP_TEDDY_M 4         /* stage-1 window bytes (shufti AND depth) */
 #define SWEEP_BLOOM_SIZE 65536  /* union bloom: fold16 of every probe code */
@@ -1188,7 +1213,8 @@ typedef struct {
     const uint32_t *fac_wmask;  /* [F, NW] */
     const uint32_t *fac_groups; /* [F, GW] */
     const uint32_t *always;     /* [GW] */
-    const uint8_t *teddy;       /* [M][2][16] nibble bucket masks */
+    const uint8_t *teddy;       /* [M][2][16] nibble masks, buckets 0..7 */
+    const uint8_t *teddy2;      /* [M][2][16] buckets 8..15; NULL when thin */
     const uint8_t *bloom;       /* [65536] union bloom over probe codes */
 } sweep_prog_c;
 
@@ -1263,6 +1289,21 @@ sweep_parse_blob(const char *blob, Py_ssize_t blen, sweep_prog_c *sp)
         return -1;
     sp->teddy = sweep_arr(blob, blen, h[SH_TEDDY_OFF],
                           SWEEP_TEDDY_M * 32, 1);
+    /* Bucket mode: 8 packs a zero second-plane offset (rejected if
+     * nonzero — a stale packer would smuggle an unread plane); 16
+     * requires the second plane to slice cleanly out of the blob. */
+    if (h[SH_BUCKETS] == 8) {
+        if (h[SH_TEDDY2_OFF] != 0)
+            return -1;
+        sp->teddy2 = NULL;
+    } else if (h[SH_BUCKETS] == 16) {
+        sp->teddy2 = sweep_arr(blob, blen, h[SH_TEDDY2_OFF],
+                               SWEEP_TEDDY_M * 32, 1);
+        if (!sp->teddy2)
+            return -1;
+    } else {
+        return -1;
+    }
     sp->bloom = sweep_arr(blob, blen, h[SH_BLOOM_OFF],
                           SWEEP_BLOOM_SIZE, 1);
     sp->always = sweep_arr(blob, blen, h[SH_ALWAYS_OFF], sp->GW, 4);
@@ -1294,7 +1335,11 @@ sweep_parse_blob(const char *blob, Py_ssize_t blen, sweep_prog_c *sp)
                 || (i && t->bucket_start[i] < t->bucket_start[i - 1]))
                 return -1;
         for (uint32_t i = 0; i < t->NE; i++)
-            if (t->fid[i] < 0 || t->fid[i] >= sp->F || t->anchor[i] < 0)
+            if (t->fid[i] < 0 || t->fid[i] >= sp->F || t->anchor[i] < 0
+                /* anchors sit inside the factor (<= cap 24 - window),
+                 * so the verify never reaches further left than the
+                 * tail copy's SWEEP_TAIL_LEFT margin */
+                || t->anchor[i] > SWEEP_TAIL_LEFT - 8)
                 return -1;
     }
     /* fac_len 0 is the zero-factor index's padding row (never
@@ -1309,12 +1354,19 @@ sweep_parse_blob(const char *blob, Py_ssize_t blen, sweep_prog_c *sp)
  * probe -> bucket run -> masked-word factor verify -> line bounds ->
  * group bitset accumulate. Mirrors FactorIndex._emit exactly: the
  * line is the one containing the FACTOR START q (not the probe
- * window), and the factor's own bytes must sit inside it. */
+ * window), and the factor's own bytes must sit inside it.
+ *
+ * Positions are GLOBAL payload offsets; the byte at global index g
+ * lives at buf[g - bias] (bias = 0 when scanning the source buffer
+ * directly, nonzero for the zero-padded tail copy). 4-byte loads are
+ * valid while they end at or before load_end; past it, bytes are
+ * assembled one at a time with zeros beyond n — same value the old
+ * full-payload zero-padded copy produced. */
 static void
 sweep_probe_tier(const sweep_prog_c *sp, const sweep_tier_c *t,
-                 uint32_t key, const uint8_t *pad, Py_ssize_t n,
-                 const int32_t *ov, Py_ssize_t B, Py_ssize_t pos,
-                 uint32_t *out)
+                 uint32_t key, const uint8_t *buf, Py_ssize_t bias,
+                 Py_ssize_t n, Py_ssize_t load_end, const int32_t *ov,
+                 Py_ssize_t B, Py_ssize_t pos, uint32_t *out)
 {
     uint32_t h = (uint32_t)(key * SWEEP_FIB) >> (32 - t->bits);
     int32_t eid = -1;
@@ -1335,13 +1387,22 @@ sweep_probe_tier(const sweep_prog_c *sp, const sweep_tier_c *t,
         int32_t fi = t->fid[bi];
         Py_ssize_t q = pos - t->anchor[bi];
         int32_t L = sp->fac_len[fi];
-        if (q < 0 || q + L > n)
+        if (q < bias || q + L > n)
             continue;
         int32_t W = (L + 3) / 4;
         int ok = 1;
         for (int32_t w = 0; w < W; w++) {
-            if ((sweep_le32(pad + q + 4 * (Py_ssize_t)w)
-                 & sp->fac_wmask[(size_t)fi * sp->NW + w])
+            Py_ssize_t a = q + 4 * (Py_ssize_t)w;
+            uint32_t vw;
+            if (a + 4 <= load_end) {
+                vw = sweep_le32(buf + (a - bias));
+            } else {
+                uint8_t tb[4] = {0, 0, 0, 0};
+                for (int z = 0; z < 4 && a + z < n; z++)
+                    tb[z] = buf[a + z - bias];
+                vw = sweep_le32(tb);
+            }
+            if ((vw & sp->fac_wmask[(size_t)fi * sp->NW + w])
                 != sp->fac_words[(size_t)fi * sp->NW + w]) {
                 ok = 0;
                 break;
@@ -1366,8 +1427,12 @@ sweep_probe_tier(const sweep_prog_c *sp, const sweep_tier_c *t,
     }
 }
 
+/* Caller guarantees 8 readable bytes at the survivor position:
+ * main-region positions sit >= SWEEP_TAIL bytes before the payload
+ * end, tail positions read the zero-padded tail copy. */
 static void
-sweep_confirm(const sweep_prog_c *sp, const uint8_t *pad, Py_ssize_t n,
+sweep_confirm(const sweep_prog_c *sp, const uint8_t *buf,
+              Py_ssize_t bias, Py_ssize_t n, Py_ssize_t load_end,
               const int32_t *ov, Py_ssize_t B, Py_ssize_t pos,
               uint32_t *out)
 {
@@ -1377,38 +1442,92 @@ sweep_confirm(const sweep_prog_c *sp, const uint8_t *pad, Py_ssize_t n,
      * digit-dense corpora, and this one multiply + cache-resident
      * byte load rules out ~95% of its survivors before any hash
      * probe is paid. */
-    uint32_t code = sweep_le32(pad + pos);
+    const uint8_t *p = buf + (pos - bias);
+    uint32_t code = sweep_le32(p);
     if (!sp->bloom[(uint32_t)(code * SWEEP_FIB) >> 16])
         return;
     if (sp->narrow.max_probe)
-        sweep_probe_tier(sp, &sp->narrow, code, pad, n, ov, B, pos, out);
+        sweep_probe_tier(sp, &sp->narrow, code, buf, bias, n,
+                         load_end, ov, B, pos, out);
     if (sp->wide.max_probe) {
-        uint32_t lo = sweep_le32(pad + pos + 4);
+        uint32_t lo = sweep_le32(p + 4);
         sweep_probe_tier(sp, &sp->wide,
                          (uint32_t)(code * SWEEP_FIB) ^ lo,
-                         pad, n, ov, B, pos, out);
+                         buf, bias, n, load_end, ov, B, pos, out);
     }
 }
 
-/* Portable scalar stage 1: the nibble masks expanded once into three
- * 256-entry byte LUTs (cache-resident), then 3 loads + 2 ANDs per
- * position. Also the tail/readability reference for the SIMD paths. */
+/* Portable scalar stage 1: the nibble masks expanded once into
+ * 256-entry byte LUTs (cache-resident), then 4 loads + 3 ANDs per
+ * position (per bucket plane). Also the tail/readability reference
+ * for the SIMD paths: a position survives when ANY plane's AND-chain
+ * is nonzero, and every survivor bumps *nsurv (the stage-1
+ * survivor-ratio telemetry) before paying its confirm. */
 static void
 sweep_scan_scalar(const sweep_prog_c *sp, const uint8_t *pad,
-                  Py_ssize_t n, const int32_t *ov, Py_ssize_t B,
-                  uint32_t *out)
+                  Py_ssize_t scan_n, Py_ssize_t n, const int32_t *ov,
+                  Py_ssize_t B, uint32_t *out, uint64_t *nsurv)
 {
-    uint8_t lut[SWEEP_TEDDY_M][256];
+    const int fat = sp->teddy2 != NULL;
+    uint8_t lut[SWEEP_TEDDY_M][256], lut2[SWEEP_TEDDY_M][256];
     for (int j = 0; j < SWEEP_TEDDY_M; j++) {
         const uint8_t *lo = sp->teddy + j * 32;
         const uint8_t *hi = lo + 16;
         for (int c = 0; c < 256; c++)
             lut[j][c] = (uint8_t)(lo[c & 15] & hi[c >> 4]);
+        if (fat) {
+            const uint8_t *lo2 = sp->teddy2 + j * 32;
+            const uint8_t *hi2 = lo2 + 16;
+            for (int c = 0; c < 256; c++)
+                lut2[j][c] = (uint8_t)(lo2[c & 15] & hi2[c >> 4]);
+        }
     }
-    for (Py_ssize_t i = 0; i < n; i++) {
-        if (lut[0][pad[i]] & lut[1][pad[i + 1]] & lut[2][pad[i + 2]]
-            & lut[3][pad[i + 3]])
-            sweep_confirm(sp, pad, n, ov, B, i, out);
+    for (Py_ssize_t i = 0; i < scan_n; i++) {
+        unsigned v = lut[0][pad[i]] & lut[1][pad[i + 1]]
+            & lut[2][pad[i + 2]] & lut[3][pad[i + 3]];
+        if (fat)
+            v |= lut2[0][pad[i]] & lut2[1][pad[i + 1]]
+                & lut2[2][pad[i + 2]] & lut2[3][pad[i + 3]];
+        if (v) {
+            (*nsurv)++;
+            sweep_confirm(sp, pad, 0, n, n, ov, B, i, out);
+        }
+    }
+}
+
+/* Scalar sweep of the last global positions [lo, n): buf is a small
+ * stack copy of payload[bias:n] followed by SWEEP_PAD zeros, so every
+ * load the confirm path issues is in-bounds and bytes past n read 0 —
+ * bit-identical to the old full-payload zero-padded copy. At most
+ * SWEEP_TAIL positions, so the plain nibble-mask test (no LUT build)
+ * is cheapest. */
+static void
+sweep_scan_tail(const sweep_prog_c *sp, const uint8_t *buf,
+                Py_ssize_t bias, Py_ssize_t lo, Py_ssize_t n,
+                const int32_t *ov, Py_ssize_t B, uint32_t *out,
+                uint64_t *nsurv)
+{
+    const int fat = sp->teddy2 != NULL;
+    for (Py_ssize_t g = lo; g < n; g++) {
+        const uint8_t *p = buf + (g - bias);
+        unsigned v = 0xff;
+        for (int j = 0; j < SWEEP_TEDDY_M; j++) {
+            const uint8_t *m = sp->teddy + j * 32;
+            v &= m[p[j] & 15] & m[16 + (p[j] >> 4)];
+        }
+        if (fat) {
+            unsigned v2 = 0xff;
+            for (int j = 0; j < SWEEP_TEDDY_M; j++) {
+                const uint8_t *m = sp->teddy2 + j * 32;
+                v2 &= m[p[j] & 15] & m[16 + (p[j] >> 4)];
+            }
+            v |= v2;
+        }
+        if (v) {
+            (*nsurv)++;
+            sweep_confirm(sp, buf, bias, n,
+                          n + SWEEP_PAD - 8, ov, B, g, out);
+        }
     }
 }
 
@@ -1418,71 +1537,164 @@ sweep_scan_scalar(const sweep_prog_c *sp, const uint8_t *pad,
 
 __attribute__((target("ssse3"))) static void
 sweep_scan_ssse3(const sweep_prog_c *sp, const uint8_t *pad,
-                 Py_ssize_t n, const int32_t *ov, Py_ssize_t B,
-                 uint32_t *out)
+                 Py_ssize_t scan_n, Py_ssize_t n, const int32_t *ov,
+                 Py_ssize_t B, uint32_t *out, uint64_t *nsurv)
 {
     const __m128i lowm = _mm_set1_epi8(0x0f);
+    const int fat = sp->teddy2 != NULL;
     __m128i tl[SWEEP_TEDDY_M], th[SWEEP_TEDDY_M];
+    __m128i tl2[SWEEP_TEDDY_M], th2[SWEEP_TEDDY_M];
     for (int j = 0; j < SWEEP_TEDDY_M; j++) {
         tl[j] = _mm_loadu_si128((const __m128i *)(sp->teddy + j * 32));
         th[j] = _mm_loadu_si128(
             (const __m128i *)(sp->teddy + j * 32 + 16));
+        tl2[j] = th2[j] = _mm_setzero_si128();
+        if (fat) {
+            tl2[j] = _mm_loadu_si128(
+                (const __m128i *)(sp->teddy2 + j * 32));
+            th2[j] = _mm_loadu_si128(
+                (const __m128i *)(sp->teddy2 + j * 32 + 16));
+        }
     }
-    for (Py_ssize_t i = 0; i < n; i += 16) {
+    for (Py_ssize_t i = 0; i < scan_n; i += 16) {
         __m128i m = _mm_set1_epi8((char)0xff);
+        __m128i m2 = m;
         for (int j = 0; j < SWEEP_TEDDY_M; j++) {
             __m128i d = _mm_loadu_si128((const __m128i *)(pad + i + j));
-            __m128i lo = _mm_shuffle_epi8(tl[j], _mm_and_si128(d, lowm));
-            __m128i hi = _mm_shuffle_epi8(
-                th[j],
-                _mm_and_si128(_mm_srli_epi16(d, 4), lowm));
-            m = _mm_and_si128(m, _mm_and_si128(lo, hi));
+            __m128i lx = _mm_and_si128(d, lowm);
+            __m128i hx = _mm_and_si128(_mm_srli_epi16(d, 4), lowm);
+            m = _mm_and_si128(m, _mm_and_si128(
+                _mm_shuffle_epi8(tl[j], lx),
+                _mm_shuffle_epi8(th[j], hx)));
+            if (fat)
+                m2 = _mm_and_si128(m2, _mm_and_si128(
+                    _mm_shuffle_epi8(tl2[j], lx),
+                    _mm_shuffle_epi8(th2[j], hx)));
         }
+        if (fat)
+            m = _mm_or_si128(m, m2);
         int bits = _mm_movemask_epi8(
             _mm_cmpeq_epi8(m, _mm_setzero_si128())) ^ 0xffff;
         while (bits) {
             int b = __builtin_ctz((unsigned)bits);
             bits &= bits - 1;
             Py_ssize_t pos = i + b;
-            if (pos < n)
-                sweep_confirm(sp, pad, n, ov, B, pos, out);
+            if (pos < scan_n) {
+                (*nsurv)++;
+                sweep_confirm(sp, pad, 0, n, n, ov, B, pos, out);
+            }
         }
     }
 }
 
 __attribute__((target("avx2"))) static void
 sweep_scan_avx2(const sweep_prog_c *sp, const uint8_t *pad,
-                Py_ssize_t n, const int32_t *ov, Py_ssize_t B,
-                uint32_t *out)
+                Py_ssize_t scan_n, Py_ssize_t n, const int32_t *ov,
+                Py_ssize_t B, uint32_t *out, uint64_t *nsurv)
 {
     const __m256i lowm = _mm256_set1_epi8(0x0f);
+    const int fat = sp->teddy2 != NULL;
     __m256i tl[SWEEP_TEDDY_M], th[SWEEP_TEDDY_M];
+    __m256i tl2[SWEEP_TEDDY_M], th2[SWEEP_TEDDY_M];
     for (int j = 0; j < SWEEP_TEDDY_M; j++) {
         tl[j] = _mm256_broadcastsi128_si256(
             _mm_loadu_si128((const __m128i *)(sp->teddy + j * 32)));
         th[j] = _mm256_broadcastsi128_si256(
             _mm_loadu_si128((const __m128i *)(sp->teddy + j * 32 + 16)));
+        tl2[j] = th2[j] = _mm256_setzero_si256();
+        if (fat) {
+            tl2[j] = _mm256_broadcastsi128_si256(_mm_loadu_si128(
+                (const __m128i *)(sp->teddy2 + j * 32)));
+            th2[j] = _mm256_broadcastsi128_si256(_mm_loadu_si128(
+                (const __m128i *)(sp->teddy2 + j * 32 + 16)));
+        }
     }
-    for (Py_ssize_t i = 0; i < n; i += 32) {
+    for (Py_ssize_t i = 0; i < scan_n; i += 32) {
         __m256i m = _mm256_set1_epi8((char)0xff);
+        __m256i m2 = m;
         for (int j = 0; j < SWEEP_TEDDY_M; j++) {
             __m256i d = _mm256_loadu_si256(
                 (const __m256i *)(pad + i + j));
-            __m256i lo = _mm256_shuffle_epi8(tl[j],
-                                             _mm256_and_si256(d, lowm));
-            __m256i hi = _mm256_shuffle_epi8(
-                th[j],
-                _mm256_and_si256(_mm256_srli_epi16(d, 4), lowm));
-            m = _mm256_and_si256(m, _mm256_and_si256(lo, hi));
+            __m256i lx = _mm256_and_si256(d, lowm);
+            __m256i hx = _mm256_and_si256(_mm256_srli_epi16(d, 4),
+                                          lowm);
+            m = _mm256_and_si256(m, _mm256_and_si256(
+                _mm256_shuffle_epi8(tl[j], lx),
+                _mm256_shuffle_epi8(th[j], hx)));
+            if (fat)
+                m2 = _mm256_and_si256(m2, _mm256_and_si256(
+                    _mm256_shuffle_epi8(tl2[j], lx),
+                    _mm256_shuffle_epi8(th2[j], hx)));
         }
+        if (fat)
+            m = _mm256_or_si256(m, m2);
         uint32_t bits = ~(uint32_t)_mm256_movemask_epi8(
             _mm256_cmpeq_epi8(m, _mm256_setzero_si256()));
         while (bits) {
             int b = __builtin_ctz(bits);
             bits &= bits - 1;
             Py_ssize_t pos = i + b;
-            if (pos < n)
-                sweep_confirm(sp, pad, n, ov, B, pos, out);
+            if (pos < scan_n) {
+                (*nsurv)++;
+                sweep_confirm(sp, pad, 0, n, n, ov, B, pos, out);
+            }
+        }
+    }
+}
+
+/* 64 positions per iteration; the bucket planes live broadcast in
+ * zmm registers and the survivor bitmap falls straight out of
+ * _mm512_test_epi8_mask — no compare-against-zero + movemask pair. */
+__attribute__((target("avx512f,avx512bw"))) static void
+sweep_scan_avx512(const sweep_prog_c *sp, const uint8_t *pad,
+                  Py_ssize_t scan_n, Py_ssize_t n, const int32_t *ov,
+                  Py_ssize_t B, uint32_t *out, uint64_t *nsurv)
+{
+    const __m512i lowm = _mm512_set1_epi8(0x0f);
+    const int fat = sp->teddy2 != NULL;
+    __m512i tl[SWEEP_TEDDY_M], th[SWEEP_TEDDY_M];
+    __m512i tl2[SWEEP_TEDDY_M], th2[SWEEP_TEDDY_M];
+    for (int j = 0; j < SWEEP_TEDDY_M; j++) {
+        tl[j] = _mm512_broadcast_i32x4(
+            _mm_loadu_si128((const __m128i *)(sp->teddy + j * 32)));
+        th[j] = _mm512_broadcast_i32x4(
+            _mm_loadu_si128((const __m128i *)(sp->teddy + j * 32 + 16)));
+        tl2[j] = th2[j] = _mm512_setzero_si512();
+        if (fat) {
+            tl2[j] = _mm512_broadcast_i32x4(_mm_loadu_si128(
+                (const __m128i *)(sp->teddy2 + j * 32)));
+            th2[j] = _mm512_broadcast_i32x4(_mm_loadu_si128(
+                (const __m128i *)(sp->teddy2 + j * 32 + 16)));
+        }
+    }
+    for (Py_ssize_t i = 0; i < scan_n; i += 64) {
+        __m512i m = _mm512_set1_epi8((char)0xff);
+        __m512i m2 = m;
+        for (int j = 0; j < SWEEP_TEDDY_M; j++) {
+            __m512i d = _mm512_loadu_si512(
+                (const void *)(pad + i + j));
+            __m512i lx = _mm512_and_si512(d, lowm);
+            __m512i hx = _mm512_and_si512(_mm512_srli_epi16(d, 4),
+                                          lowm);
+            m = _mm512_and_si512(m, _mm512_and_si512(
+                _mm512_shuffle_epi8(tl[j], lx),
+                _mm512_shuffle_epi8(th[j], hx)));
+            if (fat)
+                m2 = _mm512_and_si512(m2, _mm512_and_si512(
+                    _mm512_shuffle_epi8(tl2[j], lx),
+                    _mm512_shuffle_epi8(th2[j], hx)));
+        }
+        uint64_t bits = (uint64_t)_mm512_test_epi8_mask(m, m);
+        if (fat)
+            bits |= (uint64_t)_mm512_test_epi8_mask(m2, m2);
+        while (bits) {
+            int b = __builtin_ctzll(bits);
+            bits &= bits - 1;
+            Py_ssize_t pos = i + b;
+            if (pos < scan_n) {
+                (*nsurv)++;
+                sweep_confirm(sp, pad, 0, n, n, ov, B, pos, out);
+            }
         }
     }
 }
@@ -1490,6 +1702,9 @@ sweep_scan_avx2(const sweep_prog_c *sp, const uint8_t *pad,
 static int
 sweep_cpu_level(void)
 {
+    if (__builtin_cpu_supports("avx512f")
+        && __builtin_cpu_supports("avx512bw"))
+        return 3;
     if (__builtin_cpu_supports("avx2"))
         return 2;
     if (__builtin_cpu_supports("ssse3"))
@@ -1504,9 +1719,10 @@ sweep_cpu_level(void)
 }
 #endif
 
-/* requested: -1 auto, 0 scalar, 1 ssse3, 2 avx2 — clamped to what the
- * CPU actually has, so a pinned KLOGS_NATIVE_SIMD=avx2 on an old box
- * degrades to the best real level instead of faulting. */
+/* requested: -1 auto, 0 scalar, 1 ssse3, 2 avx2, 3 avx512 — clamped
+ * to what the CPU actually has, so a pinned KLOGS_NATIVE_SIMD=avx512
+ * on an old box degrades to the best real level instead of
+ * faulting. */
 static int
 sweep_resolve_level(int requested)
 {
@@ -1528,18 +1744,22 @@ sweep_simd_level(PyObject *self, PyObject *args)
 static PyObject *
 sweep_candidates(PyObject *self, PyObject *args)
 {
-    Py_buffer blob, payload, offs;
+    Py_buffer blob, payload, offs, stats;
     Py_ssize_t B;
     int requested;
-    if (!PyArg_ParseTuple(args, "y*y*y*ni", &blob, &payload, &offs, &B,
-                          &requested))
+    stats.obj = NULL;
+    stats.buf = NULL;
+    if (!PyArg_ParseTuple(args, "y*y*y*ni|w*", &blob, &payload, &offs,
+                          &B, &requested, &stats))
         return NULL;
     sweep_prog_c sp;
     if (B < 0 || offs.len < (B + 1) * 4
+        || (stats.obj && stats.len < 16)
         || sweep_parse_blob((const char *)blob.buf, blob.len, &sp) < 0) {
         PyBuffer_Release(&blob);
         PyBuffer_Release(&payload);
         PyBuffer_Release(&offs);
+        PyBuffer_Release(&stats);
         PyErr_SetString(PyExc_ValueError,
                         "sweep_candidates: malformed tables or sizes");
         return NULL;
@@ -1547,18 +1767,17 @@ sweep_candidates(PyObject *self, PyObject *args)
     const Py_ssize_t n = payload.len;
     PyObject *mask = PyBytes_FromStringAndSize(
         NULL, B * (Py_ssize_t)sp.GW * 4);
-    uint8_t *pad = PyMem_Malloc((size_t)n + SWEEP_PAD);
-    if (!mask || !pad) {
+    if (!mask) {
         PyBuffer_Release(&blob);
         PyBuffer_Release(&payload);
         PyBuffer_Release(&offs);
-        Py_XDECREF(mask);
-        PyMem_Free(pad);
+        PyBuffer_Release(&stats);
         return PyErr_NoMemory();
     }
     const int32_t *ov = (const int32_t *)offs.buf;
     uint32_t *out = (uint32_t *)PyBytes_AS_STRING(mask);
     int level = sweep_resolve_level(requested);
+    uint64_t nsurv = 0;
     int bad = 0;
 
     Py_BEGIN_ALLOW_THREADS
@@ -1570,34 +1789,90 @@ sweep_candidates(PyObject *self, PyObject *args)
         if (ov[i] > ov[i + 1])
             bad = 1;
     if (!bad) {
-        if (n)
-            memcpy(pad, payload.buf, n);
-        memset(pad + n, 0, SWEEP_PAD);
         /* Every row starts as the always-candidate mask (groups owning
          * unguarded patterns), exactly like the host sweep. */
         for (Py_ssize_t i = 0; i < B; i++)
             memcpy(out + (size_t)i * sp.GW, sp.always,
                    (size_t)sp.GW * 4);
         if (n >= 3) {
+            /* In-place scan of the source buffer up to scan_n (every
+             * load proven in-bounds there — see SWEEP_TAIL), then the
+             * last positions from a small zero-padded stack copy.
+             * Replaces a full-payload copy that cost ~1 ms per 8 MB
+             * slab in malloc page faults + memcpy. */
+            const uint8_t *src = (const uint8_t *)payload.buf;
+            Py_ssize_t scan_n = n > SWEEP_TAIL ? n - SWEEP_TAIL : 0;
+            if (scan_n) {
 #if SWEEP_HAVE_X86
-            if (level >= 2)
-                sweep_scan_avx2(&sp, pad, n, ov, B, out);
-            else if (level == 1)
-                sweep_scan_ssse3(&sp, pad, n, ov, B, out);
-            else
-                sweep_scan_scalar(&sp, pad, n, ov, B, out);
+                if (level >= 3)
+                    sweep_scan_avx512(&sp, src, scan_n, n, ov, B, out,
+                                      &nsurv);
+                else if (level == 2)
+                    sweep_scan_avx2(&sp, src, scan_n, n, ov, B, out,
+                                    &nsurv);
+                else if (level == 1)
+                    sweep_scan_ssse3(&sp, src, scan_n, n, ov, B, out,
+                                     &nsurv);
+                else
+                    sweep_scan_scalar(&sp, src, scan_n, n, ov, B, out,
+                                      &nsurv);
 #else
-            (void)level;
-            sweep_scan_scalar(&sp, pad, n, ov, B, out);
+                (void)level;
+                sweep_scan_scalar(&sp, src, scan_n, n, ov, B, out,
+                                  &nsurv);
 #endif
+            }
+            uint8_t tbuf[SWEEP_TAIL_LEFT + SWEEP_TAIL + SWEEP_PAD];
+            Py_ssize_t tbase = scan_n > SWEEP_TAIL_LEFT
+                ? scan_n - SWEEP_TAIL_LEFT : 0;
+            memcpy(tbuf, src + tbase, (size_t)(n - tbase));
+            memset(tbuf + (n - tbase), 0, SWEEP_PAD);
+            sweep_scan_tail(&sp, tbuf, tbase, scan_n, n, ov, B, out,
+                            &nsurv);
         }
     }
     Py_END_ALLOW_THREADS
 
-    PyMem_Free(pad);
+    if (!bad && stats.obj) {
+        /* u64[2] = [stage-1 survivors, scanned byte positions]: the
+         * survivor-ratio telemetry BENCH_SWEEP reports. Written under
+         * the GIL, after the scan — the caller owns the buffer and
+         * must not share it across in-flight sweeps. */
+        uint64_t sb[2];
+        sb[0] = nsurv;
+        sb[1] = (uint64_t)n;
+        memcpy(stats.buf, sb, sizeof sb);
+        const size_t nbits = (size_t)sp.GW * 32;
+        if ((size_t)stats.len >= (3 + nbits) * 8) {
+            /* Extended layout u64[3 + 32*GW]: [2] = lines with any
+             * candidate bit, [3+g] = per-group candidate column sums.
+             * A ctz walk over the packed mask costs ~total-set-bits;
+             * the equivalent numpy axis-0 reduction over the unpacked
+             * [B, G] matrix measured ~4-6 ms/slab at K=1024. */
+            uint64_t *sbx = (uint64_t *)stats.buf;
+            uint64_t lines = 0;
+            uint64_t *colsum = sbx + 3;
+            memset(colsum, 0, nbits * 8);
+            for (Py_ssize_t i = 0; i < B; i++) {
+                const uint32_t *row = out + (size_t)i * sp.GW;
+                uint32_t any = 0;
+                for (int32_t w = 0; w < sp.GW; w++) {
+                    uint32_t v = row[w];
+                    any |= v;
+                    while (v) {
+                        colsum[w * 32 + __builtin_ctz(v)]++;
+                        v &= v - 1;
+                    }
+                }
+                lines += any != 0;
+            }
+            sbx[2] = lines;
+        }
+    }
     PyBuffer_Release(&blob);
     PyBuffer_Release(&payload);
     PyBuffer_Release(&offs);
+    PyBuffer_Release(&stats);
     if (bad) {
         Py_DECREF(mask);
         PyErr_SetString(PyExc_ValueError,
@@ -1610,7 +1885,7 @@ sweep_candidates(PyObject *self, PyObject *args)
 /* ================= MultiDFA batched group scan =======================
  *
  * group_scan(blob, payload, offsets, n_lines, cand, stride, cols,
- *            order, out) -> scanned candidate cells (int)
+ *            order, out, packed=0) -> scanned candidate cells (int)
  *
  * The "confirm" stage of the indexed engine done in one native call
  * (Hyperscan-FDR shape; filters/indexed.py): instead of a Python loop
@@ -1629,6 +1904,12 @@ sweep_candidates(PyObject *self, PyObject *args)
  *            ruled the cell out). `stride` + `cols` let the engine
  *            pass its FULL [B, n_groups] bool group matrix with zero
  *            copies: member m's candidate column is cand[., cols[m]].
+ *            With packed=1 cand is instead the sweep kernel's RAW
+ *            u32[n_lines, stride] group bitset (member m's candidacy
+ *            is bit cols[m]&31 of word cols[m]>>5) — no host-side
+ *            unpackbits at all; a single ctz walk over the masked
+ *            words builds every member's candidate row list up front
+ *            instead of re-reading all rows once per member.
  *   order:   i32[M] scan order over members (the engine passes
  *            ascending candidate count: most selective first, so
  *            always-candidate groups run last and inherit every
@@ -1771,7 +2052,9 @@ typedef struct {
     const uint8_t *src;
     Py_ssize_t src_len;
     const int32_t *ov;          /* [B+1] framed offsets */
-    const uint8_t *cand;        /* [B, stride] candidate bytes */
+    const uint8_t *cand;        /* [B, stride] candidate bytes, or in
+                                 * packed mode [B, stride] u32 words
+                                 * (bit col&31 of word col>>5) */
     Py_ssize_t stride;
     const int32_t *cols;        /* [M] member -> cand column */
     const int32_t *order;       /* [n_ord] member scan order — the
@@ -1780,7 +2063,11 @@ typedef struct {
     uint8_t *out;               /* [B] verdict bytes (monotonic 0->1) */
     long long scanned;          /* candidate cells actually scanned */
     Py_ssize_t lo, hi;          /* row range for this worker */
-    int bad;                    /* 1 = offsets, 2 = table state id */
+    int bad;                    /* 1 offsets, 2 state id, 4 memory */
+    int packed;                 /* cand holds u32 bit words */
+    const int32_t *bit2slot;    /* [stride*32] packed col -> order
+                                 * slot, -1 for unlisted columns */
+    const uint32_t *colmask;    /* [stride] OR of listed column bits */
 } gs_job;
 
 /* One (row, member) cell: dfa_scan's scalar loop with an in-loop
@@ -1807,9 +2094,13 @@ gs_scan_cell(const mdfa_member *d, const uint8_t *row, Py_ssize_t len,
              * start -> start: jump straight there. */
             const uint8_t *q = memchr(p, d->esc[0], (size_t)(pe - p));
             if (d->esc_n == 2) {
+                /* Only the region BEFORE the first hit can move the
+                 * jump target earlier — searching past it rescans
+                 * bytes the first memchr already cleared. */
                 const uint8_t *q2 = memchr(p, d->esc[1],
-                                           (size_t)(pe - p));
-                if (!q || (q2 && q2 < q))
+                                           q ? (size_t)(q - p)
+                                             : (size_t)(pe - p));
+                if (q2)
                     q = q2;
             }
             if (!q)
@@ -1835,10 +2126,112 @@ gs_scan_cell(const mdfa_member *d, const uint8_t *row, Py_ssize_t len,
     return d->accept[s];
 }
 
+/* Scan one member over the candidate rows listed in rl[0..rn).
+ * Rows already accepted by an earlier member are skipped here (NOT
+ * counted as scanned), so the early-out semantics match the original
+ * row-major walk cell for cell regardless of how the list was built. */
+static void
+gs_scan_member(gs_job *job, int32_t g, const int32_t *rl, int32_t rn)
+{
+    const uint8_t *src = job->src;
+    const int32_t *ov = job->ov;
+    const mdfa_member *d = &job->mem[g];
+    if (d->esc_n < 0 && !d->match_all && !d->wide
+        && !d->accept[d->start]) {
+        /* No start-state acceleration possible (broad escape set):
+         * interleave DFA_LANES candidate rows so the dependent
+         * state->table->state load chains overlap — the same trick
+         * as dfa_scan_rows, gathered over this member's candidate
+         * rows. */
+        const uint32_t nc = (uint32_t)d->n_classes;
+        const uint32_t nd = (uint32_t)d->n_dfa;
+        Py_ssize_t idx[DFA_LANES];
+        const uint8_t *p[DFA_LANES], *pe[DFA_LANES];
+        uint32_t s[DFA_LANES];
+        int nl = 0;
+        for (int32_t t = 0; t <= rn; t++) {
+            if (t < rn) {
+                Py_ssize_t i = rl[t];
+                if (job->out[i])
+                    continue;
+                job->scanned++;
+                int32_t rlo = ov[i];
+                Py_ssize_t len = ov[i + 1] - rlo;
+                while (len > 0 && src[rlo + len - 1] == '\n')
+                    len--;
+                idx[nl] = i;
+                p[nl] = src + rlo;
+                pe[nl] = p[nl] + len;
+                s[nl] = (uint32_t)d->start;
+                nl++;
+                if (nl < DFA_LANES)
+                    continue;
+            }
+            unsigned active = 0;
+            for (int l = 0; l < nl; l++)
+                if (p[l] < pe[l])
+                    active |= 1u << l;
+                else
+                    s[l] = UINT32_MAX;  /* empty: end step below */
+            while (active) {
+                for (int l = 0; l < nl; l++) {
+                    if (!(active & (1u << l)))
+                        continue;
+                    uint32_t nxt = d->tab16[s[l] * nc
+                                   + (uint32_t)d->bc[*p[l]]];
+                    p[l]++;
+                    if (nxt >= nd) {
+                        job->bad = 2;
+                        return;
+                    }
+                    if (d->accept[nxt]) {
+                        job->out[idx[l]] = 1;
+                        active &= ~(1u << l);
+                    } else if (p[l] == pe[l]) {
+                        s[l] = nxt;
+                        active &= ~(1u << l);
+                    } else {
+                        s[l] = nxt;
+                    }
+                }
+            }
+            for (int l = 0; l < nl; l++) {
+                if (job->out[idx[l]])
+                    continue;
+                uint32_t sf = s[l] == UINT32_MAX
+                    ? (uint32_t)d->start : s[l];
+                sf = d->tab16[sf * nc + (uint32_t)d->end_class];
+                if (sf >= nd) {
+                    job->bad = 2;
+                    return;
+                }
+                if (d->accept[sf])
+                    job->out[idx[l]] = 1;
+            }
+            nl = 0;
+        }
+        return;
+    }
+    for (int32_t t = 0; t < rn; t++) {
+        Py_ssize_t i = rl[t];
+        if (job->out[i])
+            continue;
+        job->scanned++;
+        int32_t rlo = ov[i];
+        Py_ssize_t len = ov[i + 1] - rlo;
+        while (len > 0 && src[rlo + len - 1] == '\n')
+            len--;
+        if (d->match_all
+            || gs_scan_cell(d, src + rlo, len, &job->bad))
+            job->out[i] = 1;
+        if (job->bad)
+            return;
+    }
+}
+
 static void
 group_scan_rows(gs_job *job)
 {
-    const uint8_t *src = job->src;
     const int32_t *ov = job->ov;
     /* Validate this slice's offsets ONCE; the per-member passes below
      * then trust them. */
@@ -1851,102 +2244,90 @@ group_scan_rows(gs_job *job)
     /* Group-major: one member's tables stay cache-hot across its
      * whole row run; early-out semantics match the row-major walk
      * cell for cell (header comment). */
-    for (int32_t k = 0; k < job->n_ord; k++) {
-        const int32_t g = job->order[k];
-        const mdfa_member *d = &job->mem[g];
-        const int32_t col = job->cols[g];
-        if (d->esc_n < 0 && !d->match_all && !d->wide
-            && !d->accept[d->start]) {
-            /* No start-state acceleration possible (broad escape
-             * set): interleave DFA_LANES candidate rows so the
-             * dependent state->table->state load chains overlap —
-             * the same trick as dfa_scan_rows, gathered over this
-             * member's candidate rows. */
-            const uint32_t nc = (uint32_t)d->n_classes;
-            const uint32_t nd = (uint32_t)d->n_dfa;
-            Py_ssize_t idx[DFA_LANES];
-            const uint8_t *p[DFA_LANES], *pe[DFA_LANES];
-            uint32_t s[DFA_LANES];
-            int nl = 0;
-            for (Py_ssize_t i = job->lo; i <= job->hi; i++) {
-                if (i < job->hi) {
-                    if (job->out[i]
-                        || !job->cand[(size_t)i * job->stride + col])
-                        continue;
-                    job->scanned++;
-                    int32_t rlo = ov[i];
-                    Py_ssize_t len = ov[i + 1] - rlo;
-                    while (len > 0 && src[rlo + len - 1] == '\n')
-                        len--;
-                    idx[nl] = i;
-                    p[nl] = src + rlo;
-                    pe[nl] = p[nl] + len;
-                    s[nl] = (uint32_t)d->start;
-                    nl++;
-                    if (nl < DFA_LANES)
-                        continue;
+    if (job->packed) {
+        /* One ctz walk over the sweep's packed bit matrix builds every
+         * member's candidate row list at once. The byte-matrix shape
+         * below re-reads all B rows once PER member (n_ord * B loads —
+         * ~2 ms on a 64k-row slab at K=1k with only a handful of live
+         * members); here the listed-column mask prunes dead bits in
+         * bulk and each set bit costs one counted-sort insert. */
+        const uint32_t *cw = (const uint32_t *)job->cand;
+        const Py_ssize_t GW = job->stride;
+        int32_t *cnt = calloc((size_t)job->n_ord + 1, sizeof(int32_t));
+        if (!cnt) {
+            job->bad = 4;
+            return;
+        }
+        int64_t total = 0;
+        for (Py_ssize_t i = job->lo; i < job->hi; i++) {
+            const uint32_t *row = cw + (size_t)i * GW;
+            for (Py_ssize_t w = 0; w < GW; w++) {
+                uint32_t v = row[w] & job->colmask[w];
+                while (v) {
+                    int b = __builtin_ctz(v);
+                    v &= v - 1;
+                    cnt[job->bit2slot[w * 32 + b]]++;
+                    total++;
                 }
-                unsigned active = 0;
-                for (int l = 0; l < nl; l++)
-                    if (p[l] < pe[l])
-                        active |= 1u << l;
-                    else
-                        s[l] = UINT32_MAX;  /* empty: end step below */
-                while (active) {
-                    for (int l = 0; l < nl; l++) {
-                        if (!(active & (1u << l)))
-                            continue;
-                        uint32_t nxt = d->tab16[s[l] * nc
-                                       + (uint32_t)d->bc[*p[l]]];
-                        p[l]++;
-                        if (nxt >= nd) {
-                            job->bad = 2;
-                            return;
-                        }
-                        if (d->accept[nxt]) {
-                            job->out[idx[l]] = 1;
-                            active &= ~(1u << l);
-                        } else if (p[l] == pe[l]) {
-                            s[l] = nxt;
-                            active &= ~(1u << l);
-                        } else {
-                            s[l] = nxt;
-                        }
-                    }
-                }
-                for (int l = 0; l < nl; l++) {
-                    if (job->out[idx[l]])
-                        continue;
-                    uint32_t sf = s[l] == UINT32_MAX
-                        ? (uint32_t)d->start : s[l];
-                    sf = d->tab16[sf * nc + (uint32_t)d->end_class];
-                    if (sf >= nd) {
-                        job->bad = 2;
-                        return;
-                    }
-                    if (d->accept[sf])
-                        job->out[idx[l]] = 1;
-                }
-                nl = 0;
             }
-            continue;
+        }
+        int32_t *start = malloc(((size_t)job->n_ord + 1)
+                                * sizeof(int32_t));
+        int32_t *fill = malloc(((size_t)job->n_ord + 1)
+                               * sizeof(int32_t));
+        int32_t *lists = malloc(total ? (size_t)total * sizeof(int32_t)
+                                      : sizeof(int32_t));
+        if (!start || !fill || !lists) {
+            free(cnt);
+            free(start);
+            free(fill);
+            free(lists);
+            job->bad = 4;
+            return;
+        }
+        int32_t acc = 0;
+        for (int32_t k = 0; k < job->n_ord; k++) {
+            start[k] = fill[k] = acc;
+            acc += cnt[k];
         }
         for (Py_ssize_t i = job->lo; i < job->hi; i++) {
-            if (job->out[i]
-                || !job->cand[(size_t)i * job->stride + col])
-                continue;
-            job->scanned++;
-            int32_t rlo = ov[i];
-            Py_ssize_t len = ov[i + 1] - rlo;
-            while (len > 0 && src[rlo + len - 1] == '\n')
-                len--;
-            if (d->match_all
-                || gs_scan_cell(d, src + rlo, len, &job->bad))
-                job->out[i] = 1;
-            if (job->bad)
-                return;
+            const uint32_t *row = cw + (size_t)i * GW;
+            for (Py_ssize_t w = 0; w < GW; w++) {
+                uint32_t v = row[w] & job->colmask[w];
+                while (v) {
+                    int b = __builtin_ctz(v);
+                    v &= v - 1;
+                    lists[fill[job->bit2slot[w * 32 + b]]++] =
+                        (int32_t)i;
+                }
+            }
         }
+        for (int32_t k = 0; k < job->n_ord && !job->bad; k++)
+            gs_scan_member(job, job->order[k], lists + start[k],
+                           cnt[k]);
+        free(cnt);
+        free(start);
+        free(fill);
+        free(lists);
+        return;
     }
+    Py_ssize_t nrows = job->hi - job->lo;
+    int32_t *tmp = malloc(nrows ? (size_t)nrows * sizeof(int32_t)
+                                : sizeof(int32_t));
+    if (!tmp) {
+        job->bad = 4;
+        return;
+    }
+    for (int32_t k = 0; k < job->n_ord && !job->bad; k++) {
+        const int32_t g = job->order[k];
+        const int32_t col = job->cols[g];
+        int32_t rn = 0;
+        for (Py_ssize_t i = job->lo; i < job->hi; i++)
+            if (job->cand[(size_t)i * job->stride + col])
+                tmp[rn++] = (int32_t)i;
+        gs_scan_member(job, g, tmp, rn);
+    }
+    free(tmp);
 }
 
 static void *
@@ -1967,33 +2348,69 @@ group_scan(PyObject *self, PyObject *args)
 {
     Py_buffer blob, payload, offs, cand, cols, order, outb;
     Py_ssize_t B, stride;
-    if (!PyArg_ParseTuple(args, "y*y*y*ny*ny*y*w*", &blob, &payload,
+    int packed = 0;
+    if (!PyArg_ParseTuple(args, "y*y*y*ny*ny*y*w*|i", &blob, &payload,
                           &offs, &B, &cand, &stride, &cols, &order,
-                          &outb))
+                          &outb, &packed))
         return NULL;
     int32_t M = 0;
     mdfa_member *mem = NULL;
+    int32_t *bit2slot = NULL;
+    uint32_t *colmask = NULL;
     int ok = (B >= 0 && stride >= 1 && offs.len >= (B + 1) * 4
               && mdfa_parse_blob((const char *)blob.buf, blob.len,
                                  &M, &mem) == 0);
     /* order may name FEWER members than the program holds — the
      * caller omits members it knows have zero candidate rows. */
     const int32_t n_ord = (int32_t)(order.len / 4);
-    if (ok && (cand.len < (int64_t)B * stride
+    /* Packed mode: cand is the sweep's u32[B, stride] group bitset
+     * (bit col&31 of word col>>5 = that column's candidacy), consumed
+     * zero-copy; byte mode keeps the original [B, stride] matrix. */
+    if (ok && (cand.len < (int64_t)B * stride * (packed ? 4 : 1)
                || cols.len < (Py_ssize_t)M * 4
                || n_ord > M || outb.len < B))
         ok = 0;
     if (ok) {
         const int32_t *colv = (const int32_t *)cols.buf;
         const int32_t *ordv = (const int32_t *)order.buf;
+        const Py_ssize_t ncol = packed ? stride * 32 : stride;
         for (int32_t k = 0; k < M; k++)
-            if (colv[k] < 0 || colv[k] >= stride)
+            if (colv[k] < 0 || colv[k] >= ncol)
                 ok = 0;
         for (int32_t k = 0; k < n_ord; k++)
             if (ordv[k] < 0 || ordv[k] >= M)
                 ok = 0;
+        if (ok && packed) {
+            bit2slot = PyMem_Malloc((size_t)stride * 32
+                                    * sizeof(int32_t));
+            colmask = PyMem_Calloc((size_t)stride, sizeof(uint32_t));
+            if (!bit2slot || !colmask) {
+                PyMem_Free(mem);
+                PyMem_Free(bit2slot);
+                PyMem_Free(colmask);
+                PyBuffer_Release(&blob);
+                PyBuffer_Release(&payload);
+                PyBuffer_Release(&offs);
+                PyBuffer_Release(&cand);
+                PyBuffer_Release(&cols);
+                PyBuffer_Release(&order);
+                PyBuffer_Release(&outb);
+                return PyErr_NoMemory();
+            }
+            memset(bit2slot, 0xff, (size_t)stride * 32
+                                   * sizeof(int32_t));
+            for (int32_t k = 0; k < n_ord; k++) {
+                int32_t c = colv[ordv[k]];
+                if (bit2slot[c] != -1)
+                    ok = 0;  /* duplicate column: lists would split */
+                bit2slot[c] = k;
+                colmask[c >> 5] |= 1u << (c & 31);
+            }
+        }
     }
     if (!ok) {
+        PyMem_Free(bit2slot);
+        PyMem_Free(colmask);
         PyMem_Free(mem);
         PyBuffer_Release(&blob);
         PyBuffer_Release(&payload);
@@ -2024,7 +2441,11 @@ group_scan(PyObject *self, PyObject *args)
             uint32_t cnt = 0;
             for (int e = 0; e < mem[m].esc_n; e++)
                 cnt += hist[mem[m].esc[e]];
-            if (hn && (size_t)cnt * 32 > hn)
+            /* Break-even measured on the BENCH_K corpus: memchr +
+             * range-limited second probe beats the interleaved walk
+             * up to ~1/8 escape density; only truly saturated escape
+             * bytes (an 'e'-every-few-bytes corpus) still demote. */
+            if (hn && (size_t)cnt * 8 > hn)
                 mem[m].esc_n = -1;
         }
     }
@@ -2033,7 +2454,7 @@ group_scan(PyObject *self, PyObject *args)
                   (const uint8_t *)cand.buf, stride,
                   (const int32_t *)cols.buf,
                   (const int32_t *)order.buf, (uint8_t *)outb.buf,
-                  0, 0, B, 0};
+                  0, 0, B, 0, packed, bit2slot, colmask};
     int nthreads = host_threads();
     long long scanned = 0;
     int bad = 0;
@@ -2060,6 +2481,8 @@ group_scan(PyObject *self, PyObject *args)
             bad |= jobs[t].bad;
         }
     }
+    PyMem_Free(bit2slot);
+    PyMem_Free(colmask);
     PyMem_Free(mem);
     PyBuffer_Release(&blob);
     PyBuffer_Release(&payload);
@@ -2069,6 +2492,8 @@ group_scan(PyObject *self, PyObject *args)
     PyBuffer_Release(&order);
     PyBuffer_Release(&outb);
     if (bad) {
+        if (bad & 4)
+            return PyErr_NoMemory();
         PyErr_SetString(PyExc_ValueError,
                         bad & 2 ? "group_scan: table state id out of range"
                                 : "group_scan: offsets out of range");
@@ -2104,14 +2529,18 @@ static PyMethodDef Methods[] = {
     {"join_kept_framed", join_kept_framed, METH_VARARGS,
      "join_kept_framed(payload, offsets, n, mask) -> bytes"},
     {"sweep_candidates", sweep_candidates, METH_VARARGS,
-     "sweep_candidates(blob, payload, offsets, n_lines, simd)"
-     " -> u32[n_lines, GW] group-bitset bytes"},
+     "sweep_candidates(blob, payload, offsets, n_lines, simd,"
+     " stats=None) -> u32[n_lines, GW] group-bitset bytes; stats is an"
+     " optional writable u64[2] receiving [survivors, positions], or"
+     " u64[3 + 32*GW] to also receive [candidate lines, per-group"
+     " column sums]"},
     {"sweep_simd_level", sweep_simd_level, METH_VARARGS,
      "sweep_simd_level(requested=-1) -> resolved SIMD level"
-     " (0 scalar, 1 ssse3, 2 avx2)"},
+     " (0 scalar, 1 ssse3, 2 avx2, 3 avx512)"},
     {"group_scan", group_scan, METH_VARARGS,
      "group_scan(blob, payload, offsets, n_lines, cand, stride, cols,"
-     " order, out) -> scanned candidate cells (out updated in place)"},
+     " order, out, packed=0) -> scanned candidate cells (out updated"
+     " in place); packed=1 reads cand as the sweep's u32 bit words"},
     {NULL, NULL, 0, NULL},
 };
 

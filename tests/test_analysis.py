@@ -1608,15 +1608,19 @@ def test_async_blocking_lambda_and_class_body_in_async(tmp_path):
 # A minimal packer/parser pair stating the SAME contracts as the real
 # tree (token names from the abi-conformance contract table), clean at
 # baseline; each mutation test perturbs exactly one contract fact and
-# asserts exactly one finding.
+# asserts exactly one finding. The pair mirrors the real v2 fat-Teddy
+# header shape: version 2, with the bucket-mode word (SH_BUCKETS) and
+# second-plane offset (SH_TEDDY2_OFF) appended after SH_TOTAL, each
+# validated by its own parser statement so a mutation hits one word.
 _ABI_C = """\
 #include <stdint.h>
 
 #define SWEEP_MAGIC 0x4B535750
-#define SWEEP_VERSION 1
+#define SWEEP_VERSION 2
 
 enum { SH_MAGIC = 0, SH_VERSION, SH_F,
-       SH_NARROW = 3, SH_WIDE = 5, SH_TOTAL = 7, SH_WORDS = 8 };
+       SH_NARROW = 3, SH_WIDE = 5, SH_TOTAL = 7,
+       SH_BUCKETS = 8, SH_TEDDY2_OFF = 9, SH_WORDS = 10 };
 enum { ST_H = 0, ST_E };
 
 #define MDFA_MAGIC 0x4B4D4446
@@ -1639,6 +1643,10 @@ sweep_parse_blob(const char *blob, int blen)
         || h[SH_TOTAL] != blen)
         return 0;
     if (h[SH_F] < 0)
+        return 0;
+    if (h[SH_BUCKETS] != 8 && h[SH_BUCKETS] != 16)
+        return 0;
+    if (h[SH_TEDDY2_OFF] < 0)
         return 0;
     return sweep_parse_tier((const int32_t *)blob + SH_NARROW)
          + sweep_parse_tier((const int32_t *)blob + SH_WIDE);
@@ -1665,7 +1673,7 @@ _ABI_PY = """\
 import numpy as np
 
 _NATIVE_MAGIC = 0x4B535750
-_NATIVE_VERSION = 1
+_NATIVE_VERSION = 2
 _MDFA_MAGIC = 0x4B4D4446
 _MDFA_VERSION = 1
 _MDFA_HEADER_WORDS = 4
@@ -1673,9 +1681,9 @@ _MDFA_DESC_WORDS = 3
 
 
 def native_sweep_blob(prog):
-    header = np.zeros(8, dtype=np.int32)
+    header = np.zeros(10, dtype=np.int32)
     parts = []
-    pos = 32
+    pos = 40
 
     def put(arr, dt):
         nonlocal pos
@@ -1691,6 +1699,9 @@ def native_sweep_blob(prog):
     for base, tier in ((3, prog.narrow), (5, prog.wide)):
         header[base + 0] = len(tier.keys)
         header[base + 1] = put(tier.keys, "<u4")
+    header[8] = prog.buckets
+    if prog.buckets == 16:
+        header[9] = put(prog.teddy2, "u1")
     header[7] = pos
     return header.astype("<i4").tobytes() + b"".join(parts)
 
@@ -1765,17 +1776,57 @@ def test_abi_conformance_version_drift(tmp_path):
 
 def test_abi_conformance_header_word_count_drift_py(tmp_path):
     root = _abi_tree(tmp_path, py_subst=(
-        "np.zeros(8, dtype=np.int32)", "np.zeros(9, dtype=np.int32)"))
+        "np.zeros(10, dtype=np.int32)", "np.zeros(11, dtype=np.int32)"))
     found = _active(root, "abi-conformance")
     assert len(found) == 1, [f.message for f in found]
     assert "header word count disagrees" in found[0].message
 
 
 def test_abi_conformance_header_word_count_drift_c(tmp_path):
-    root = _abi_tree(tmp_path, c_subst=("SH_WORDS = 8", "SH_WORDS = 9"))
+    root = _abi_tree(tmp_path, c_subst=("SH_WORDS = 10",
+                                        "SH_WORDS = 11"))
     found = _active(root, "abi-conformance")
     assert len(found) == 1, [f.message for f in found]
     assert "header word count disagrees" in found[0].message
+
+
+def test_abi_conformance_sweep_version_drift(tmp_path):
+    """The fat-Teddy bump class itself: one side still at v1 while the
+    other packs/parses v2 — exactly one version finding."""
+    root = _abi_tree(tmp_path, py_subst=(
+        "_NATIVE_VERSION = 2", "_NATIVE_VERSION = 1"))
+    found = _active(root, "abi-conformance")
+    assert len(found) == 1, [f.message for f in found]
+    assert "version disagrees" in found[0].message
+    assert "SWEEP_VERSION=2" in found[0].message
+
+
+def test_abi_conformance_bucket_word_unvalidated(tmp_path):
+    """Parser drops the bucket-mode validation (the v1->v2 hazard: a
+    v1-era parser ignoring the new word would scan the thin plane of a
+    fat blob) -> one finding at the packed SH_BUCKETS word."""
+    root = _abi_tree(tmp_path, c_subst=(
+        "    if (h[SH_BUCKETS] != 8 && h[SH_BUCKETS] != 16)\n"
+        "        return 0;\n", ""))
+    found = _active(root, "abi-conformance")
+    assert len(found) == 1, [f.message for f in found]
+    assert "packed but never read" in found[0].message
+    assert "header word 8" in found[0].message
+    assert found[0].path == "klogs_tpu/filters/compiler/index.py"
+
+
+def test_abi_conformance_teddy2_word_unpacked(tmp_path):
+    """Packer stops writing the second-plane offset the parser bounds-
+    checks -> the parser trusts uninitialized bytes; one finding at the
+    parse fn."""
+    root = _abi_tree(tmp_path, py_subst=(
+        "    if prog.buckets == 16:\n"
+        "        header[9] = put(prog.teddy2, \"u1\")\n", ""))
+    found = _active(root, "abi-conformance")
+    assert len(found) == 1, [f.message for f in found]
+    assert "never packed" in found[0].message
+    assert "header word 9" in found[0].message
+    assert found[0].path == "klogs_tpu/native/_hostops.c"
 
 
 def test_abi_conformance_descriptor_stride_drift(tmp_path):

@@ -87,7 +87,7 @@ def test_bench_k_axis_contract(tmp_path):
                     "narrowing_ratio", "auto_engine", "n_groups",
                     "speedup_vs_scan_all", "sweep_s", "group_scan_s",
                     "merge_s", "group_scan_impl", "parity",
-                    "banned_factors"):
+                    "banned_factors", "pipeline_depth"):
             assert key in row, key
         assert 0.0 <= row["narrowing_ratio"] <= 1.0
         assert row["indexed_lps"] > 0 and row["scan_all_lps"] > 0
@@ -100,6 +100,12 @@ def test_bench_k_axis_contract(tmp_path):
         assert row["group_scan_impl"] in ("native", "python")
         assert row["sweep_s"] >= 0 and row["group_scan_s"] >= 0
         assert row["merge_s"] >= 0
+        # Regression contract for the confirm tail (PR 17): the
+        # combined-re remainder must never dominate the pipeline —
+        # state-budget overflows bisect into DFA-backed groups instead
+        # of degrading wholesale (the K=256 merge_s was 15x the
+        # group_scan before).
+        assert row["merge_s"] <= row["sweep_s"] + row["group_scan_s"]
         assert row["banned_factors"] >= 0
     # Same verdicts from both configurations is asserted inside the
     # sweep itself; above the auto threshold the indexed engine is
@@ -116,6 +122,12 @@ def test_bench_k_axis_contract(tmp_path):
         assert row["sweep_lps"] > 0
         assert row["parity"] is True
         assert row["cpu_model"]
+        # PR 17 columns: stage-1 bucket mode + survivor fraction
+        # (native rows), and the slab schedule — sweep-stage rows are
+        # always timed serially so they stay schedule-independent.
+        for key in ("buckets", "survivor_ratio", "pipeline_depth"):
+            assert key in row, key
+        assert row["pipeline_depth"] == 1
     assert [r["k"] for r in by_impl["numpy"]] == [8, 64]
     # jax is importable in this environment, so device rows exist.
     assert [r["k"] for r in by_impl["device"]] == [8, 64]
@@ -125,10 +137,19 @@ def test_bench_k_axis_contract(tmp_path):
 
     if _native.hostops is not None and hasattr(_native.hostops,
                                                "sweep_candidates"):
-        assert [r["k"] for r in by_impl["native"]] == [8, 64]
-        for row in by_impl["native"]:
-            assert row["simd"] in ("scalar", "ssse3", "avx2")
+        # Fat Ks append an extra 8-bucket-pinned A/B row on the same
+        # warmed index, so dedupe on K; every fat row must have its
+        # thin twin.
+        nat = by_impl["native"]
+        assert sorted({r["k"] for r in nat}) == [8, 64]
+        for row in nat:
+            assert row["simd"] in ("scalar", "ssse3", "avx2", "avx512")
             assert row["vs_numpy"] > 0
+            assert row["buckets"] in (8, 16)
+            assert row["survivor_ratio"] is None \
+                or 0.0 <= row["survivor_ratio"] <= 1.0
+        for k in {r["k"] for r in nat if r["buckets"] == 16}:
+            assert any(r["k"] == k and r["buckets"] == 8 for r in nat)
     assert rec["rows"][0]["sweep_impl"] in ("native", "numpy")
 
 

@@ -385,7 +385,7 @@ def test_whole_slab_fast_path_restricts_to_undecided(monkeypatch):
     monkeypatch.setattr(grp.filt, "dispatch_framed", spy)
     arr = np.frombuffer(payload, dtype=np.uint8)
     lens = np.diff(offsets)
-    f._scan_group(g, gm, out, payload, offsets, arr, lens)
+    f._scan_group(g, gm[:, g], out, payload, offsets, arr, lens)
     assert calls["n"] == 2  # only the undecided rows were dispatched
 
 

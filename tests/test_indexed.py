@@ -415,16 +415,18 @@ def test_indexed_warm_start_skips_recompile(tmp_path, monkeypatch):
     f1 = IndexedFilter(pats, registry=r1)
     ev1 = cache_events(r1)
     # A miss is an ATTEMPT: every group tries the DFA engine first;
-    # the ones that overflow the state budget degrade (no table
-    # written) and re-attempt on every build — only successful
-    # determinizations are cached, so warm misses = non-DFA groups.
+    # the ones that overflow the state budget are bisected (each
+    # overflowing parent is one extra attempt) and a singleton that
+    # still overflows degrades — only successful determinizations are
+    # cached, so a warm build repays every attempt except the n_dfa
+    # cache hits.
     n_dfa = f1.engine_kinds.get("dfa", 0)
     n_attempts = len(f1.groups)
-    assert n_dfa >= 1 and ev1["miss"] == n_attempts and ev1["hit"] == 0
+    assert n_dfa >= 1 and ev1["miss"] >= n_attempts and ev1["hit"] == 0
     r2 = Registry()
     f2 = IndexedFilter(pats, registry=r2)
     ev2 = cache_events(r2)
-    assert ev2["miss"] == n_attempts - n_dfa and ev2["hit"] == n_dfa
+    assert ev2["miss"] == ev1["miss"] - n_dfa and ev2["hit"] == n_dfa
     lines = [b"needle-0031 fired", b"noise"]
     assert f1.match_lines(lines) == f2.match_lines(lines) == [True, False]
 
@@ -453,17 +455,21 @@ def test_k4096_grouped_compile_and_warm_start(tmp_path, monkeypatch):
     fam = r1.family("klogs_prefilter_table_cache_events_total")
     n_dfa = f1.engine_kinds.get("dfa", 0)
     n_attempts = len(f1.groups)
+    cold_misses = fam.labels(event="miss").value
     assert n_dfa >= 64
-    assert fam.labels(event="miss").value == n_attempts
+    # Attempts >= final groups: each group costs one, plus one per
+    # overflowing parent the bisection walked through.
+    assert cold_misses >= n_attempts
     r2 = Registry()
     t0 = time.perf_counter()
     IndexedFilter(pats, registry=r2)
     warm_s = time.perf_counter() - t0
     fam2 = r2.family("klogs_prefilter_table_cache_events_total")
-    # Every determinized table loads from the cache; only the groups
-    # that can never cache (state-budget overflow) re-attempt.
+    # Every determinized table loads from the cache; only the attempts
+    # that can never cache (state-budget overflows, degraded
+    # singletons) re-run.
     assert fam2.labels(event="hit").value == n_dfa
-    assert fam2.labels(event="miss").value == n_attempts - n_dfa
+    assert fam2.labels(event="miss").value == cold_misses - n_dfa
     assert warm_s < cold_s, (warm_s, cold_s)
 
 

@@ -120,14 +120,18 @@ def test_parity_empty_payload():
     assert not native_m.any()
 
 
-@pytest.mark.parametrize("level", ["scalar", "ssse3", "avx2", "sse2"])
-def test_parity_every_simd_tier(level, monkeypatch):
-    """Each stage-1 tier (scalar LUT, SSSE3 shufti, AVX2 shufti; sse2
-    aliases the ssse3 tier) produces the identical mask. On CPUs
+@pytest.mark.parametrize("buckets", ["8", "16"])
+@pytest.mark.parametrize("level", ["scalar", "ssse3", "avx2", "sse2",
+                                   "avx512"])
+def test_parity_every_simd_tier(level, buckets, monkeypatch):
+    """Each stage-1 tier (scalar LUT, SSSE3/AVX2/AVX-512 shufti; sse2
+    aliases the ssse3 tier) produces the identical mask in BOTH bucket
+    modes (8-bucket thin plane and 16-bucket fat Teddy). On CPUs
     without the requested feature the kernel clamps down, so this is
     parity coverage for whatever actually runs, never a fault."""
     require_native()
     monkeypatch.setenv("KLOGS_NATIVE_SIMD", level)
+    monkeypatch.setenv("KLOGS_SWEEP_BUCKETS", buckets)
     idx = _index(["ERR!", "panic: out of memory", "x!z",
                   "uid=000123456789"], max_group_patterns=2)
     lines = [b"an ERR! line", b"panic: out of memory", b"ax!zb",
@@ -139,11 +143,53 @@ def test_parity_every_simd_tier(level, monkeypatch):
 def test_simd_level_resolution():
     require_native()
     auto = native.hostops.sweep_simd_level(-1)
-    assert auto in (0, 1, 2)
+    assert auto in (0, 1, 2, 3)
     # A pinned level never resolves above what the CPU has.
-    for req in (0, 1, 2):
+    for req in (0, 1, 2, 3):
         assert native.hostops.sweep_simd_level(req) <= max(req, 0)
         assert native.hostops.sweep_simd_level(req) <= auto
+
+
+def test_fat_teddy_blob_and_survivor_stats(monkeypatch):
+    """The bucket knob switches the packed header (word 32; the second
+    plane offset in word 33 only in 16-bucket mode), both modes agree
+    with the numpy oracle, and the fat plane never passes MORE stage-1
+    survivors than the thin one on the same corpus (that is its whole
+    point; equality is legal when 8 buckets are not saturated)."""
+    require_native()
+    import bench
+
+    idx = _index(bench.make_patterns(256))
+    lines = [ln.rstrip(b"\n") for ln in bench.make_lines(4000)]
+    payload, offsets = _frame(lines)
+    expect = idx.group_candidates(payload, offsets, impl="numpy")
+    survivors = {}
+    for buckets in ("8", "16"):
+        monkeypatch.setenv("KLOGS_SWEEP_BUCKETS", buckets)
+        blob = idx.native_sweep_blob()
+        header = np.frombuffer(blob[:34 * 4], dtype="<i4")
+        assert header[1] == 2          # SWEEP_VERSION
+        assert header[32] == int(buckets)
+        assert (header[33] > 0) == (buckets == "16")
+        got = idx.group_candidates(payload, offsets, impl="native")
+        assert np.array_equal(expect, got)
+        stats = idx.last_sweep_stats
+        assert stats is not None
+        assert 0 < stats["survivors"] <= stats["positions"]
+        assert stats["positions"] == len(payload)
+        survivors[buckets] = stats["survivors"]
+    assert survivors["16"] <= survivors["8"]
+
+
+def test_sweep_buckets_env_validation(monkeypatch):
+    from klogs_tpu.filters.compiler.index import native_sweep_buckets
+
+    monkeypatch.setenv("KLOGS_SWEEP_BUCKETS", "32")
+    with pytest.raises(ValueError, match="KLOGS_SWEEP_BUCKETS"):
+        native_sweep_buckets(100)
+    monkeypatch.setenv("KLOGS_SWEEP_BUCKETS", "auto")
+    assert native_sweep_buckets(4) == 8
+    assert native_sweep_buckets(1000) == 16
 
 
 def test_fuzz_seeded_subset():
@@ -259,6 +305,13 @@ def test_malformed_blob_rejected():
         bytes(blob[:4]) + b"\x63" + bytes(blob[5:]),  # bad version
         bytes(blob[:-8]),                # arrays cut short
         bytes(h1_tier),                  # shift-by-32 tier
+        # Bucket mode must be 8 or 16 (word 32 = SH_BUCKETS) ...
+        bytes(blob[:32 * 4]) + (5).to_bytes(4, "little")
+        + bytes(blob[33 * 4:]),
+        # ... and an 8-bucket blob smuggling a nonzero second-plane
+        # offset (word 33 = SH_TEDDY2_OFF) is a stale packer.
+        bytes(blob[:33 * 4]) + (64).to_bytes(4, "little")
+        + bytes(blob[34 * 4:]),
     ):
         with pytest.raises(ValueError):
             native.hostops.sweep_candidates(
@@ -389,3 +442,82 @@ def test_gil_overlap_speedup():
     # Each thread does the same work as one serial pass: a held GIL
     # serializes them (~2x serial), real overlap approaches ~1x.
     assert parallel < 1.5 * serial, (serial, parallel)
+
+
+# -- slab pipeline (KLOGS_SWEEP_PIPELINE) ------------------------------
+
+
+def _pipeline_corpus(n_lines=6000):
+    import bench
+
+    pats = bench.make_patterns(64)
+    lines = [ln.rstrip(b"\n") for ln in bench.make_lines(n_lines)]
+    return pats, lines
+
+
+def _pipeline_filter(monkeypatch, depth, slab=1024):
+    """An IndexedFilter whose frames span several slabs (shrunken slab
+    bounds) with the pipeline knob pinned to ``depth``."""
+    from klogs_tpu.filters import indexed as mod
+
+    monkeypatch.setattr(mod, "SLAB_LINES", slab)
+    monkeypatch.setattr(mod, "NATIVE_SLAB_LINES", slab)
+    monkeypatch.setenv("KLOGS_SWEEP_PIPELINE", depth)
+    pats, lines = _pipeline_corpus()
+    return mod.IndexedFilter(pats), lines
+
+
+def test_sweep_pipeline_knob_strict(monkeypatch):
+    from klogs_tpu.filters.indexed import _sweep_pipeline_depth
+
+    for raw, want in (("off", 1), ("0", 1), ("1", 1), ("2", 2),
+                      ("3", 3), ("9", 4), (" AUTO ", None)):
+        monkeypatch.setenv("KLOGS_SWEEP_PIPELINE", raw)
+        got = _sweep_pipeline_depth()
+        if want is None:  # auto: serial on 1 core, depth 2 otherwise
+            assert got == (2 if (os.cpu_count() or 1) >= 2 else 1)
+        else:
+            assert got == want, (raw, got)
+    for raw in ("junk", "2.5", "-1"):
+        monkeypatch.setenv("KLOGS_SWEEP_PIPELINE", raw)
+        with pytest.raises(ValueError, match="KLOGS_SWEEP_PIPELINE"):
+            _sweep_pipeline_depth()
+
+
+def test_sweep_pipeline_parity(monkeypatch):
+    """Pipelined verdicts AND cumulative stats must be byte-identical
+    to the serial schedule (the parity oracle): the prefetch stage is
+    stateless and every fold happens on the main thread in slab order.
+    Also the TSan gate's pipeline-overlap exercise — worker threads
+    sweep slab i+1 inside the native kernel while the main thread
+    confirms slab i through the batched group_scan."""
+    require_native()
+    f_ser, lines = _pipeline_filter(monkeypatch, "off")
+    want = f_ser.match_lines(lines)
+    for depth in ("2", "3"):
+        f_pipe, _ = _pipeline_filter(monkeypatch, depth)
+        assert f_pipe._pipe_depth == int(depth)
+        got = f_pipe.match_lines(lines)
+        assert got == want
+        assert f_pipe.swept_lines == f_ser.swept_lines
+        assert f_pipe.swept_cells == f_ser.swept_cells
+        assert f_pipe.candidate_cells == f_ser.candidate_cells
+        assert f_pipe.candidate_lines == f_ser.candidate_lines
+
+
+def test_sweep_pipeline_invalidation_on_adaptive_flip(monkeypatch):
+    """An adaptive flip mid-frame (bypass here; re-guard swaps
+    self.index the same way) must invalidate in-flight prefetches —
+    they swept the OLD program — and finish the frame on the serial
+    path. Thresholds are shrunk so the bypass probation ends after the
+    first slab; verdicts cannot change (scan-all is a superset)."""
+    require_native()
+    monkeypatch.setenv("KLOGS_INDEX_BYPASS_RATIO", "0")
+    monkeypatch.setenv("KLOGS_INDEX_BYPASS_LINES", "1024")
+    f_pipe, lines = _pipeline_filter(monkeypatch, "3")
+    got = f_pipe.match_lines(lines)
+    assert f_pipe.bypassed is True
+    monkeypatch.delenv("KLOGS_INDEX_BYPASS_RATIO")
+    monkeypatch.delenv("KLOGS_INDEX_BYPASS_LINES")
+    f_ser, _ = _pipeline_filter(monkeypatch, "off")
+    assert got == f_ser.match_lines(lines)

@@ -68,6 +68,10 @@ TSAN_TEST_IDS = [
     "tests/test_groupscan.py::test_threaded_rows_parity",
     "tests/test_native_sweep.py::test_packed_tables_shared_across_threads",
     "tests/test_native_sweep.py::test_gil_released_during_sweep",
+    # Slab pipeline: prefetch threads inside sweep_candidates while the
+    # main thread confirms through group_scan — the exact production
+    # overlap KLOGS_SWEEP_PIPELINE enables.
+    "tests/test_native_sweep.py::test_sweep_pipeline_parity",
 ]
 
 

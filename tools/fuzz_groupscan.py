@@ -61,7 +61,8 @@ def oracle_mask(filt, lines: "list[bytes]",
 
 
 def run_trials(trials: int, seed: int, quiet: bool = True) -> int:
-    """Run ``trials`` three-way differential trials; returns the
+    """Run ``trials`` differential trials (python oracle vs byte and
+    packed kernel modes); returns the
     number checked. Raises AssertionError with a repro line on the
     first divergence. The caller owns KLOGS_NATIVE_GROUPSCAN
     restoration."""
@@ -105,11 +106,21 @@ def run_trials(trials: int, seed: int, quiet: bool = True) -> int:
             mats.append(rand_gm)
             for which, gm in enumerate(mats):
                 expect = oracle_mask(filt, lines, gm)
+                # The same matrix in the sweep kernel's packed u32
+                # form: the packed group_scan must agree bit for bit
+                # with the byte-matrix walk and the Python loop.
+                W = (G + 31) // 32
+                pb = np.packbits(gm, axis=1, bitorder="little")
+                pbuf = np.zeros((B, W * 4), dtype=np.uint8)
+                pbuf[:, :pb.shape[1]] = pb
+                packed = pbuf.view("<u4")
                 got = {}
                 for mode in ("off", "native"):
                     os.environ["KLOGS_NATIVE_GROUPSCAN"] = mode
                     got[mode] = filt._scan_candidates(
                         payload, offsets, np.ascontiguousarray(gm))
+                    got[mode + "-packed"] = filt._scan_candidates(
+                        payload, offsets, None, packed=packed)
                 for mode, mask in got.items():
                     assert np.array_equal(expect, mask), (
                         f"DIVERGENCE: seed={seed} trial={trial} "
@@ -147,7 +158,7 @@ def main() -> int:
     except AssertionError as e:
         print(str(e), flush=True)
         return 1
-    print(f"fuzz-groupscan OK: {checked} three-way comparisons across "
+    print(f"fuzz-groupscan OK: {checked} differential matrices across "
           f"{args.trials} trials, {time.time() - t0:.0f}s, seed={seed}",
           flush=True)
     return 0

@@ -9,7 +9,10 @@ device sweep, which is oracled against the same numpy masks in
 tests/test_sweep.py). This fuzzer generates adversarial pattern sets ×
 framed payloads and asserts full mask equality every trial, rotating
 KLOGS_NATIVE_SIMD across all stage-1 tiers (scalar / ssse3 / avx2 /
-auto) so every kernel variant is exercised.
+avx512 / auto — the kernel clamps each to what the CPU really has, so
+unsupported tiers exercise the dispatch ladder, never fault) AND
+KLOGS_SWEEP_BUCKETS across auto / 8 / 16, so every kernel variant ×
+bucket plane combination is exercised.
 
 Deliberately covered shapes (the cases a buffer-arithmetic slip would
 miss silently):
@@ -48,7 +51,8 @@ from klogs_tpu.filters.compiler.index import (  # noqa: E402
 )
 
 ALPHA = b"abcdef0123-=/ :\t.XYZ"
-SIMD_LEVELS = ("scalar", "ssse3", "avx2", "auto")
+SIMD_LEVELS = ("scalar", "ssse3", "avx2", "avx512", "auto")
+BUCKET_MODES = ("auto", "8", "16")
 
 
 def rand_patterns(rng: random.Random) -> "list[str]":
@@ -120,9 +124,14 @@ def run_trials(trials: int, seed: int, quiet: bool = True) -> int:
 
     rng = random.Random(seed)
     saved = env_read("KLOGS_NATIVE_SIMD")
+    saved_buckets = env_read("KLOGS_SWEEP_BUCKETS")
     checked = 0
     try:
         for trial in range(trials):
+            # Rotate the stage-1 bucket plane too: coprime strides
+            # (5 SIMD levels x 3 bucket modes) cover every pairing.
+            bmode = BUCKET_MODES[trial % len(BUCKET_MODES)]
+            os.environ["KLOGS_SWEEP_BUCKETS"] = bmode
             pats = rand_patterns(rng)
             try:
                 infos = analyze(pats)
@@ -141,6 +150,7 @@ def run_trials(trials: int, seed: int, quiet: bool = True) -> int:
             got = idx.group_candidates(payload, offsets, impl="native")
             assert np.array_equal(expect, got), (
                 f"DIVERGENCE: seed={seed} trial={trial} simd={level} "
+                f"buckets={bmode} "
                 f"patterns={pats!r} lines={lines!r}\n"
                 f"numpy:\n{expect.astype(int)}\n"
                 f"native:\n{got.astype(int)}")
@@ -152,6 +162,10 @@ def run_trials(trials: int, seed: int, quiet: bool = True) -> int:
             os.environ.pop("KLOGS_NATIVE_SIMD", None)
         else:
             os.environ["KLOGS_NATIVE_SIMD"] = saved
+        if saved_buckets is None:
+            os.environ.pop("KLOGS_SWEEP_BUCKETS", None)
+        else:
+            os.environ["KLOGS_SWEEP_BUCKETS"] = saved_buckets
     return checked
 
 
