@@ -16,7 +16,12 @@ from typing import Iterable
 from klogs_tpu.cli import Options
 from klogs_tpu.cluster.backend import ClusterBackend
 from klogs_tpu.cluster.types import LogOptions, PodInfo
-from klogs_tpu.runtime.fanout import FanoutRunner, StreamJob, plan_jobs
+from klogs_tpu.runtime.fanout import (
+    FanoutRunner,
+    StreamJob,
+    plan_jobs,
+    plan_source_jobs,
+)
 from klogs_tpu.ui import interactive, term, widgets
 from klogs_tpu.utils.env import read as env_read
 from klogs_tpu.utils import convert_bytes, parse_duration, split_log_file_name
@@ -186,6 +191,20 @@ def print_log_size(log_files: list[str], log_path: str) -> None:
         table.append([label, container, convert_bytes(size)])
         previous_pod = pod
     widgets.render_table(table)
+
+
+def _print_backfill_summary(pipeline) -> None:
+    """--backfill exit accounting (match/shed), printed whether or not
+    --stats was given — a run-to-completion mode owes its verdict."""
+    if pipeline is None:
+        term.info("Backfill complete (no --match/--exclude: every line "
+                  "written)")
+        return
+    s = pipeline.stats
+    term.info(
+        "Backfill complete: %s lines in, %s matched (%.2f%%), %s shed",
+        f"{s.lines_in:,}", f"{s.lines_matched:,}", s.matched_pct(),
+        f"{s.degraded_lines:,}")
 
 
 async def _watch_for_quit(
@@ -378,7 +397,18 @@ async def _run_async_inner(
         except FaultSpecError as e:
             term.fatal("invalid KLOGS_FAULTS: %s", e)
         term.warning("Fault injection ACTIVE (KLOGS_FAULTS=%s)", fault_spec)
-    backend = backend or make_backend(opts)
+    # --source/--backfill: a non-kube Source replaces the cluster
+    # backend wholesale — no namespace resolution, no pod selection,
+    # no kube client. cli.main validates the spec; this is the
+    # library-caller backstop.
+    from klogs_tpu.sources import SourceError, make_source
+
+    try:
+        source = make_source(opts)
+    except SourceError as e:
+        term.fatal("%s", e)
+    if source is None:
+        backend = backend or make_backend(opts)
     profiling = False
     if opts.profile:
         # Optional tracing hook (SURVEY.md §5: the reference has none;
@@ -391,45 +421,63 @@ async def _run_async_inner(
         profiling = True
         term.info("Profiling to %s", term.green(opts.profile))
     try:
-        namespace = await resolve_namespace(backend, opts, select_keys)
-        pods = await select_pods(backend, namespace, opts, select_keys)
-        log_opts = build_log_options(opts)
         container_re = exclude_container_re = None
-        import re as _re
+        log_opts = build_log_options(opts)
+        if source is not None:
+            namespace = "local"
+            pods: list[PodInfo] = []
+            await source.start()
+            refs = await source.discover()
+            jobs = plan_source_jobs(refs, opts.log_path)
+            log_files = [j.path for j in jobs]
+            mode = "backfilling" if opts.backfill else "streaming"
+            term.info("Found %s %s stream(s), %s",
+                      term.green(str(len(jobs))), source.kind, mode)
+            for j in jobs[:12]:
+                term.info("  %s", j.pod)
+            if len(jobs) > 12:
+                term.info("  … and %d more", len(jobs) - 12)
+        else:
+            namespace = await resolve_namespace(backend, opts, select_keys)
+            pods = await select_pods(backend, namespace, opts, select_keys)
+            import re as _re
 
-        # Backstop for library callers; cli.main rejects earlier.
-        if opts.container:
-            try:
-                container_re = _re.compile(opts.container)
-            except _re.error as e:
-                term.fatal("invalid -c/--container pattern %r: %s",
-                           opts.container, e)
-        if opts.exclude_container:
-            try:
-                exclude_container_re = _re.compile(opts.exclude_container)
-            except _re.error as e:
-                term.fatal("invalid -E/--exclude-container pattern %r: %s",
-                           opts.exclude_container, e)
-        jobs = plan_jobs(pods, opts.log_path, opts.init_containers,
-                         container_re=container_re,
-                         exclude_container_re=exclude_container_re)
-        log_files = [j.path for j in jobs]
-        if (container_re is not None or exclude_container_re is not None) \
-                and pods and not jobs:
-            # A filter miss must be distinguishable from an empty
-            # cluster (≙ the empty-label-result error that continues,
-            # cmd/root.go:392-394).
-            term.error("No containers left after -c/-E filtering in %d "
-                       "selected pod(s)", len(pods))
-        if jobs:
-            if container_re is not None or exclude_container_re is not None:
-                # With -c/-E active, pods whose containers were all
-                # filtered out contribute no streams — counting or
-                # rendering them would misstate the plan.
-                streaming = {j.pod for j in jobs}
-                print_plan([p for p in pods if p.name in streaming], jobs)
-            else:
-                print_plan(pods, jobs)
+            # Backstop for library callers; cli.main rejects earlier.
+            if opts.container:
+                try:
+                    container_re = _re.compile(opts.container)
+                except _re.error as e:
+                    term.fatal("invalid -c/--container pattern %r: %s",
+                               opts.container, e)
+            if opts.exclude_container:
+                try:
+                    exclude_container_re = _re.compile(opts.exclude_container)
+                except _re.error as e:
+                    term.fatal("invalid -E/--exclude-container pattern "
+                               "%r: %s", opts.exclude_container, e)
+            jobs = plan_jobs(pods, opts.log_path, opts.init_containers,
+                             container_re=container_re,
+                             exclude_container_re=exclude_container_re)
+            log_files = [j.path for j in jobs]
+            if (container_re is not None
+                    or exclude_container_re is not None) \
+                    and pods and not jobs:
+                # A filter miss must be distinguishable from an empty
+                # cluster (≙ the empty-label-result error that continues,
+                # cmd/root.go:392-394).
+                term.error("No containers left after -c/-E filtering in "
+                           "%d selected pod(s)", len(pods))
+            if jobs:
+                if container_re is not None \
+                        or exclude_container_re is not None:
+                    # With -c/-E active, pods whose containers were all
+                    # filtered out contribute no streams — counting or
+                    # rendering them would misstate the plan.
+                    streaming = {j.pod for j in jobs}
+                    print_plan([p for p in pods if p.name in streaming],
+                               jobs)
+                else:
+                    print_plan(pods, jobs)
         if opts.timestamps and (opts.match or opts.exclude):
             # grep-parity semantics: the server-side stamp is part of
             # the line the filter sees (as it would be for kubectl
@@ -491,6 +539,8 @@ async def _run_async_inner(
         backend_bind = getattr(backend, "bind_registry", None)
         if backend_bind is not None and obs_registry is not None:
             backend_bind(obs_registry)
+        if source is not None and obs_registry is not None:
+            source.bind_registry(obs_registry)
 
         pipeline = make_pipeline_for(opts, registry=obs_registry)
         inner_factory = make_inner_sink_factory(opts)
@@ -511,6 +561,7 @@ async def _run_async_inner(
                               else inner_factory),
                 create_files=opts.output != "stdout",
                 registry=obs_registry,
+                source=source,
             )
             if opts.metrics_port is not None:
                 from klogs_tpu import obs
@@ -540,7 +591,19 @@ async def _run_async_inner(
             # one-off multiselect cannot); re-run the same -a/-l
             # selection and let the runner diff.
             plan_new = None
-            if opts.watch_new and opts.follow:
+            if source is not None:
+                if opts.follow:
+                    # Sources re-discover for free (glob expansion, new
+                    # socket connections): follow mode always watches.
+                    _src = source
+
+                    async def plan_new() -> list[StreamJob]:
+                        return plan_source_jobs(await _src.discover(),
+                                                opts.log_path)
+                if opts.watch_new and not opts.follow:
+                    term.warning("--watch-new only applies with -f; "
+                                 "ignoring")
+            elif opts.watch_new and opts.follow:
                 if opts.all_pods or opts.labels:
                     async def plan_new() -> list[StreamJob]:
                         pods = await select_noninteractive(
@@ -653,6 +716,10 @@ async def _run_async_inner(
                             pass
             else:
                 await runner.run(jobs)
+                if opts.backfill:
+                    # Run-to-completion contract: always account for
+                    # what was matched vs shed, --stats or not.
+                    _print_backfill_summary(pipeline)
 
             if opts.output != "stdout":
                 # No files exist in stdout-only mode; the size table
@@ -708,7 +775,10 @@ async def _run_async_inner(
                 # Trace serialization failure must not skip backend
                 # cleanup or mask an in-flight exception.
                 term.warning("Failed to write profiler trace: %s", e)
-        await backend.close()
+        if backend is not None:
+            await backend.close()
+        if source is not None:
+            await source.close()
 
 
 def run(opts: Options) -> int:

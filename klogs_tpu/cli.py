@@ -61,6 +61,17 @@ New (north-star) flags, absent from the reference:
                     utilization, queue/in-flight samples); same doc as
                     /profile on --metrics-port
   --cluster         cluster backend: kube (real) | fake (hermetic demo)
+  --source          non-kube log source (docs/SOURCES.md):
+                    replay:PATH[,PATH...] streams local files/dirs/globs
+                    with rotation handling; socket:HOST:PORT or
+                    socket:unix:/path.sock listens for newline-delimited
+                    ingest (requires -f)
+  --backfill        archive backfill mode: read rotated/gzip/zstd logs
+                    under the given paths through the full pipeline to
+                    completion, then exit with match/shed accounting
+                    (incompatible with -f and --source)
+  --replay-rate     pace replay at N lines/s (default: as fast as the
+                    disk goes; KLOGS_REPLAY_RATE sets a default)
 """
 
 import argparse
@@ -107,6 +118,9 @@ class Options:
     exclude_container: str = ""
     format: str = "text"
     since_time: str = ""
+    source: str = ""
+    backfill: list[str] = field(default_factory=list)
+    replay_rate: float | None = None
 
 
 USE = "klogs"
@@ -357,6 +371,32 @@ def build_parser() -> argparse.ArgumentParser:
         default="kube",
         help="Cluster backend: real Kubernetes API or hermetic fake (demo/test)",
     )
+    p.add_argument(
+        "--source",
+        default="",
+        metavar="SPEC",
+        help="Non-kube log source: replay:PATH[,PATH...] (local "
+        "files/dirs/globs with rotation handling) or socket:HOST:PORT / "
+        "socket:unix:/path.sock (newline-delimited listener, needs -f)",
+    )
+    p.add_argument(
+        "--backfill",
+        nargs="+",
+        default=[],
+        metavar="PATH",
+        help="Read rotated/gzip/zstd archives under PATH(s) through the "
+        "full pipeline to completion, then exit with match/shed "
+        "accounting (incompatible with -f and --source)",
+    )
+    p.add_argument(
+        "--replay-rate",
+        type=float,
+        default=None,
+        dest="replay_rate",
+        metavar="LPS",
+        help="Pace a replay source at LPS lines/s (default: unpaced; "
+        "KLOGS_REPLAY_RATE sets a default)",
+    )
     return p
 
 
@@ -395,6 +435,9 @@ def parse_args(argv: list[str] | None = None) -> Options:
         exclude_container=ns.exclude_container,
         format=ns.format,
         since_time=ns.since_time,
+        source=ns.source,
+        backfill=list(ns.backfill),
+        replay_rate=ns.replay_rate,
     )
 
 
@@ -435,6 +478,32 @@ def main(argv: list[str] | None = None) -> int:
                        "timezone, e.g. 2026-07-31T06:00:00Z)",
                        opts.since_time)
             return 1
+    if opts.source and opts.backfill:
+        term.error("--source and --backfill are mutually exclusive "
+                   "(backfill IS a source)")
+        return 1
+    if opts.backfill and opts.follow:
+        term.error("--backfill is a run-to-completion mode and cannot "
+                   "be combined with -f/--follow")
+        return 1
+    if opts.source:
+        if not (opts.source.startswith("replay:")
+                or opts.source.startswith("socket:")):
+            term.error("invalid --source %r: expected "
+                       "replay:PATH[,PATH...], socket:HOST:PORT, or "
+                       "socket:unix:/path.sock", opts.source)
+            return 1
+        if opts.source.startswith("socket:") and not opts.follow:
+            term.error("--source socket: is a live listener and "
+                       "requires -f/--follow")
+            return 1
+    if opts.replay_rate is not None:
+        if opts.replay_rate <= 0:
+            term.error("--replay-rate must be a positive lines/s value")
+            return 1
+        if not opts.source.startswith("replay:"):
+            term.warning("--replay-rate only applies to a replay "
+                         "source; ignoring")
     if opts.shard_mode != "round-robin" and (
             opts.remote is None or "," not in opts.remote):
         # One endpoint is below the routing layer entirely (the plain
@@ -455,13 +524,14 @@ def main(argv: list[str] | None = None) -> int:
 
     from klogs_tpu.app import run
     from klogs_tpu.cluster.backend import ClusterError
+    from klogs_tpu.sources import SourceError
     from klogs_tpu.ui.interactive import NotInteractive
 
     try:
         return run(opts)
     except term.FatalError:
         return 1
-    except ClusterError as e:
+    except (ClusterError, SourceError) as e:
         # One friendly line for control-plane failures (401/403/
         # unreachable apiserver), not a traceback; ≙ pterm.Fatal/panic
         # in the reference (cmd/root.go:78,110,130).

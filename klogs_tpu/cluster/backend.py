@@ -12,9 +12,9 @@ All methods are async: the fan-out runtime is an asyncio event loop
 """
 
 import abc
-from typing import AsyncIterator
 
 from klogs_tpu.cluster.types import LogOptions, PodInfo
+from klogs_tpu.sources.base import SourceError, SourceStream
 
 
 class ClusterError(Exception):
@@ -25,29 +25,22 @@ class NamespaceNotFound(ClusterError):
     pass
 
 
-class StreamError(ClusterError):
-    """Opening or reading a log stream failed (cmd/root.go:326-329 analog)."""
+class StreamError(ClusterError, SourceError):
+    """Opening or reading a log stream failed (cmd/root.go:326-329
+    analog). Subclasses SourceError so the source-agnostic fanout
+    layer handles kube stream failures and file/socket failures with
+    one except clause."""
 
 
-class LogStream(abc.ABC):
+class LogStream(SourceStream):
     """One container's log stream: an async iterator of byte chunks.
 
     The analog of the reference's io.ReadCloser from GetLogs(...).Stream
     (cmd/root.go:322-325): raw chunked bytes, line boundaries not
-    guaranteed to align with chunk boundaries.
+    guaranteed to align with chunk boundaries. The iterator/close
+    contract now lives on ``sources.base.SourceStream``; LogStream is
+    the cluster-flavored alias every backend already implements.
     """
-
-    @abc.abstractmethod
-    def __aiter__(self) -> AsyncIterator[bytes]: ...
-
-    @abc.abstractmethod
-    async def close(self) -> None: ...
-
-    async def __aenter__(self) -> "LogStream":
-        return self
-
-    async def __aexit__(self, *exc) -> None:
-        await self.close()
 
 
 class ClusterBackend(abc.ABC):

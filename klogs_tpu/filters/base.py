@@ -102,6 +102,13 @@ class FilterStats:
         return int(self._lines_matched.value)
 
     @property
+    def degraded_lines(self) -> int:
+        """Lines that took ANY degrade action (pass/drop), summed
+        across actions — the --backfill "shed" accounting."""
+        return int(sum(child.value
+                       for _lv, child in self._degraded_lines.children()))
+
+    @property
     def bytes_in(self) -> int:
         return int(self._bytes_in.value)
 

@@ -297,6 +297,24 @@ SPECS: dict[str, dict] = {
         "counter", "Sink writes that blocked longer than the stall "
         "threshold (downstream backpressure)."),
 
+    # -- source layer (sources/*: replay, archive, socket) ------------
+    "klogs_source_bytes_total": _m(
+        "counter", "Bytes delivered by non-kube sources, by source "
+        "kind (file, archive, socket).", labels=("kind",),
+        bounds={"kind": "enum"}),
+    "klogs_source_rotations_total": _m(
+        "counter", "File rotations/truncations detected by the replay "
+        "source (inode change or shrink at the watched path)."),
+    "klogs_source_archive_members_total": _m(
+        "counter", "Archive members (rotated/compressed files) fully "
+        "decoded by the backfill source."),
+    "klogs_source_errors_total": _m(
+        "counter", "Source open/read failures (SourceError), by "
+        "source kind.", labels=("kind",), bounds={"kind": "enum"}),
+    "klogs_source_connections_total": _m(
+        "counter", "Connections accepted by the socket source "
+        "(KLOGS_SOCKET_MAX_CONNS bounds the concurrent set)."),
+
     # -- resilience layer (retry/breaker/faults/degrade) --------------
     "klogs_retry_attempts_total": _m(
         "counter", "Retries performed by the shared resilience policy, "

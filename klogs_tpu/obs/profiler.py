@@ -60,6 +60,7 @@ if TYPE_CHECKING:
 # "Span catalog") the profiler folds. A fixed enum — the `stage` label
 # on the klogs_profile_* families is bounded by this tuple.
 STAGES: "tuple[str, ...]" = (
+    "source.read",
     "fanout.read",
     "sink.flush",
     "sink.write",

@@ -10,6 +10,14 @@ policies guard, so an armed fault exercises the REAL recovery path
 - ``kube.log_stream``           — log-stream open (cluster/kube.py,
                                   cluster/fake.py)
 - ``sink.write``                — sink write (runtime/sink.py)
+- ``source.open``               — non-kube source stream open
+                                  (sources/replay.py, archive.py,
+                                  socket.py; the kube path keeps its
+                                  ``kube.*`` points)
+- ``source.read``               — non-kube source chunk read (same
+                                  sites; surfaces as SourceError so
+                                  the fanout reconnect/degrade path
+                                  runs for real)
 
 Arming: tests call ``FAULTS.arm(point, times=..., exc=..., delay_s=...)``
 with whatever exception type the site really raises; operators/CI use
@@ -39,7 +47,7 @@ from typing import Callable
 
 KNOWN_POINTS = frozenset({
     "rpc.match", "rpc.hello", "kube.list_pods", "kube.log_stream",
-    "sink.write",
+    "sink.write", "source.open", "source.read",
 })
 
 

@@ -11,6 +11,14 @@ Deliberate improvement over the reference: follow mode tears down with
 explicit cancellation (stop() closes every stream and flushes every
 sink) instead of exiting the process with goroutines still running
 (SURVEY.md §3.3 quirk).
+
+Source-agnostic since PR 18: workers open streams through the Source
+contract (sources/base.py) — a kube backend is silently adapted via
+ClusterSource, and file/archive/socket sources get the same per-stream
+sinks, reconnect policy, error isolation, and metrics. Pod identity
+generalizes to SourceRef (group/unit play pod/container); refs marked
+``ephemeral`` (socket peers) end without reconnect or a premature-end
+warning.
 """
 
 import asyncio
@@ -20,11 +28,13 @@ import time
 from dataclasses import dataclass
 from typing import Callable
 
-from klogs_tpu.cluster.backend import ClusterBackend, StreamError
+from klogs_tpu.cluster.backend import ClusterBackend
 from klogs_tpu.cluster.types import LogOptions, PodInfo
 from klogs_tpu.obs import trace
 from klogs_tpu.resilience import RetryPolicy
 from klogs_tpu.runtime.sink import FileSink, Sink, SinkError
+from klogs_tpu.sources.base import Source, SourceError, SourceRef
+from klogs_tpu.sources.cluster import ClusterSource
 from klogs_tpu.ui import term
 from klogs_tpu.utils.naming import log_file_name
 
@@ -55,6 +65,9 @@ class StreamJob:
     container: str
     init: bool
     path: str
+    # Non-cluster sources attach the ref the job was planned from;
+    # None = classic pod identity (the worker synthesizes a pod ref).
+    ref: "SourceRef | None" = None
 
 
 @dataclass
@@ -113,10 +126,29 @@ def plan_jobs(
     return jobs
 
 
+def plan_source_jobs(refs: "list[SourceRef]",
+                     log_path: str) -> list[StreamJob]:
+    """plan_jobs for non-cluster sources: one job per ref, with
+    group/unit standing in for pod/container so the per-stream output
+    files, sinks, and metric labels follow the same naming scheme."""
+    jobs = []
+    seen: set[tuple[str, str]] = set()
+    for ref in refs:
+        key = (ref.group, ref.unit)
+        if key in seen:
+            continue
+        seen.add(key)
+        jobs.append(StreamJob(
+            ref.group, ref.unit, False,
+            os.path.join(log_path, log_file_name(ref.group, ref.unit)),
+            ref=ref))
+    return jobs
+
+
 class FanoutRunner:
     def __init__(
         self,
-        backend: ClusterBackend,
+        backend: "ClusterBackend | None",
         namespace: str,
         log_opts: LogOptions,
         sink_factory: SinkFactory | None = None,
@@ -125,8 +157,16 @@ class FanoutRunner:
         create_files: bool = True,
         registry=None,
         reconnect_policy: "RetryPolicy | None" = None,
+        source: "Source | None" = None,
     ):
+        if source is None:
+            if backend is None:
+                raise ValueError("FanoutRunner needs a backend or a source")
+            # The classic construction: adapt the cluster backend. The
+            # adapter adds nothing, so the kube path is unchanged.
+            source = ClusterSource(backend, namespace)
         self.backend = backend
+        self.source = source
         self.namespace = namespace
         self.log_opts = log_opts
         self.sink_factory = sink_factory or (lambda job: FileSink(job.path))
@@ -201,14 +241,14 @@ class FanoutRunner:
         # the still-unfetched gap would be silently skipped. None until
         # the first stream opens.
         last_data: float | None = None
+        ref = job.ref or SourceRef(kind="pod", group=job.pod,
+                                   unit=job.container, target=job.pod)
         try:
             while True:
                 try:
                     async with self._open_gate():
-                        stream = await self.backend.open_log_stream(
-                            self.namespace, job.pod, opts
-                        )
-                except StreamError as e:
+                        stream = await self.source.open_stream(ref, opts)
+                except SourceError as e:
                     if await self._should_reconnect(job, attempt, e):
                         attempt += 1
                         continue
@@ -234,7 +274,7 @@ class FanoutRunner:
                 if last_data is None:
                     last_data = opened_at
                 got_data = False
-                stream_err: StreamError | None = None
+                stream_err: SourceError | None = None
                 sink_err: SinkError | None = None
                 # Per-chunk trace root: the first hop of a batch's
                 # life. With sampling off span() is a no-op singleton
@@ -267,7 +307,7 @@ class FanoutRunner:
                             if (time.monotonic() - last_data
                                     >= STALL_THRESHOLD_S):
                                 stalls.inc()
-                except StreamError as e:
+                except SourceError as e:
                     stream_err = e
                 except SinkError as e:
                     sink_err = e
@@ -290,6 +330,16 @@ class FanoutRunner:
                     term.error("Sink failed for container %s\n%s",
                                job.container, sink_err)
                     result.error = str(sink_err)
+                    return result
+
+                if ref.ephemeral:
+                    # Connection-scoped stream (socket peer): its EOF is
+                    # the lifecycle, not a premature end, and there is
+                    # nothing to reconnect TO once the peer is gone.
+                    if stream_err is not None and not self._stopping:
+                        term.error("Error reading logs for container %s\n%s",
+                                   job.container, stream_err)
+                        result.error = str(stream_err)
                     return result
 
                 if not self.log_opts.follow or self._stopping:
@@ -382,7 +432,7 @@ class FanoutRunner:
                            jitter=0.0)
 
     async def _should_reconnect(self, job: StreamJob, attempt: int,
-                                err: "StreamError | None") -> bool:
+                                err: "SourceError | None") -> bool:
         """Backoff-gated reconnect decision for follow mode; sleeps the
         shared RetryPolicy's backoff (stop-aware) when reconnecting —
         the same policy implementation the RPC and kube layers use.

@@ -1,0 +1,79 @@
+"""Pluggable log sources (docs/SOURCES.md).
+
+Only ``base`` is imported eagerly: ``cluster/backend.py`` imports
+``sources.base`` for the shared stream contract, so pulling the
+concrete implementations (which import back into cluster/) at package
+import time would be a cycle. ``make_source`` resolves them lazily.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from klogs_tpu.sources.base import (
+    Source,
+    SourceConfigError,
+    SourceError,
+    SourceMetrics,
+    SourceRef,
+    SourceStream,
+    safe_group_name,
+)
+
+if TYPE_CHECKING:
+    from klogs_tpu.cli import Options
+
+__all__ = [
+    "Source",
+    "SourceConfigError",
+    "SourceError",
+    "SourceMetrics",
+    "SourceRef",
+    "SourceStream",
+    "make_source",
+    "safe_group_name",
+]
+
+
+def make_source(opts: "Options") -> "Source | None":
+    """Build the Source selected by ``--source``/``--backfill``, or
+    None on the default kube path. Knobs: KLOGS_SOURCE_READAHEAD_MB
+    (archive read-ahead), KLOGS_REPLAY_RATE (replay pacing, 0 = as
+    fast as the disk goes; ``--replay-rate`` overrides),
+    KLOGS_SOCKET_MAX_CONNS (listener accept cap)."""
+    from klogs_tpu.utils.env import nonneg_float, warn_positive_int
+
+    backfill = getattr(opts, "backfill", None)
+    spec = getattr(opts, "source", None)
+    if backfill:
+        from klogs_tpu.sources.archive import ArchiveSource
+
+        readahead = warn_positive_int("KLOGS_SOURCE_READAHEAD_MB", 8)
+        return ArchiveSource(list(backfill), readahead_mb=readahead)
+    if not spec:
+        return None
+    if spec.startswith("replay:"):
+        from klogs_tpu.sources.replay import ReplaySource
+
+        paths = [p for p in spec[len("replay:"):].split(",") if p]
+        if not paths:
+            raise SourceConfigError(
+                "--source replay: needs at least one path "
+                "(replay:PATH[,PATH...])")
+        rate = getattr(opts, "replay_rate", None)
+        if rate is None:
+            rate = nonneg_float("KLOGS_REPLAY_RATE", 0.0)
+        return ReplaySource(paths, rate_lps=rate if rate > 0 else None)
+    if spec.startswith("socket:"):
+        from klogs_tpu.sources.socket import SocketSource
+
+        target = spec[len("socket:"):]
+        if not target:
+            raise SourceConfigError(
+                "--source socket: needs a listen address "
+                "(socket:HOST:PORT or socket:unix:/path.sock)")
+        max_conns = warn_positive_int("KLOGS_SOCKET_MAX_CONNS", 64)
+        return SocketSource(target, max_conns=max_conns)
+    raise SourceConfigError(
+        f"unknown --source {spec!r}: expected replay:PATH[,PATH...], "
+        "socket:HOST:PORT, or socket:unix:/path.sock")

@@ -1,0 +1,286 @@
+"""File/directory replay source.
+
+Streams local files through the same per-stream fanout workers the
+kube path uses — the source that lets follow-mode soaks and parity
+tests run at disk speed instead of apiserver speed. Handles the
+logrotate lifecycle:
+
+* **rotation/rename** — EOF + a changed inode at the path means the
+  file was rotated away; the old fd is drained first (bytes written
+  between our last read and the rename are not lost), then the new
+  file is picked up from offset 0 and a ``klogs_source_rotations_total``
+  tick is recorded.
+* **truncation in place** (``copytruncate``) — size < our position
+  reopens at 0.
+* **resume offsets** — per (path, inode) the source remembers the last
+  *line-aligned* byte delivered; re-opening the same file resumes
+  there, so a drop/re-open re-emits at most the one partial line that
+  was in flight (the PR 5 reconnect gap-bounds analog for files).
+* **glob watching** — ``discover()`` re-expands directories and glob
+  patterns, so in follow mode new files join the fanout via the same
+  poller that handles ``--watch-new`` pods.
+
+Chunks are slab-sized (256 KiB) and cut at the last newline with the
+tail carried, so the downstream FramedBatcher's native newline sweep
+gets full lines without any per-line Python here. Optional pacing
+(``--replay-rate`` / KLOGS_REPLAY_RATE) throttles to N lines/s for
+follow-mode realism.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import glob
+import os
+import time
+import zlib
+from typing import BinaryIO
+
+from klogs_tpu.cluster.types import LogOptions
+from klogs_tpu.obs import trace
+from klogs_tpu.resilience.faults import FAULTS, InjectedFault
+from klogs_tpu.sources.base import (
+    Source,
+    SourceError,
+    SourceMetrics,
+    SourceRef,
+    SourceStream,
+    safe_group_name,
+)
+
+DEFAULT_READ_SIZE = 256 << 10
+DEFAULT_POLL_S = 0.2
+_GLOB_CHARS = frozenset("*?[")
+
+
+def _expand_paths(specs: "list[str]") -> "list[str]":
+    """Files, directories (their direct regular files), and glob
+    patterns → ordered, deduplicated file list."""
+    out: "list[str]" = []
+    for spec in specs:
+        if _GLOB_CHARS & set(spec):
+            out.extend(sorted(p for p in glob.glob(spec)
+                              if os.path.isfile(p)))
+        elif os.path.isdir(spec):
+            for name in sorted(os.listdir(spec)):
+                p = os.path.join(spec, name)
+                if os.path.isfile(p) and not name.startswith("."):
+                    out.append(p)
+        elif os.path.isfile(spec):
+            out.append(spec)
+    seen: "set[str]" = set()
+    uniq: "list[str]" = []
+    for p in out:
+        if p not in seen:
+            seen.add(p)
+            uniq.append(p)
+    return uniq
+
+
+async def _fire_fault(point: str, metrics: SourceMetrics, target: str,
+                      path: str) -> None:
+    if not FAULTS.active:
+        return
+    try:
+        await FAULTS.fire(point, target=target)
+    except InjectedFault as exc:
+        metrics.error()
+        raise SourceError(f"injected {point} fault: {exc}",
+                          path=path) from exc
+
+
+class ReplayStream(SourceStream):
+    """One file's stream. All blocking I/O runs via to_thread; the
+    async side only ever sees newline-aligned slabs."""
+
+    def __init__(self, ref: SourceRef, follow: bool, *,
+                 offsets: "dict[str, tuple[int, int]]",
+                 metrics: SourceMetrics,
+                 rate_lps: "float | None" = None,
+                 poll_s: float = DEFAULT_POLL_S,
+                 read_size: int = DEFAULT_READ_SIZE) -> None:
+        self._ref = ref
+        self._path = ref.target
+        self._follow = follow
+        self._offsets = offsets
+        self._metrics = metrics
+        self._rate = rate_lps
+        self._poll_s = poll_s
+        self._read_size = read_size
+        self._f: "BinaryIO | None" = None
+        self._inode = -1
+        self._pos = 0
+        self._tail = b""
+        self._closed = False
+        self._wake: "asyncio.Event | None" = None  # lazy: no eager loop bind
+        self._t0: "float | None" = None
+        self._due = 0.0
+
+    def _wake_ev(self) -> asyncio.Event:
+        if self._wake is None:
+            self._wake = asyncio.Event()
+        return self._wake
+
+    # -- blocking half (thread) ---------------------------------------
+
+    def _open_file(self) -> None:
+        f = open(self._path, "rb")
+        st = os.fstat(f.fileno())
+        pos = 0
+        prev = self._offsets.get(self._path)
+        if prev is not None and prev[0] == st.st_ino \
+                and prev[1] <= st.st_size:
+            pos = prev[1]
+        f.seek(pos)
+        self._f, self._inode, self._pos = f, st.st_ino, pos
+
+    def _step(self) -> "tuple[str, bytes]":
+        """One poll: ('data', raw) | ('rotate', old_fd_remainder) |
+        ('eof', b'') | ('wait', b'')."""
+        if self._f is None:
+            try:
+                self._open_file()
+            except FileNotFoundError:
+                return ("wait", b"") if self._follow else ("eof", b"")
+        assert self._f is not None
+        data = self._f.read(self._read_size)
+        if data:
+            self._pos += len(data)
+            return ("data", data)
+        if not self._follow:
+            return ("eof", b"")
+        try:
+            st = os.stat(self._path)
+        except FileNotFoundError:
+            # Renamed away with no successor yet; old fd is drained,
+            # keep watching the path for a recreated file.
+            self._close_file(forget=True)
+            return ("wait", b"")
+        if st.st_ino != self._inode:
+            rest = self._f.read()
+            self._close_file(forget=True)
+            return ("rotate", rest)
+        if st.st_size < self._pos:
+            self._f.seek(0)
+            self._pos = 0
+            return ("rotate", b"")
+        return ("wait", b"")
+
+    def _close_file(self, forget: bool = False) -> None:
+        if self._f is not None:
+            try:
+                self._f.close()
+            finally:
+                self._f = None
+        if forget:
+            self._offsets.pop(self._path, None)
+            self._inode = -1
+            self._pos = 0
+
+    # -- async half ---------------------------------------------------
+
+    def __aiter__(self) -> "ReplayStream":
+        return self
+
+    async def __anext__(self) -> bytes:
+        while True:
+            if self._closed:
+                raise StopAsyncIteration
+            await _fire_fault("source.read", self._metrics,
+                              self._ref.group, self._path)
+            with trace.TRACER.span("source.read", kind="file",
+                                   group=self._ref.group):
+                kind, data = await asyncio.to_thread(self._step)
+            if kind == "data":
+                buf = self._tail + data
+                cut = buf.rfind(b"\n")
+                if cut < 0:
+                    self._tail = buf
+                    continue
+                out, self._tail = buf[:cut + 1], buf[cut + 1:]
+                # Resume point: everything up to the carried tail was
+                # delivered line-aligned.
+                self._offsets[self._path] = (
+                    self._inode, self._pos - len(self._tail))
+                self._metrics.add_bytes(len(out))
+                await self._pace(out)
+                return out
+            if kind == "rotate":
+                self._metrics.rotation()
+                out, self._tail = self._tail + data, b""
+                if out:
+                    self._metrics.add_bytes(len(out))
+                    return out
+                continue
+            if kind == "eof":
+                out, self._tail = self._tail, b""
+                if out:
+                    self._metrics.add_bytes(len(out))
+                    return out
+                raise StopAsyncIteration
+            try:  # wait
+                await asyncio.wait_for(self._wake_ev().wait(),
+                                       self._poll_s)
+            except asyncio.TimeoutError:
+                pass
+
+    async def _pace(self, out: bytes) -> None:
+        if self._rate is None:
+            return
+        now = time.monotonic()
+        if self._t0 is None:
+            self._t0 = now
+        self._due += out.count(b"\n") / self._rate
+        delay = self._due - (now - self._t0)
+        if delay > 0:
+            await asyncio.sleep(delay)
+
+    async def close(self) -> None:
+        self._closed = True
+        self._wake_ev().set()
+        await asyncio.to_thread(self._close_file)
+
+
+class ReplaySource(Source):
+    kind = "file"
+
+    def __init__(self, paths: "list[str]", *,
+                 rate_lps: "float | None" = None,
+                 poll_interval_s: float = DEFAULT_POLL_S,
+                 read_size: int = DEFAULT_READ_SIZE) -> None:
+        super().__init__()
+        self.paths = list(paths)
+        self.rate_lps = rate_lps
+        self.poll_interval_s = poll_interval_s
+        self.read_size = read_size
+        # path -> (inode, line-aligned offset); consulted on re-open.
+        self._offsets: "dict[str, tuple[int, int]]" = {}
+
+    async def discover(self) -> "list[SourceRef]":
+        files = await asyncio.to_thread(_expand_paths, self.paths)
+        refs: "list[SourceRef]" = []
+        groups: "set[str]" = set()
+        for path in files:
+            group = safe_group_name(path)
+            if group in groups:
+                # Distinct paths that sanitize identically stay
+                # distinct (stable: derived from the path itself).
+                group = f"{group}-{zlib.crc32(path.encode()) & 0xffff:04x}"
+            groups.add(group)
+            refs.append(SourceRef(kind=self.kind, group=group,
+                                  unit="log", target=path))
+        return refs
+
+    async def open_stream(self, ref: SourceRef,
+                          opts: LogOptions) -> SourceStream:
+        await _fire_fault("source.open", self.metrics, ref.group,
+                          ref.target)
+        if not opts.follow \
+                and not await asyncio.to_thread(os.path.isfile, ref.target):
+            self.metrics.error()
+            raise SourceError(f"no such file: {ref.target}",
+                              path=ref.target)
+        return ReplayStream(ref, opts.follow, offsets=self._offsets,
+                            metrics=self.metrics, rate_lps=self.rate_lps,
+                            poll_s=self.poll_interval_s,
+                            read_size=self.read_size)

@@ -1,0 +1,185 @@
+"""Socket/journald-style ingest: a newline-delimited TCP or UDS
+listener whose connections become fanout streams.
+
+Backpressure is propagated to the peer by construction, never by
+buffering: bytes are read from a connection only inside the stream's
+``__anext__`` — when the downstream sink stalls, the StreamReader's
+flow-control limit (64 KiB) pauses the transport, the kernel receive
+window fills, and the peer's ``send`` blocks. No unbounded queue
+exists anywhere on the path (the test asserts a slow consumer blocks
+a fast peer).
+
+Connections are ``ephemeral`` SourceRefs: a peer hanging up ends its
+stream without the reconnect machinery or a "premature end" warning —
+EOF *is* the lifecycle. New connections join through the same
+discover() polling that picks up new pods under ``--watch-new``, so
+the mode requires ``-f``. The accept cap (KLOGS_SOCKET_MAX_CONNS)
+bounds both memory and the per-connection metric label space.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import stat as stat_mod
+
+from klogs_tpu.cluster.types import LogOptions
+from klogs_tpu.obs import trace
+from klogs_tpu.sources.base import (
+    Source,
+    SourceError,
+    SourceMetrics,
+    SourceRef,
+    SourceStream,
+)
+from klogs_tpu.sources.replay import _fire_fault
+
+READ_SIZE = 1 << 16
+FLOW_LIMIT = 1 << 16  # StreamReader high-water mark == one read slab
+
+
+class SocketStream(SourceStream):
+    def __init__(self, ref: SourceRef, reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter, *,
+                 metrics: SourceMetrics,
+                 source: "SocketSource") -> None:
+        self._ref = ref
+        self._reader = reader
+        self._writer = writer
+        self._metrics = metrics
+        self._source = source
+        self._closed = False
+
+    def __aiter__(self) -> "SocketStream":
+        return self
+
+    async def __anext__(self) -> bytes:
+        if self._closed:
+            raise StopAsyncIteration
+        await _fire_fault("source.read", self._metrics, self._ref.group,
+                          self._ref.target)
+        with trace.TRACER.span("source.read", kind="socket",
+                               group=self._ref.group):
+            try:
+                data = await self._reader.read(READ_SIZE)
+            except (ConnectionError, OSError) as exc:
+                self._metrics.error()
+                await self.close()
+                raise SourceError(
+                    f"socket peer {self._ref.group}: {exc}") from exc
+        if not data:
+            await self.close()
+            raise StopAsyncIteration
+        self._metrics.add_bytes(len(data))
+        return data
+
+    async def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        await self._source._release(self._ref.target)
+        self._writer.close()
+        try:
+            await self._writer.wait_closed()
+        except (ConnectionError, OSError):
+            pass
+
+
+class SocketSource(Source):
+    """Listener lifecycle: ``start()`` binds (lazily — never in the
+    constructor), the accept callback only registers the connection,
+    and ``discover()`` surfaces registered peers as ephemeral refs."""
+
+    kind = "socket"
+
+    def __init__(self, target: str, *, max_conns: int = 64) -> None:
+        super().__init__()
+        self.target = target
+        self.max_conns = max_conns
+        self._server: "asyncio.base_events.Server | None" = None
+        self._unix_path: "str | None" = None
+        # conn id -> (reader, writer); mutated only from the loop.
+        self._conns: "dict[str, tuple[asyncio.StreamReader, asyncio.StreamWriter]]" = {}
+        self._next_id = 0
+
+    async def start(self) -> None:
+        if self._server is not None:
+            return
+        try:
+            if self.target.startswith("unix:"):
+                path = self.target[len("unix:"):]
+                await asyncio.to_thread(self._unlink_stale, path)
+                self._server = await asyncio.start_unix_server(
+                    self._on_conn, path=path, limit=FLOW_LIMIT)
+                self._unix_path = path
+            else:
+                host, _, port = self.target.rpartition(":")
+                if not host or not port.isdigit():
+                    raise SourceError(
+                        f"bad socket listen spec {self.target!r}: "
+                        "expected HOST:PORT or unix:/path.sock")
+                self._server = await asyncio.start_server(
+                    self._on_conn, host=host, port=int(port),
+                    limit=FLOW_LIMIT)
+        except OSError as exc:
+            self.metrics.error()
+            raise SourceError(
+                f"cannot listen on {self.target}: {exc}") from exc
+
+    @staticmethod
+    def _unlink_stale(path: str) -> None:
+        try:
+            if stat_mod.S_ISSOCK(os.stat(path).st_mode):
+                os.unlink(path)
+        except FileNotFoundError:
+            pass
+
+    def bound_port(self) -> int:
+        """The kernel-assigned port (tests listen on port 0)."""
+        assert self._server is not None and self._server.sockets
+        addr = self._server.sockets[0].getsockname()
+        return int(addr[1]) if isinstance(addr, tuple) else 0
+
+    async def _on_conn(self, reader: asyncio.StreamReader,
+                       writer: asyncio.StreamWriter) -> None:
+        if len(self._conns) >= self.max_conns:
+            writer.close()
+            return
+        name = f"conn-{self._next_id:04d}"
+        self._next_id += 1
+        self._conns[name] = (reader, writer)
+        self.metrics.connection()
+
+    async def _release(self, name: str) -> None:
+        self._conns.pop(name, None)
+
+    async def discover(self) -> "list[SourceRef]":
+        await self.start()
+        return [
+            SourceRef(kind=self.kind, group=name, unit="peer",
+                      target=name, ephemeral=True)
+            for name in self._conns
+        ]
+
+    async def open_stream(self, ref: SourceRef,
+                          opts: LogOptions) -> SourceStream:
+        await _fire_fault("source.open", self.metrics, ref.group,
+                          ref.target)
+        pair = self._conns.get(ref.target)
+        if pair is None:
+            self.metrics.error()
+            raise SourceError(f"connection {ref.target} is gone")
+        return SocketStream(ref, pair[0], pair[1], metrics=self.metrics,
+                            source=self)
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        for _reader, writer in list(self._conns.values()):
+            writer.close()
+        self._conns.clear()
+        if self._unix_path is not None:
+            await asyncio.to_thread(self._unlink_stale, self._unix_path)
+            self._unix_path = None

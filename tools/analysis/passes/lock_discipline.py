@@ -109,6 +109,17 @@ SHARED_STATE: dict = {
         # from executor threads) may ever mutate them.
         "FanoutRunner": _decl("loop", None, "_streams", "_stopping"),
     },
+    "klogs_tpu/sources/archive.py": {
+        # The producer thread communicates ONLY through the bounded
+        # queue; _closed is flipped on the loop and merely read by the
+        # thread (a stale read costs one extra slab, never corruption).
+        "ArchiveStream": _decl("loop", None, "_closed"),
+    },
+    "klogs_tpu/sources/socket.py": {
+        # Connection registry: mutated by the asyncio accept callback
+        # and stream close, both on the loop.
+        "SocketSource": _decl("loop", None, "_conns"),
+    },
     "klogs_tpu/service/tenancy.py": {
         # The registry maps are mutated by async Register/evict
         # handlers on the loop but READ from sync banner/Hello paths

@@ -1,0 +1,276 @@
+"""Archive-backfill throughput bench (BENCH_BACKFILL.json).
+
+The acceptance measurement for PR 18's `--backfill` path: rotated,
+gzip-compressed archives driven through the FULL production pipeline —
+ArchiveSource producer threads (decompress → newline-aligned slabs →
+bounded read-ahead queue) → FanoutRunner → framing → coalescing async
+filter → gated FileSink writes — and, per K, sustained end-to-end
+lines/sec plus the continuous profiler's per-stage attribution.
+
+The row's ``source_bound`` field is the claim under test: with the
+decompressors fanned out across stream producer threads (zlib releases
+the GIL), the bottleneck attribution must land on an ENGINE stage, not
+``source.read`` — i.e. backfill feeds the engine at its real speed and
+the source abstraction costs nothing.
+
+    python tools/bench_backfill.py         # writes BENCH_BACKFILL.json
+
+Each K runs once per corpus codec ("gzip,plain" by default): the gzip
+rows price real rotated archives including inflate, the plain rows
+isolate the source/framing/engine path — on a single-core host inflate
+CPU is strictly additive to engine CPU (there is no second core to
+hide it behind), and the pair of rows makes that arithmetic visible.
+
+Env knobs (KLOGS_BENCH_* family): KLOGS_BENCH_BACKFILL_K ("1024"),
+KLOGS_BENCH_BACKFILL_LINES, KLOGS_BENCH_BACKFILL_STREAMS,
+KLOGS_BENCH_BACKFILL_BATCH, KLOGS_BENCH_BACKFILL_READAHEAD_MB,
+KLOGS_BENCH_BACKFILL_CODECS ("gzip,plain"), KLOGS_BENCH_REPEATS,
+KLOGS_BENCH_BACKFILL_OUT.
+"""
+
+import asyncio
+import gzip
+import json
+import multiprocessing
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import bench  # noqa: E402
+from klogs_tpu.cluster.types import LogOptions  # noqa: E402
+from klogs_tpu.filters.base import frame_lines  # noqa: E402
+from klogs_tpu.filters.sink import make_pipeline  # noqa: E402
+from klogs_tpu.obs import trace  # noqa: E402
+from klogs_tpu.obs.profiler import PROFILER  # noqa: E402
+from klogs_tpu.runtime.fanout import FanoutRunner, plan_source_jobs  # noqa: E402
+from klogs_tpu.sources.archive import ArchiveSource  # noqa: E402
+from klogs_tpu.utils.env import read as env_read  # noqa: E402
+
+DEFAULT_K = "1024"
+DEFAULT_LINES = 4_000_000
+DEFAULT_STREAMS = 8
+DEFAULT_BATCH = 8192
+DEFAULT_READAHEAD_MB = 8
+WARMUP_LINES = 160_000  # past the index re-tune threshold (~67k seen)
+
+
+def build_archives(root: str, lines: "list[bytes]", n_streams: int,
+                   codec: str) -> int:
+    """Write the corpus as ``n_streams`` rotated sets — two older
+    generations plus a plain live file per stream, the shape logrotate
+    leaves behind. ``codec`` gzips the rotated generations ("gzip") or
+    leaves them plain ("plain"). Returns total archive bytes."""
+    per = (len(lines) + n_streams - 1) // n_streams
+    total_bytes = 0
+    for s in range(n_streams):
+        chunk = lines[s * per:(s + 1) * per]
+        if not chunk:
+            continue
+        third = (len(chunk) + 2) // 3
+        parts = [chunk[:third], chunk[third:2 * third], chunk[2 * third:]]
+        base = os.path.join(root, f"app-{s:02d}.log")
+        for gen, part in zip((2, 1), parts[:2]):
+            if codec == "gzip":
+                path = f"{base}.{gen}.gz"
+                # Level 1: rotation compresses for space, not ratio —
+                # and the bench measures OUR decompress fan-out, not
+                # zlib's best-compression encode speed.
+                with gzip.open(path, "wb", compresslevel=1) as f:
+                    f.writelines(part)
+            else:
+                path = f"{base}.{gen}"
+                with open(path, "wb") as f:
+                    f.writelines(part)
+            total_bytes += os.path.getsize(path)
+        with open(base, "wb") as f:
+            f.writelines(parts[2])
+        total_bytes += os.path.getsize(base)
+    return total_bytes
+
+
+async def run_backfill(archive_dir: str, codec: str, k: int, n_lines: int,
+                       batch_lines: int, readahead_mb: int) -> dict:
+    patterns = bench.make_patterns(k)
+    out_dir = tempfile.mkdtemp(prefix="klogs-bench-backfill-out-")
+    pipeline = make_pipeline(patterns, "cpu", batch_lines=batch_lines)
+    # Warm the engine past its one-time costs (K=1024 DFA compile, the
+    # ~67k-line index re-tune) before the clock starts — same
+    # discipline as bench.py's warm pass; a real backfill amortizes
+    # these over the whole archive set anyway.
+    filt = pipeline.log_filter
+    if filt is not None:
+        warm = [ln.rstrip(b"\n") for ln in bench.make_lines(WARMUP_LINES)]
+        for i in range(0, len(warm), batch_lines):
+            payload, offsets, _ = frame_lines(warm[i:i + batch_lines])
+            filt.fetch_framed(filt.dispatch_framed(
+                payload, np.asarray(offsets, dtype=np.int32)))
+    source = ArchiveSource([archive_dir], readahead_mb=readahead_mb)
+    try:
+        await source.start()
+        jobs = plan_source_jobs(await source.discover(), out_dir)
+        await pipeline.start()
+        runner = FanoutRunner(None, "local", LogOptions(follow=False),
+                              sink_factory=pipeline.sink_factory,
+                              create_files=True, source=source)
+        before = PROFILER.tick() or {"stages": {}}
+        t0 = time.perf_counter()
+        results = await runner.run(jobs)
+        # The drain is part of the run: lines/sec counts bytes ON DISK,
+        # not bytes parked in the coalescer.
+        await pipeline.aclose()
+        dt = time.perf_counter() - t0
+        after = PROFILER.tick() or {"stages": {}}
+        errors = [r.error for r in results if r.error]
+        if errors:
+            raise SystemExit(f"bench_backfill: stream errors: {errors}")
+        s = pipeline.stats
+        if s.lines_in != n_lines:
+            raise SystemExit(f"bench_backfill: pipeline saw {s.lines_in} "
+                             f"of {n_lines} lines")
+        stages = {}
+        for name, st in after["stages"].items():
+            prev = before["stages"].get(name, {})
+            busy = st["busy_s"] - prev.get("busy_s", 0.0)
+            spans = st["spans"] - prev.get("spans", 0)
+            if spans <= 0:
+                continue
+            stages[name] = {"busy_s": round(busy, 4), "spans": spans,
+                            "utilization": round(busy / dt, 4)}
+        # The source runs one producer thread per stream, so its busy
+        # sum is spread over n_streams-way parallelism: "source-bound"
+        # means the producers themselves were (nearly) saturated, not
+        # that their summed busy beat a serial stage's. Capacity is
+        # what the producers could have delivered flat out.
+        src_busy = stages.get("source.read", {}).get("busy_s", 0.0)
+        n_streams = len(jobs)
+        src_frac = (src_busy / (n_streams * dt)) if dt else 0.0
+        src_capacity = (n_lines * n_streams / src_busy) if src_busy \
+            else float("inf")
+        source_bound = src_frac > 0.8
+        rest = {n: s for n, s in stages.items() if n != "source.read"}
+        bottleneck = ("source.read" if source_bound else
+                      max(rest, key=lambda n: rest[n]["busy_s"])
+                      if rest else None)
+        return {
+            "k": k,
+            "codec": codec,
+            "n_lines": n_lines,
+            "streams": len(jobs),
+            "batch_lines": batch_lines,
+            "readahead_mb": readahead_mb,
+            "lps": round(n_lines / dt, 1),
+            "wall_s": round(dt, 3),
+            "matched": s.lines_matched,
+            "shed": s.degraded_lines,
+            "stages": stages,
+            "bottleneck": bottleneck,
+            "source_busy_frac": round(src_frac, 4),
+            "source_capacity_lps": (round(src_capacity, 1)
+                                    if src_busy else None),
+            "source_bound": source_bound,
+        }
+    finally:
+        await source.close()
+        shutil.rmtree(out_dir, ignore_errors=True)
+
+
+def main() -> None:
+    ks = [int(x) for x in env_read("KLOGS_BENCH_BACKFILL_K",
+                                   DEFAULT_K).split(",") if x]
+    n_lines = int(env_read("KLOGS_BENCH_BACKFILL_LINES",
+                           str(DEFAULT_LINES)))
+    n_streams = int(env_read("KLOGS_BENCH_BACKFILL_STREAMS",
+                             str(DEFAULT_STREAMS)))
+    batch_lines = int(env_read("KLOGS_BENCH_BACKFILL_BATCH",
+                               str(DEFAULT_BATCH)))
+    readahead_mb = int(env_read("KLOGS_BENCH_BACKFILL_READAHEAD_MB",
+                                str(DEFAULT_READAHEAD_MB)))
+    codecs = [c for c in env_read("KLOGS_BENCH_BACKFILL_CODECS",
+                                  "gzip,plain").split(",") if c]
+    repeats = int(env_read("KLOGS_BENCH_REPEATS", "2"))
+
+    # On a single-core host (this bench records cpu_count for exactly
+    # this reason) the default 5ms GIL switch interval convoys the
+    # producer threads against the event loop: each thread holds the
+    # core for a full quantum while the others' queues run dry.
+    # Shortening it recovered ~25% end-to-end on the 1-core CI box and
+    # is noise on multi-core hosts.
+    sys.setswitchinterval(0.0005)
+
+    root = tempfile.mkdtemp(prefix="klogs-bench-backfill-arch-")
+    try:
+        t0 = time.perf_counter()
+        lines = bench.make_lines(n_lines)
+        total = len(lines)
+        dirs = {}
+        for codec in codecs:
+            d = os.path.join(root, codec)
+            os.makedirs(d, exist_ok=True)
+            arch_bytes = build_archives(d, lines, n_streams, codec)
+            dirs[codec] = d
+            print(f"bench_backfill: [{codec}] corpus {total:,} lines -> "
+                  f"{arch_bytes / 1e6:,.0f} MB of archives", file=sys.stderr)
+        del lines
+        print(f"bench_backfill: corpus built in "
+              f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+        # Span stream fully on: the attribution IS the measurement
+        # (and the honest one — the committed lps carries the
+        # profiler's cost, same discipline as bench_fleet rows).
+        trace.reset(1.0)
+        rows = []
+        for codec in codecs:
+            for k in ks:
+                best = None
+                for _ in range(repeats):
+                    PROFILER.reset()
+                    PROFILER.enable(1.0)
+                    row = asyncio.run(run_backfill(
+                        dirs[codec], codec, k, total, batch_lines,
+                        readahead_mb))
+                    PROFILER.reset()
+                    if best is None or row["lps"] > best["lps"]:
+                        best = row
+                rows.append(best)
+                print(f"bench_backfill: [{codec}] K={k} -> "
+                      f"{best['lps']:,.0f} l/s "
+                      f"bottleneck={best['bottleneck']} "
+                      f"source_bound={best['source_bound']}",
+                      file=sys.stderr)
+        trace.reset(None)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    payload = {
+        "metric": "archive backfill end-to-end lines/sec (rotated "
+                  "archive sets -> ArchiveSource producer threads -> fan-out -> "
+                  "framing -> coalescing cpu filter -> gated file "
+                  "writes), with per-stage attribution from the "
+                  "continuous profiler",
+        "unit": "lines/sec",
+        "corpus": "needle-finding synthetic pod logs, ~128B lines, "
+                  "rotated sets per codec (gzip -1 generations, and "
+                  "the same set uncompressed to isolate decompress "
+                  "cost from the source/engine path)",
+        "cpu_count": multiprocessing.cpu_count(),
+        "rows": rows,
+    }
+    out = env_read("KLOGS_BENCH_BACKFILL_OUT") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_BACKFILL.json")
+    with open(out, "w") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    print(json.dumps({"rows": len(rows),
+                      "lps": {r["codec"]: r["lps"] for r in rows},
+                      "source_bound": any(r["source_bound"] for r in rows),
+                      "out": out}))
+
+
+if __name__ == "__main__":
+    main()

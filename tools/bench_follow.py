@@ -10,6 +10,13 @@ wall-clock dwell time; run it by hand / from CI:
 
     python tools/bench_follow.py --pods 200 --seconds 60 --backend tpu
 
+``--source replay`` swaps the FakeCluster for the PR 18 replay source:
+the bench pre-writes one live log file per "pod" with the backlog,
+appends lines at the offered rate for the duration, and drives the app
+through ``--source replay:DIR`` — same pipeline, file-tail ingest
+instead of the cluster transport, so the FOLLOW_BENCH source=replay
+rows price the source abstraction at identical offered load.
+
 Env: KLOGS_FOLLOW_RATE_HZ per-stream line rate (default 100).
 """
 
@@ -38,26 +45,68 @@ def main() -> None:
                     default=None, help="patterns (default: 'failed')")
     ap.add_argument("--backlog-lines", type=int, default=50,
                     help="historical lines per container at start")
+    ap.add_argument("--source", choices=["fake", "replay"], default="fake",
+                    help="ingest path: FakeCluster follow streams, or "
+                    "live log files tailed via --source replay:DIR")
     ns = ap.parse_args()
     patterns = ns.match or ["failed"]
     rate = float(env_read("KLOGS_FOLLOW_RATE_HZ", "100"))
 
     out_dir = tempfile.mkdtemp(prefix="klogs-bench-follow-")
-    fc = FakeCluster.synthetic(
-        n_pods=ns.pods, n_containers=1,
-        lines_per_container=ns.backlog_lines,
-        follow_interval_s=1.0 / rate,
-    )
-    print(f"offered load: {ns.pods} streams x {rate:.0f} lines/s "
-          f"= {ns.pods * rate:,.0f} lines/s for {ns.seconds:.0f}s "
-          f"(+{ns.backlog_lines} backlog lines/stream); latency "
-          f"percentiles from FilterStats are end-to-end per batch, with "
-          f"queue vs device split printed when the async service runs")
+    fc = None
+    src_dir = None
     argv = ["-n", "default", "-a", "-f", "-p", out_dir,
             "--backend", ns.backend, "--stats"]
+    if ns.source == "replay":
+        src_dir = tempfile.mkdtemp(prefix="klogs-bench-follow-src-")
+        for s in range(ns.pods):
+            with open(os.path.join(src_dir, f"pod-{s:04d}.log"), "wb") as f:
+                for i in range(ns.backlog_lines):
+                    f.write(b"backlog line %d with nothing to see\n" % i)
+        argv += ["--source", f"replay:{src_dir}"]
+    else:
+        fc = FakeCluster.synthetic(
+            n_pods=ns.pods, n_containers=1,
+            lines_per_container=ns.backlog_lines,
+            follow_interval_s=1.0 / rate,
+        )
+    print(f"offered load: {ns.pods} streams x {rate:.0f} lines/s "
+          f"= {ns.pods * rate:,.0f} lines/s for {ns.seconds:.0f}s "
+          f"(+{ns.backlog_lines} backlog lines/stream, source={ns.source}); "
+          f"latency percentiles from FilterStats are end-to-end per batch, "
+          f"with queue vs device split printed when the async service runs")
     for p in patterns:
         argv += ["--match", p]
     opts = parse_args(argv)
+
+    async def writer(stop: asyncio.Event) -> None:
+        # Append at the offered rate across all files in ~20ms ticks —
+        # one buffered write per file per tick, which is how a real
+        # log-emitting fleet looks to the tailer (bursts, not a line
+        # at a time).
+        assert src_dir is not None
+        files = [open(os.path.join(src_dir, f"pod-{s:04d}.log"), "ab")
+                 for s in range(ns.pods)]
+        try:
+            tick = 0.02
+            per_tick = max(1, int(rate * tick))
+            seq = 0
+            while not stop.is_set():
+                t_next = time.perf_counter() + tick
+                for f in files:
+                    f.write(b"".join(
+                        b"tick line %d maybe failed maybe not\n" % (seq + i)
+                        for i in range(per_tick)))
+                    f.flush()
+                seq += per_tick
+                delay = t_next - time.perf_counter()
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                else:
+                    await asyncio.sleep(0)
+        finally:
+            for f in files:
+                f.close()
 
     async def run():
         stop = asyncio.Event()
@@ -67,6 +116,8 @@ def main() -> None:
             stop.set()
 
         asyncio.create_task(stopper())
+        if src_dir is not None:
+            asyncio.create_task(writer(stop))
         t0 = time.perf_counter()
         await app.run_async(opts, backend=fc, stop=stop)
         print(f"run returned {time.perf_counter() - t0 - ns.seconds:.1f}s "
