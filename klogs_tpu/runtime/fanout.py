@@ -560,50 +560,55 @@ class FanoutRunner:
         stop_task = asyncio.create_task(stop.wait()) if stop is not None else None
 
         try:
-            while True:
-                pending = [t for t in tasks if not t.done()]
-                if not pending and poller is None:
-                    break  # static plan fully drained
-                waiters = set(pending)
-                if stop_task is not None:
-                    waiters.add(stop_task)
+            try:
+                while True:
+                    pending = [t for t in tasks if not t.done()]
+                    if not pending and poller is None:
+                        break  # static plan fully drained
+                    waiters = set(pending)
+                    if stop_task is not None:
+                        waiters.add(stop_task)
+                    if poller is not None:
+                        waiters.add(poller)
+                    if not waiters:
+                        break
+                    done, _ = await asyncio.wait(
+                        waiters, return_when=asyncio.FIRST_COMPLETED)
+                    if stop_task is not None and stop_task in done:
+                        await self.stop()
+                        break
+                    if poller is not None and poller.done():
+                        # Normal exit = stop fired inside the poll loop;
+                        # the loop swallows per-iteration faults, so
+                        # anything else here is unexpected — surface it,
+                        # don't let the task die with an unretrieved
+                        # exception.
+                        exc = poller.exception()
+                        if exc is not None:
+                            term.warning(
+                                "pod discovery stopped unexpectedly: %s",
+                                exc)
+                        poller = None
+            finally:
                 if poller is not None:
-                    waiters.add(poller)
-                if not waiters:
-                    break
-                done, _ = await asyncio.wait(
-                    waiters, return_when=asyncio.FIRST_COMPLETED)
-                if stop_task is not None and stop_task in done:
-                    await self.stop()
-                    break
-                if poller is not None and poller.done():
-                    # Normal exit = stop fired inside the poll loop; the
-                    # loop swallows per-iteration faults, so anything
-                    # else here is unexpected — surface it, don't let
-                    # the task die with an unretrieved exception.
-                    exc = poller.exception()
-                    if exc is not None:
+                    self._stop_ev().set()
+                    try:
+                        await poller
+                    except Exception as e:
                         term.warning(
-                            "pod discovery stopped unexpectedly: %s", exc)
-                    poller = None
-        finally:
-            if poller is not None:
-                self._stop_ev().set()
-                try:
-                    await poller
-                except Exception as e:
-                    term.warning(
-                        "pod discovery stopped unexpectedly: %s", e)
-            if stop_task is not None:
-                stop_task.cancel()
-            PROFILER.remove_probe("fanout.active_streams", _streams_probe)
-        try:
+                            "pod discovery stopped unexpectedly: %s", e)
+                if stop_task is not None:
+                    stop_task.cancel()
+                PROFILER.remove_probe("fanout.active_streams",
+                                      _streams_probe)
             return await asyncio.gather(*tasks)
-        except Exception:
+        except BaseException:
             # A worker escalated (--on-filter-error=abort raising
-            # Unavailable): close every other stream and let the
-            # workers drain before the one clear error surfaces, so no
-            # task is destroyed pending at loop teardown.
+            # Unavailable) — or run() itself was cancelled, whether in
+            # the supervision wait above or mid-gather: close every
+            # other stream and let the workers drain before the
+            # error/cancellation surfaces, so no task is destroyed
+            # pending at loop teardown.
             await self.stop()
             await asyncio.gather(*tasks, return_exceptions=True)
             raise

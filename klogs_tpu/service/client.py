@@ -196,6 +196,9 @@ class RemoteFilterClient:
         # None until the first Hello; old servers (no "framed" key)
         # route match_framed through the legacy per-line Match.
         self._server_framed: bool | None = None
+        # Sync close() parks its channel-close task here; aclose()
+        # settles it so it can't outlive the client.
+        self._close_task: "asyncio.Task | None" = None
         # Multi-tenant registry state (docs/TENANCY.md): the set id the
         # server handed back at registration, attached to every match
         # RPC; the expected config is remembered so an evicted set can
@@ -426,6 +429,15 @@ class RemoteFilterClient:
         """Graceful shutdown: awaited from the pipeline so the channel
         closes before the event loop exits (a fire-and-forget task here
         leaks and warns under an exiting loop)."""
+        pending, self._close_task = self._close_task, None
+        if pending is not None:
+            # A prior sync close() parked its work here; settle it so
+            # the task can't outlive the client (and double-closing the
+            # channel below stays a no-op).
+            try:
+                await pending
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
         await self._channel.close()
 
     def close(self) -> None:

@@ -658,19 +658,24 @@ class FilterServer:
                 host=self.metrics_host, port=self.metrics_port)
             try:
                 self.metrics_port = await self._http.start()
-            except OSError as e:
-                # Unbindable metrics port: tear the already-started
-                # gRPC server down (serve()'s finally is not armed
-                # yet) and surface the friendly ValueError path.
+            except BaseException as e:
+                # Unbindable metrics port — or a cancellation landing
+                # mid-bind: tear the already-started gRPC server down
+                # (serve()'s finally is not armed yet). OSError gets
+                # the friendly ValueError path; everything else
+                # (CancelledError included) re-raises after teardown.
                 self._http = None
                 await self._server.stop(0)
                 if self.tenants is not None:
                     self.tenants.close()
                 if self._service is not None:
                     self._service.close()
-                raise ValueError(
-                    f"cannot bind metrics port "
-                    f"{self.metrics_host}:{self.metrics_port}: {e}") from e
+                if isinstance(e, OSError):
+                    raise ValueError(
+                        f"cannot bind metrics port "
+                        f"{self.metrics_host}:{self.metrics_port}: {e}"
+                    ) from e
+                raise
             # Readiness flips when the warmup batch lands — NOT here:
             # /readyz during the cold-start compile must answer 503
             # while /healthz already answers 200.
@@ -758,43 +763,52 @@ async def serve(patterns: list[str], backend: str, host: str, port: int,
     bound = await server.start()
     prof_stop: "asyncio.Event | None" = None
     prof_task: "asyncio.Task | None" = None
-    if PROFILER.enabled:
-        prof_stop = asyncio.Event()
-        prof_task = asyncio.get_running_loop().create_task(
-            PROFILER.run_ticker(prof_stop))
-    mode = "TLS" if server.tls_cert else "plaintext"
-    if server.tls_client_ca:
-        mode = "mTLS"
-    if server.auth_enabled:
-        mode += "+bearer"
-        if not server.tls_cert:
-            print("klogs filterd: WARNING bearer auth over plaintext sends "
-                  "the token in the clear; add --tls-cert/--tls-key on "
-                  "untrusted networks", flush=True)
-    where = (server.host if server.host.startswith("unix:")
-             else f"{server.host}:{bound}")
-    print(banner_line(server, where, mode), flush=True)
-    if server.metrics_port is not None:
-        print(f"klogs filterd: metrics on http://{server.metrics_host}:"
-              f"{server.metrics_port}/metrics (health: /healthz, "
-              "readiness: /readyz)", flush=True)
+    # Everything past start() runs under the stop() finally: a raise
+    # while printing the banner (or starting the profiler ticker) must
+    # not leak the bound listener or the ticker task.
     try:
+        if PROFILER.enabled:
+            prof_stop = asyncio.Event()
+            prof_task = asyncio.get_running_loop().create_task(
+                PROFILER.run_ticker(prof_stop))
+        mode = "TLS" if server.tls_cert else "plaintext"
+        if server.tls_client_ca:
+            mode = "mTLS"
+        if server.auth_enabled:
+            mode += "+bearer"
+            if not server.tls_cert:
+                print("klogs filterd: WARNING bearer auth over plaintext "
+                      "sends the token in the clear; add --tls-cert/"
+                      "--tls-key on untrusted networks", flush=True)
+        where = (server.host if server.host.startswith("unix:")
+                 else f"{server.host}:{bound}")
+        print(banner_line(server, where, mode), flush=True)
+        if server.metrics_port is not None:
+            print(f"klogs filterd: metrics on http://{server.metrics_host}:"
+                  f"{server.metrics_port}/metrics (health: /healthz, "
+                  "readiness: /readyz)", flush=True)
         await server.wait()
     finally:
-        await server.stop()
-        if prof_task is not None:
-            # Final tick lands inside run_ticker before it returns, so
-            # the JSONL stream ends with the complete picture.
-            if prof_stop is not None:
-                prof_stop.set()
-            try:
-                await prof_task
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
-                pass
-            PROFILER.set_json_path(None)
-        # A degrade trigger armed near shutdown may have no further
-        # local root span to ride — write it before the process exits
-        # (mirrors the collector-side teardown in app.py).
-        from klogs_tpu.obs import trace as _trace2
+        try:
+            await server.stop()
+        finally:
+            # Nested so a cancellation landing inside server.stop()
+            # still reaps the ticker instead of abandoning it.
+            if prof_task is not None:
+                # Final tick lands inside run_ticker before it
+                # returns, so the JSONL stream ends with the complete
+                # picture.
+                if prof_stop is not None:
+                    prof_stop.set()
+                try:
+                    await prof_task
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
+                PROFILER.set_json_path(None)
+            # A degrade trigger armed near shutdown may have no
+            # further local root span to ride — write it before the
+            # process exits (mirrors the collector-side teardown in
+            # app.py).
+            from klogs_tpu.obs import trace as _trace2
 
-        _trace2.RECORDER.flush()
+            _trace2.RECORDER.flush()

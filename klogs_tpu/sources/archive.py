@@ -138,8 +138,16 @@ class ArchiveStream(SourceStream):
         tail = b""  # carried partial last line (no newline yet)
         try:
             for path in self._members:
+                if self._closed:
+                    return
                 it = self._decompress(path)
                 while True:
+                    # Re-checked every slab, not only when a put blocks:
+                    # a drained-by-close() queue never fills, and without
+                    # this the producer would decompress the whole
+                    # archive after close() and outlive the join below.
+                    if self._closed:
+                        return
                     slab = None
                     # The span covers the actual source work (decompress
                     # + newline cut) so `source.read` busy answers
@@ -242,8 +250,23 @@ class ArchiveStream(SourceStream):
                 f"cannot read {path}: zstd support requires the "
                 "'zstandard' package", path=path) from None
         with open(path, "rb") as f:
-            with zstandard.ZstdDecompressor().stream_reader(f) as r:
-                while chunk := r.read(self._slab):
+            # read_across_frames: a rotated-then-appended archive is
+            # concatenated zstd frames (the same multi-member shape
+            # _gunzip handles for .gz); without it the reader stops
+            # silently at the first frame boundary.
+            with zstandard.ZstdDecompressor().stream_reader(
+                    f, read_across_frames=True) as r:
+                while True:
+                    try:
+                        chunk = r.read(self._slab)
+                    except zstandard.ZstdError as exc:
+                        off = f.tell()
+                        raise SourceError(
+                            f"corrupt or truncated zstd data in {path} "
+                            f"near compressed byte {off}: {exc}",
+                            path=path, offset=off) from exc
+                    if not chunk:
+                        return
                     yield chunk
 
     # -- consumer (event loop) ----------------------------------------
@@ -313,6 +336,13 @@ class ArchiveStream(SourceStream):
                     q.get_nowait()
             except queue.Empty:
                 pass
+        t = self._thread
+        if t is not None and t.is_alive():
+            # Join off-loop: the producer exits at its next _closed
+            # check (loop head, or a blocked put's 0.2s timeout), so
+            # this is bounded — without it the daemon thread keeps
+            # inflating into a dead queue past close().
+            await asyncio.to_thread(t.join, 2.0)
 
 
 class ArchiveSource(Source):

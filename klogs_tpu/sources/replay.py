@@ -125,13 +125,19 @@ class ReplayStream(SourceStream):
 
     def _open_file(self) -> None:
         f = open(self._path, "rb")
-        st = os.fstat(f.fileno())
-        pos = 0
-        prev = self._offsets.get(self._path)
-        if prev is not None and prev[0] == st.st_ino \
-                and prev[1] <= st.st_size:
-            pos = prev[1]
-        f.seek(pos)
+        try:
+            st = os.fstat(f.fileno())
+            pos = 0
+            prev = self._offsets.get(self._path)
+            if prev is not None and prev[0] == st.st_ino \
+                    and prev[1] <= st.st_size:
+                pos = prev[1]
+            f.seek(pos)
+        except BaseException:
+            # fstat/seek failing between open and ownership transfer
+            # would otherwise leak the fd into the poller thread.
+            f.close()
+            raise
         self._f, self._inode, self._pos = f, st.st_ino, pos
 
     def _step(self) -> "tuple[str, bytes]":

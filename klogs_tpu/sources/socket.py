@@ -64,6 +64,9 @@ class SocketStream(SourceStream):
                 data = await self._reader.read(READ_SIZE)
             except (ConnectionError, OSError) as exc:
                 self._metrics.error()
+                # Peer-error close only; on cancellation the listener
+                # still owns this stream, SocketSource.close() reaps it.
+                # klogs: ignore[cancel-safety] — owner reaps on cancel
                 await self.close()
                 raise SourceError(
                     f"socket peer {self._ref.group}: {exc}") from exc

@@ -811,9 +811,9 @@ def test_cli_docs_real_tree_clean():
 # -- second-generation suite (core dataflow + fleet-era passes) --------
 
 def test_pass_count_floor():
-    """The suite advertises >= 16 registered rules (acceptance gate);
+    """The suite advertises >= 18 registered rules (acceptance gate);
     keep the floor explicit so a dropped registration fails loudly."""
-    assert len(all_passes()) >= 16
+    assert len(all_passes()) >= 18
 
 
 def test_reaching_defs_basic_and_branches():
@@ -2195,3 +2195,352 @@ def test_native_tsan_gate():
                     f"{proc.stdout.strip().splitlines()[-1]}")
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "OK: native parity tests passed under TSan" in proc.stdout
+
+
+# -- exception-edge CFG (core layer) -----------------------------------
+
+def _cfg(src: str):
+    import ast
+
+    from tools.analysis.core import CFG
+
+    fn = ast.parse(textwrap.dedent(src)).body[0]
+    return CFG(fn), fn
+
+
+def test_cfg_exit_edge_kinds():
+    """An async body exposes every exit class: the await's cancel
+    edge, the call's raise escape, and the explicit returns."""
+    cfg, _ = _cfg("""
+        async def f(q):
+            x = await q.get()
+            if x is None:
+                return None
+            return x
+        """)
+    kinds = {k for _, k in cfg.exit_edges()}
+    assert "cancel" in kinds
+    assert "raise" in kinds
+    assert "return" in kinds
+
+
+def test_cfg_sync_functions_have_no_cancel_edges():
+    cfg, _ = _cfg("""
+        def f(q):
+            x = q.get()
+            return x
+        """)
+    assert not any(k == "cancel" for _, k in cfg.exit_edges())
+
+
+def test_cfg_catch_all_suppresses_the_raise_escape():
+    """`except BaseException` keeps the raise edge inside the try;
+    `except Exception` does not (KeyboardInterrupt still escapes)."""
+    caught, _ = _cfg("""
+        def f(p):
+            try:
+                g(p)
+            except BaseException:
+                return None
+            return 1
+        """)
+    assert not any(k == "raise" for _, k in caught.exit_edges())
+    escapes, _ = _cfg("""
+        def f(p):
+            try:
+                g(p)
+            except Exception:
+                return None
+            return 1
+        """)
+    assert any(k == "raise" for _, k in escapes.exit_edges())
+
+
+def test_cfg_while_true_has_no_false_edge():
+    cfg, fn = _cfg("""
+        def f(q):
+            while True:
+                v = q.pop()
+                if not v:
+                    break
+            return 1
+        """)
+    head = cfg.node_of(fn.body[0])
+    assert head is not None
+    assert all(k != "false" for _, k in cfg.succ(head))
+
+
+def test_cfg_cancel_edge_routes_through_finally():
+    """Every path out of the awaited body — cancel included — passes
+    the finally node; with no stop predicate the exit is reachable."""
+    cfg, fn = _cfg("""
+        async def f(res, q):
+            try:
+                await q.get()
+            finally:
+                res.close()
+        """)
+    try_stmt = fn.body[0]
+    aw = cfg.node_of(try_stmt.body[0])
+    closer = try_stmt.finalbody[0]
+    assert aw is not None
+    assert cfg.path_to_exit(aw, lambda n: n.stmt is closer) is None
+    assert cfg.path_to_exit(aw, lambda n: False) is not None
+
+
+def test_cfg_cached_per_function(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/c.py": """
+        def f():
+            return 1
+        """})
+    sf = SourceFile(root, "klogs_tpu/c.py")
+    fn = sf.index.functions[0].node
+    assert sf.cfg(fn) is sf.cfg(fn)
+
+
+# -- resource-lifecycle ------------------------------------------------
+
+def test_resource_lifecycle_fd_leak_on_raise_edge(tmp_path):
+    """h.read() can raise between open() and close(): the raise edge
+    exits with the fd live — exactly one finding."""
+    root = _tree(tmp_path, {"klogs_tpu/sources/leak.py": """
+        def slurp(path):
+            h = open(path, "rb")
+            data = h.read()
+            h.close()
+            return data
+        """})
+    found = _active(root, "resource-lifecycle")
+    assert len(found) == 1
+    assert "fd" in found[0].message and "raise" in found[0].message
+
+
+def test_resource_lifecycle_unjoined_stored_thread(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/runtime/pump.py": """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+
+            def _run(self):
+                pass
+        """})
+    found = _active(root, "resource-lifecycle")
+    assert len(found) == 1 and "self._t" in found[0].message
+
+    clean = _tree(tmp_path / "clean", {"klogs_tpu/runtime/pump.py": """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+
+            def _run(self):
+                pass
+
+            def stop(self):
+                self._t.join()
+        """})
+    assert _active(clean, "resource-lifecycle") == []
+
+
+def test_resource_lifecycle_task_leak_on_cancel_edge(tmp_path):
+    """Cancellation landing in `await other()` exits with the hedge
+    task still running; a finally that cancels it is clean."""
+    root = _tree(tmp_path, {"klogs_tpu/filters/hedge.py": """
+        import asyncio
+
+        async def hedged(work, other):
+            t = asyncio.create_task(work())
+            await other()
+            return await t
+        """})
+    found = _active(root, "resource-lifecycle")
+    assert len(found) == 1 and "task" in found[0].message
+
+    clean = _tree(tmp_path / "clean", {"klogs_tpu/filters/hedge.py": """
+        import asyncio
+
+        async def hedged(work, other):
+            t = asyncio.create_task(work())
+            try:
+                return await other()
+            finally:
+                t.cancel()
+        """})
+    assert _active(clean, "resource-lifecycle") == []
+
+
+def test_resource_lifecycle_span_open_on_early_return(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/obs/spanny.py": """
+        def traced(tracer, cond):
+            s = tracer.start_span("op")
+            if cond:
+                return None
+            s.end()
+            return None
+        """})
+    found = _active(root, "resource-lifecycle")
+    assert len(found) == 1
+    assert "span" in found[0].message and "return" in found[0].message
+
+
+def test_resource_lifecycle_clean_and_suppressed(tmp_path):
+    root = _tree(tmp_path, {
+        "klogs_tpu/sources/ok.py": """
+            def slurp(path):
+                with open(path, "rb") as h:
+                    return h.read()
+
+            def handoff(path, owner):
+                h = open(path, "rb")
+                owner.adopt(h)
+            """,
+        "klogs_tpu/sources/waived.py": """
+            def leaky(path):
+                h = open(path, "rb")  # klogs: ignore[resource-lifecycle]
+                return h.read()
+            """,
+    })
+    report = run(root, rules=["resource-lifecycle"])
+    assert report.active == []
+    assert len(report.suppressed) == 1
+
+
+# -- cancel-safety -----------------------------------------------------
+
+def test_cancel_safety_swallowed_in_loop(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/runtime/looper.py": """
+        import asyncio
+
+        async def pump(q):
+            while True:
+                try:
+                    item = await q.get()
+                except asyncio.CancelledError:
+                    pass
+        """})
+    found = _active(root, "cancel-safety")
+    assert len(found) == 1
+    assert "swallows CancelledError" in found[0].message
+
+
+def test_cancel_safety_teardown_idiom_waived(tmp_path):
+    """`t.cancel(); try: await t / except CancelledError: pass` is the
+    repo's teardown idiom — outside a loop it is not a finding."""
+    root = _tree(tmp_path, {"klogs_tpu/runtime/stopper.py": """
+        import asyncio
+
+        async def stop(t):
+            t.cancel()
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+        """})
+    assert _active(root, "cancel-safety") == []
+
+
+def test_cancel_safety_lock_held_across_cancel_edge(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/service/locky.py": """
+        async def update(lock, work):
+            await lock.acquire()
+            await work()
+            lock.release()
+        """})
+    found = _active(root, "cancel-safety")
+    assert len(found) == 1 and "lock.release()" in found[0].message
+
+    clean = _tree(tmp_path / "clean", {"klogs_tpu/service/locky.py": """
+        async def update(lock, work):
+            async with lock:
+                await work()
+        """})
+    assert _active(clean, "cancel-safety") == []
+
+
+def test_cancel_safety_cleanup_on_non_cancel_edge_only(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/service/conny.py": """
+        async def fetch(conn):
+            try:
+                return await conn.recv()
+            except Exception:
+                conn.close()
+                raise
+        """})
+    found = _active(root, "cancel-safety")
+    assert len(found) == 1 and "finally" in found[0].message
+
+    clean = _tree(tmp_path / "clean", {"klogs_tpu/service/conny.py": """
+        async def fetch(conn):
+            try:
+                return await conn.recv()
+            finally:
+                conn.close()
+        """})
+    assert _active(clean, "cancel-safety") == []
+
+
+def test_cancel_safety_suppression_honored(tmp_path):
+    root = _tree(tmp_path, {"klogs_tpu/service/waived.py": """
+        import asyncio
+
+        async def pump(q):
+            while True:
+                try:
+                    item = await q.get()
+                # klogs: ignore[cancel-safety] — deliberate drain
+                except asyncio.CancelledError:
+                    pass
+        """})
+    report = run(root, rules=["cancel-safety"])
+    assert report.active == []
+    assert len(report.suppressed) == 1
+
+
+# -- registry self-check + --list-rules --------------------------------
+
+def test_registry_self_check_rejects_drift():
+    from tools.analysis.passes import _self_check
+
+    passes = all_passes()  # the real registry passes its own check
+    with pytest.raises(RuntimeError, match="alphabetical"):
+        _self_check(list(reversed(passes)))
+    with pytest.raises(RuntimeError, match="duplicate"):
+        _self_check(passes + [passes[-1]])
+    with pytest.raises(RuntimeError, match="not registered"):
+        _self_check(passes[:-1])
+
+
+def test_list_rules_cli(capsys):
+    from tools.analysis.__main__ import main
+
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    rules = [ln.split()[0] for ln in out.splitlines() if ln.strip()]
+    assert rules == sorted(rules)
+    assert len(rules) >= 18
+    assert "resource-lifecycle" in rules and "cancel-safety" in rules
+
+
+def test_tier1_sarif_timings_budget_gate(tmp_path):
+    """The tier-1 invocation shape: ONE run over the repo writing
+    SARIF, printing per-pass timings, held to the 30s soft budget."""
+    import json as _json
+
+    sarif = tmp_path / "analysis.sarif"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analysis", "--sarif", str(sarif),
+         "--timings", "--budget-s", "30"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "WARNING" not in proc.stderr, proc.stderr
+    assert "resource-lifecycle" in proc.stdout
+    assert "cancel-safety" in proc.stdout
+    doc = _json.loads(sarif.read_text())
+    run0 = doc["runs"][0]
+    assert run0["invocations"][0]["executionSuccessful"] is True
+    assert len(run0["tool"]["driver"]["rules"]) >= 18

@@ -181,3 +181,36 @@ def test_plan_jobs_container_regex_filter(tmp_path):
         ("web", "setup", True), ("web", "nginx", False)]
     # No filter: everything (unchanged default).
     assert len(plan_jobs(pods, str(tmp_path), include_init=True)) == 3
+
+
+class TestCancelDrain:
+    def test_cancel_mid_follow_drains_workers(self, tmp_path):
+        """Cancelling run() itself (not via the stop event) must close
+        every stream and let the workers drain: no task left pending
+        at loop teardown, no stream left open (regression for the
+        cancellation edge found by the cancel-safety pass)."""
+        fc = make_cluster(follow_interval_s=0.001)
+        pods = run(fc.list_pods("default"))
+        jobs = plan_jobs(pods, str(tmp_path), include_init=False)
+        runner = FanoutRunner(fc, "default", LogOptions(follow=True))
+
+        async def drive():
+            task = asyncio.create_task(runner.run(jobs))
+            await asyncio.sleep(0.08)
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+            else:
+                raise AssertionError("cancellation was swallowed")
+
+        async def scenario():
+            await asyncio.wait_for(drive(), timeout=10)
+            assert runner._streams == []
+            leftovers = [t for t in asyncio.all_tasks()
+                         if t is not asyncio.current_task()
+                         and not t.done()]
+            assert leftovers == []
+
+        run(scenario())

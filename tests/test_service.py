@@ -431,3 +431,101 @@ def test_exclude_only_service():
             await server.stop()
 
     asyncio.run(run())
+
+
+class TestClientCloseTask:
+    def test_aclose_settles_a_parked_sync_close(self):
+        """close() under a running loop parks its work on
+        self._close_task; aclose() must settle it so the task cannot
+        outlive the client (regression for the resource-lifecycle
+        finding on the fire-and-forget close task)."""
+
+        class _FakeChannel:
+            def __init__(self):
+                self.closes = 0
+
+            async def close(self):
+                self.closes += 1
+
+        async def scenario():
+            c = object.__new__(RemoteFilterClient)
+            c._channel = _FakeChannel()
+            c._close_task = None
+            c.close()  # sync path: parks the channel close on a task
+            assert c._close_task is not None
+            await c.aclose()
+            assert c._close_task is None
+            assert c._channel.closes == 2  # parked close + aclose close
+            leftovers = [t for t in asyncio.all_tasks()
+                         if t is not asyncio.current_task()
+                         and not t.done()]
+            assert leftovers == []
+
+        asyncio.run(scenario())
+
+
+class TestServeTeardown:
+    """serve() must stop the bound listener on every exit path — a
+    raise after start() (banner printing) and a cancellation landing
+    in wait() (regressions for the resource-lifecycle findings on the
+    serve() teardown path)."""
+
+    class _FakeServer:
+        def __init__(self, *a, **kw):
+            self.stops = 0
+            self.tls_cert = None
+            self.tls_client_ca = None
+            self.auth_enabled = False
+            self.host = "127.0.0.1"
+            self.metrics_host = "127.0.0.1"
+            self.metrics_port = None
+            self.tenants = None
+            self.backend = "cpu"
+            self.patterns = ["x"]
+
+        async def start(self):
+            return 50051
+
+        async def stop(self):
+            self.stops += 1
+
+        async def wait(self):
+            await asyncio.Event().wait()
+
+    def _patch(self, monkeypatch):
+        from klogs_tpu.service import server as server_mod
+
+        made = []
+
+        def factory(*a, **kw):
+            s = self._FakeServer()
+            made.append(s)
+            return s
+
+        monkeypatch.setattr(server_mod, "FilterServer", factory)
+        return server_mod, made
+
+    def test_banner_raise_stops_server(self, monkeypatch):
+        server_mod, made = self._patch(monkeypatch)
+
+        def boom(*a):
+            raise RuntimeError("banner boom")
+
+        monkeypatch.setattr(server_mod, "banner_line", boom)
+        with pytest.raises(RuntimeError, match="banner boom"):
+            asyncio.run(server_mod.serve(["x"], "cpu", "127.0.0.1", 0))
+        assert [s.stops for s in made] == [1]
+
+    def test_cancel_during_wait_stops_server(self, monkeypatch):
+        server_mod, made = self._patch(monkeypatch)
+
+        async def scenario():
+            task = asyncio.create_task(
+                server_mod.serve(["x"], "cpu", "127.0.0.1", 0))
+            await asyncio.sleep(0.05)
+            task.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await task
+
+        asyncio.run(asyncio.wait_for(scenario(), timeout=10))
+        assert [s.stops for s in made] == [1]

@@ -33,8 +33,9 @@ def main(argv: "list[str] | None" = None) -> int:
                     help="also write findings as SARIF 2.1.0 to PATH "
                          "(CI inline annotations); exit-code semantics "
                          "unchanged")
-    ap.add_argument("--list", action="store_true", dest="list_rules",
-                    help="list registered rules and exit")
+    ap.add_argument("--list", "--list-rules", action="store_true",
+                    dest="list_rules",
+                    help="list registered rules (alphabetical) and exit")
     ap.add_argument("--timings", action="store_true",
                     help="print per-pass wall time (always present in "
                          "--json output as timings_s)")

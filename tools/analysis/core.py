@@ -22,6 +22,12 @@ checkers share:
   answering "which loads can this assignment's value reach?" — what
   the task-lifecycle pass uses to prove a ``create_task`` result is
   awaited/cancelled/stored rather than leaked.
+- :class:`CFG`: a per-function exception-edge-aware control-flow
+  graph (statement-granularity nodes; raise/return/break/continue
+  edges; every ``await`` carries a potential-cancellation exit;
+  ``with``/``finally`` coverage per node) — the third core layer,
+  shared by the resource-lifecycle and cancel-safety passes and
+  cached per function via :meth:`SourceFile.cfg`.
 - ``Pass``: one named rule (``rule`` id, ``doc`` rationale) producing
   ``Finding``s. Passes are registered in ``tools.analysis.passes``.
 - Suppressions: ``# klogs: ignore[rule-id]`` on the flagged line or the
@@ -473,6 +479,413 @@ class ReachingDefs:
         return env
 
 
+class CFGNode:
+    """One statement-granularity node of a :class:`CFG`."""
+
+    __slots__ = ("idx", "stmt", "line", "can_raise", "has_await",
+                 "in_finally", "withs")
+
+    def __init__(self, idx: int, stmt: ast.AST, line: int, *,
+                 can_raise: bool, has_await: bool, in_finally: bool,
+                 withs: "tuple[str, ...]"):
+        self.idx = idx
+        self.stmt = stmt
+        self.line = line
+        self.can_raise = can_raise    # any call/await/yield in the stmt
+        self.has_await = has_await    # a potential-cancellation point
+        self.in_finally = in_finally  # lexically inside a finally body
+        self.withs = withs            # dotted names of enclosing `with`s
+
+
+class _Fin:
+    """An active ``finally`` region during CFG construction: abrupt
+    edges raised inside the try route to ``entry``; when the Try
+    completes, ``exits`` (the finally body's dangling frontier) is
+    connected onward to every recorded continuation in ``conts``."""
+
+    __slots__ = ("entry", "exits", "conts")
+
+    def __init__(self, entry: int,
+                 exits: "list[tuple[int, str]]"):
+        self.entry = entry
+        self.exits = exits
+        # (kind, remaining outer-fin chain, final sink token)
+        self.conts: "list[tuple[str, tuple[_Fin, ...], tuple[Any, ...]]]" = []
+
+
+class _Loop:
+    __slots__ = ("head", "breaks", "fin_depth")
+
+    def __init__(self, head: int, fin_depth: int):
+        self.head = head
+        self.breaks: "list[tuple[int, str]]" = []
+        self.fin_depth = fin_depth
+
+
+class CFG:
+    """Exception-edge-aware control-flow graph for one function.
+
+    Statement-granularity nodes; edges carry a kind. Besides the
+    ordinary ``next``/``true``/``false``/``loop``/``case`` flow, every
+    statement that can raise (contains a call/await/yield, or is an
+    ``assert``/``raise``) gets a ``raise`` edge to each handler of the
+    nearest enclosing ``try`` *and* an abrupt ``raise`` path through
+    the enclosing ``finally`` chain to EXIT (handlers are matched
+    conservatively — an ``except Exception`` never catches
+    ``KeyboardInterrupt``, so the escape path is always real). In an
+    ``async def``, every await additionally gets a ``cancel`` edge:
+    cancellation routes through enclosing ``finally`` bodies to EXIT
+    but deliberately NOT into ``except`` handlers — on Python >= 3.8
+    ``CancelledError`` is a ``BaseException`` that ``except
+    Exception`` does not see, which is exactly the semantics the
+    cancel-safety pass leans on. ``return``/``break``/``continue``
+    route through intervening finallies likewise. A finally body's
+    exit frontier is connected to *every* recorded continuation (the
+    standard over-approximation), and to the normal fall-through only
+    when some normal path actually enters the finally.
+
+    Known over-approximations, accepted for lint purposes: unmatched
+    handlers still receive raise edges; ``while`` loops with a
+    non-constant test always have a false edge; a try-inside-finally
+    uses the inner region's first node as the finally entry.
+
+    Query with :meth:`succ` / :meth:`node_of` /
+    :meth:`path_to_exit`."""
+
+    EXIT = -1
+
+    def __init__(self, fn: "ast.FunctionDef | ast.AsyncFunctionDef"):
+        self.fn = fn
+        self.is_async = isinstance(fn, ast.AsyncFunctionDef)
+        self.nodes: "list[CFGNode]" = []
+        self.entry: "int | None" = None
+        self._succ: "dict[int, list[tuple[int, str]]]" = {}
+        self._node_of: "dict[int, int]" = {}
+        self._fins: "list[_Fin]" = []
+        self._loops: "list[_Loop]" = []
+        # (raiser node list, catch-all?) per active try-with-handlers
+        self._tries: "list[tuple[list[int], bool]]" = []
+        self._withs: "list[str]" = []
+        self._fin_depth = 0
+        tail = self._block(fn.body, [])
+        for src, _kind in tail:
+            self._edge(src, self.EXIT, "fall")
+
+    # -- queries ------------------------------------------------------
+
+    def succ(self, idx: int) -> "list[tuple[int, str]]":
+        return self._succ.get(idx, [])
+
+    def node_of(self, stmt: ast.AST) -> "int | None":
+        """Node index of a statement (identity keyed), None if the
+        statement placed no node (e.g. a bare ``try``)."""
+        return self._node_of.get(id(stmt))
+
+    def exit_edges(self) -> "list[tuple[int, str]]":
+        out = []
+        for src, edges in self._succ.items():
+            out.extend((src, kind) for dst, kind in edges
+                       if dst == self.EXIT)
+        return out
+
+    def path_to_exit(self, start: int,
+                     stop: "Any") -> "tuple[int, str] | None":
+        """BFS from ``start``'s successors; ``stop(node) -> bool``
+        halts traversal through a node (the obligation was met on that
+        path). Returns the ``(src_idx, kind)`` of the first EXIT edge
+        a surviving path reaches, else None. ``start``'s own exit
+        edges are skipped (an acquire that raises never produced the
+        resource)."""
+        seen = {start}
+        queue: "list[tuple[int, int, str]]" = [
+            (start, dst, kind) for dst, kind in self.succ(start)]
+        pos = 0
+        while pos < len(queue):
+            src, dst, kind = queue[pos]
+            pos += 1
+            if dst == self.EXIT:
+                if src == start:
+                    continue
+                return (src, kind)
+            if dst in seen:
+                continue
+            seen.add(dst)
+            if stop(self.nodes[dst]):
+                continue
+            queue.extend((dst, d2, k2) for d2, k2 in self.succ(dst))
+        return None
+
+    # -- construction -------------------------------------------------
+
+    def _edge(self, src: int, dst: int, kind: str) -> None:
+        self._succ.setdefault(src, []).append((dst, kind))
+
+    def _place(self, stmt: ast.AST, frontier: "list[tuple[int, str]]",
+               *, can_raise: bool, has_await: bool) -> int:
+        idx = len(self.nodes)
+        self.nodes.append(CFGNode(
+            idx, stmt, getattr(stmt, "lineno", 0),
+            can_raise=can_raise, has_await=has_await,
+            in_finally=self._fin_depth > 0, withs=tuple(self._withs)))
+        self._node_of[id(stmt)] = idx
+        if self.entry is None:
+            self.entry = idx
+        for src, kind in frontier:
+            self._edge(src, idx, kind)
+        return idx
+
+    @staticmethod
+    def _scan(*exprs: "ast.AST | None") -> "tuple[bool, bool]":
+        """(can_raise, has_await) over expressions. Calls, awaits and
+        yields can raise; nested def/lambda bodies are included (an
+        over-approximation that only widens the graph)."""
+        can_raise = has_await = False
+        for e in exprs:
+            if e is None:
+                continue
+            for n in ast.walk(e):
+                if isinstance(n, (ast.Call, ast.Await, ast.Yield,
+                                  ast.YieldFrom)):
+                    can_raise = True
+                if isinstance(n, ast.Await):
+                    has_await = True
+        return can_raise, has_await
+
+    def _abrupt(self, srcs: "list[int]", kind: str,
+                chain: "list[_Fin]",
+                sink: "tuple[Any, ...]") -> None:
+        """Route an abrupt edge through ``chain`` (innermost finally
+        first) toward ``sink``: ("exit",) | ("break", loop) |
+        ("continue", loop)."""
+        if not chain:
+            if sink[0] == "exit":
+                for src in srcs:
+                    self._edge(src, self.EXIT, kind)
+            elif sink[0] == "break":
+                sink[1].breaks.extend((src, kind) for src in srcs)
+            else:  # continue
+                for src in srcs:
+                    self._edge(src, sink[1].head, kind)
+            return
+        fin = chain[0]
+        for src in srcs:
+            self._edge(src, fin.entry, kind)
+        fin.conts.append((kind, tuple(chain[1:]), sink))
+
+    def _raise_and_cancel(self, idx: int, *, can_raise: bool,
+                          has_await: bool) -> None:
+        chain = list(reversed(self._fins))
+        if can_raise:
+            catch_all = False
+            if self._tries:
+                raisers, catch_all = self._tries[-1]
+                raisers.append(idx)
+            # A bare `except:` / `except BaseException` region lets
+            # nothing escape; anything narrower (incl. `except
+            # Exception`) leaves the raise edge out — KeyboardInterrupt
+            # and friends still walk it.
+            if not catch_all:
+                self._abrupt([idx], "raise", chain, ("exit",))
+        if has_await and self.is_async:
+            self._abrupt([idx], "cancel", chain, ("exit",))
+
+    def _block(self, stmts: "list[ast.stmt]",
+               frontier: "list[tuple[int, str]]",
+               ) -> "list[tuple[int, str]]":
+        for stmt in stmts:
+            frontier = self._stmt(stmt, frontier)
+        return frontier
+
+    def _stmt(self, stmt: ast.stmt,
+              frontier: "list[tuple[int, str]]",
+              ) -> "list[tuple[int, str]]":
+        if isinstance(stmt, ast.If):
+            cr, aw = self._scan(stmt.test)
+            idx = self._place(stmt, frontier, can_raise=cr,
+                              has_await=aw)
+            self._raise_and_cancel(idx, can_raise=cr, has_await=aw)
+            out = self._block(stmt.body, [(idx, "true")])
+            if stmt.orelse:
+                out += self._block(stmt.orelse, [(idx, "false")])
+            else:
+                out.append((idx, "false"))
+            return out
+
+        if isinstance(stmt, ast.While):
+            cr, aw = self._scan(stmt.test)
+            idx = self._place(stmt, frontier, can_raise=cr,
+                              has_await=aw)
+            self._raise_and_cancel(idx, can_raise=cr, has_await=aw)
+            loop = _Loop(idx, len(self._fins))
+            self._loops.append(loop)
+            body_f = self._block(stmt.body, [(idx, "true")])
+            for src, _k in body_f:
+                self._edge(src, idx, "loop")
+            self._loops.pop()
+            always = (isinstance(stmt.test, ast.Constant)
+                      and bool(stmt.test.value))
+            out = [] if always else [(idx, "false")]
+            if stmt.orelse:
+                out = self._block(stmt.orelse, out)
+            return out + loop.breaks
+
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            cr, aw = self._scan(stmt.iter, stmt.target)
+            cr = True  # advancing the iterator can raise
+            aw = aw or isinstance(stmt, ast.AsyncFor)
+            idx = self._place(stmt, frontier, can_raise=cr,
+                              has_await=aw)
+            self._raise_and_cancel(idx, can_raise=cr, has_await=aw)
+            loop = _Loop(idx, len(self._fins))
+            self._loops.append(loop)
+            body_f = self._block(stmt.body, [(idx, "true")])
+            for src, _k in body_f:
+                self._edge(src, idx, "loop")
+            self._loops.pop()
+            out = [(idx, "false")]
+            if stmt.orelse:
+                out = self._block(stmt.orelse, out)
+            return out + loop.breaks
+
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            exprs: "list[ast.AST | None]" = []
+            names: "list[str]" = []
+            for item in stmt.items:
+                exprs.append(item.context_expr)
+                exprs.append(item.optional_vars)
+                name = dotted(item.context_expr)
+                if item.optional_vars is not None:
+                    name = dotted(item.optional_vars) or name
+                if name:
+                    names.append(name)
+            cr, aw = self._scan(*exprs)
+            aw = aw or isinstance(stmt, ast.AsyncWith)
+            idx = self._place(stmt, frontier, can_raise=cr,
+                              has_await=aw)
+            self._raise_and_cancel(idx, can_raise=cr, has_await=aw)
+            self._withs.extend(names)
+            out = self._block(stmt.body, [(idx, "next")])
+            del self._withs[len(self._withs) - len(names):]
+            return out
+
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, frontier)
+
+        if isinstance(stmt, ast.Match):
+            cr, aw = self._scan(stmt.subject)
+            idx = self._place(stmt, frontier, can_raise=cr,
+                              has_await=aw)
+            self._raise_and_cancel(idx, can_raise=cr, has_await=aw)
+            out = [(idx, "nomatch")]
+            for case in stmt.cases:
+                out += self._block(case.body, [(idx, "case")])
+            return out
+
+        if isinstance(stmt, ast.Return):
+            cr, aw = self._scan(stmt.value)
+            idx = self._place(stmt, frontier, can_raise=cr,
+                              has_await=aw)
+            self._raise_and_cancel(idx, can_raise=cr, has_await=aw)
+            self._abrupt([idx], "return", list(reversed(self._fins)),
+                         ("exit",))
+            return []
+
+        if isinstance(stmt, ast.Raise):
+            idx = self._place(stmt, frontier, can_raise=True,
+                              has_await=False)
+            self._raise_and_cancel(idx, can_raise=True,
+                                   has_await=False)
+            return []
+
+        if isinstance(stmt, (ast.Break, ast.Continue)):
+            idx = self._place(stmt, frontier, can_raise=False,
+                              has_await=False)
+            if self._loops:
+                loop = self._loops[-1]
+                kind = ("break" if isinstance(stmt, ast.Break)
+                        else "continue")
+                chain = list(reversed(self._fins[loop.fin_depth:]))
+                self._abrupt([idx], kind, chain, (kind, loop))
+            return []
+
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Global, ast.Nonlocal,
+                             ast.Pass, ast.Import, ast.ImportFrom)):
+            idx = self._place(stmt, frontier, can_raise=False,
+                              has_await=False)
+            return [(idx, "next")]
+
+        # Simple statement: scan the whole thing.
+        cr, aw = self._scan(stmt)
+        cr = cr or isinstance(stmt, ast.Assert)
+        idx = self._place(stmt, frontier, can_raise=cr, has_await=aw)
+        self._raise_and_cancel(idx, can_raise=cr, has_await=aw)
+        return [(idx, "next")]
+
+    @staticmethod
+    def _catch_all(handlers: "list[ast.ExceptHandler]") -> bool:
+        for h in handlers:
+            if h.type is None:
+                return True
+            types = (h.type.elts if isinstance(h.type, ast.Tuple)
+                     else [h.type])
+            for t in types:
+                if dotted(t).split(".")[-1] == "BaseException":
+                    return True
+        return False
+
+    def _try(self, stmt: ast.Try,
+             frontier: "list[tuple[int, str]]",
+             ) -> "list[tuple[int, str]]":
+        fin: "_Fin | None" = None
+        if stmt.finalbody:
+            # Build the finally body eagerly (with only OUTER fins
+            # active) so abrupt edges inside the try have a target.
+            marker = len(self.nodes)
+            self._fin_depth += 1
+            fin_exits = self._block(stmt.finalbody, [])
+            self._fin_depth -= 1
+            fin = _Fin(marker, fin_exits)
+
+        raisers: "list[int]" = []
+        if stmt.handlers:
+            self._tries.append((raisers, self._catch_all(stmt.handlers)))
+        if fin is not None:
+            self._fins.append(fin)
+        body_f = self._block(stmt.body, frontier)
+        if stmt.handlers:
+            self._tries.pop()
+        # The else block runs after normal completion; exceptions
+        # there are NOT caught by this try's handlers.
+        else_f = (self._block(stmt.orelse, body_f)
+                  if stmt.orelse else body_f)
+        handler_f: "list[tuple[int, str]]" = []
+        for h in stmt.handlers:
+            hidx = self._place(h, [], can_raise=False,
+                               has_await=False)
+            for r in raisers:
+                self._edge(r, hidx, "raise")
+            handler_f += self._block(h.body, [(hidx, "except")])
+
+        if fin is None:
+            return else_f + handler_f
+
+        self._fins.pop()
+        normal = else_f + handler_f
+        for src, kind in normal:
+            self._edge(src, fin.entry, kind)
+        srcs = [s for s, _k in fin.exits]
+        done: "set[tuple[Any, ...]]" = set()
+        for kind, chain, sink in fin.conts:
+            key = (kind, tuple(id(c) for c in chain), sink[0],
+                   id(sink[1]) if len(sink) > 1 else 0)
+            if key in done:
+                continue
+            done.add(key)
+            self._abrupt(srcs, kind, list(chain), sink)
+        return list(fin.exits) if normal else []
+
+
 class SourceFile:
     """One parsed source file: text, AST (lazy), the cached
     :class:`ModuleIndex`, and the per-line suppression table."""
@@ -485,6 +898,7 @@ class SourceFile:
         self._tree: "ast.AST | None" = None
         self._index: "ModuleIndex | None" = None
         self._suppress: "dict[int, set[str]] | None" = None
+        self._cfgs: "dict[int, CFG]" = {}
 
     @property
     def tree(self) -> ast.AST:
@@ -501,6 +915,15 @@ class SourceFile:
         if self._index is None:
             self._index = ModuleIndex(self.tree)
         return self._index
+
+    def cfg(self, fn: "ast.FunctionDef | ast.AsyncFunctionDef") -> CFG:
+        """The cached exception-edge CFG for a function in this file
+        (identity keyed) — built once, shared between the
+        resource-lifecycle and cancel-safety passes."""
+        got = self._cfgs.get(id(fn))
+        if got is None:
+            got = self._cfgs[id(fn)] = CFG(fn)
+        return got
 
     def suppressions(self) -> dict[int, set[str]]:
         """Per-line ignore table, from COMMENT tokens only — a

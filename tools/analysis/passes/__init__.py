@@ -1,14 +1,21 @@
 """Pass registry: one instance of every registered invariant.
 
-Order is the report order for project-level (line-0) findings; keep
-the core invariants first, docs parity and the post-run suppression
-audit last.
+Registration is ALPHABETICAL BY RULE ID and self-checked: a pass
+module on disk that is not registered, or a registration that drifts
+out of order, raises at import time instead of silently shrinking the
+gate. Execution order does not matter — ``core.run`` pulls
+``run_post`` passes (the suppression audit) to the end itself and
+sorts findings for the report — so the list might as well be the one
+order a human can diff against ``--list-rules`` and the docs catalog.
 """
+
+import os
 
 
 def all_passes():
     from tools.analysis.passes.abi_conformance import AbiConformancePass
     from tools.analysis.passes.async_blocking import AsyncBlockingPass
+    from tools.analysis.passes.cancel_safety import CancelSafetyPass
     from tools.analysis.passes.cli_docs import CliDocsPass
     from tools.analysis.passes.dispatch_parity import DispatchParityPass
     from tools.analysis.passes.env_discipline import EnvDisciplinePass
@@ -19,6 +26,9 @@ def all_passes():
     )
     from tools.analysis.passes.metrics_docs import MetricsDocsPass
     from tools.analysis.passes.native_tier import NativeTierPass
+    from tools.analysis.passes.resource_lifecycle import (
+        ResourceLifecyclePass,
+    )
     from tools.analysis.passes.retry_discipline import RetryDisciplinePass
     from tools.analysis.passes.span_discipline import SpanDisciplinePass
     from tools.analysis.passes.suppression_audit import (
@@ -28,21 +38,51 @@ def all_passes():
     from tools.analysis.passes.traced_purity import TracedPurityPass
     from tools.analysis.passes.wire_tokens import WireTokensPass
 
-    return [
+    passes = [
+        AbiConformancePass(),
         AsyncBlockingPass(),
-        LockDisciplinePass(),
-        TracedPurityPass(),
+        CancelSafetyPass(),
+        CliDocsPass(),
         DispatchParityPass(),
+        EnvDisciplinePass(),
         Int32GuardPass(),
+        LockDisciplinePass(),
+        MetricCardinalityPass(),
+        MetricsDocsPass(),
+        NativeTierPass(),
+        ResourceLifecyclePass(),
         RetryDisciplinePass(),
         SpanDisciplinePass(),
-        EnvDisciplinePass(),
-        TaskLifecyclePass(),
-        WireTokensPass(),
-        MetricCardinalityPass(),
-        NativeTierPass(),
-        AbiConformancePass(),
-        MetricsDocsPass(),
-        CliDocsPass(),
         SuppressionAuditPass(),
+        TaskLifecyclePass(),
+        TracedPurityPass(),
+        WireTokensPass(),
     ]
+    _self_check(passes)
+    return passes
+
+
+def _self_check(passes) -> None:
+    """Fail LOUDLY on a drifted registry: unsorted registration, a
+    duplicate rule id, or a pass module on disk that no registered
+    pass comes from (the forgotten-import hole)."""
+    rules = [p.rule for p in passes]
+    if rules != sorted(rules):
+        raise RuntimeError(
+            "tools.analysis.passes: registration is not alphabetical "
+            f"by rule id: {rules}")
+    if len(set(rules)) != len(rules):
+        raise RuntimeError(
+            f"tools.analysis.passes: duplicate rule ids in {rules}")
+    here = os.path.dirname(os.path.abspath(__file__))
+    on_disk = {
+        f"{__name__}.{name[:-3]}"
+        for name in os.listdir(here)
+        if name.endswith(".py") and not name.startswith("_")}
+    registered = {type(p).__module__ for p in passes}
+    missing = sorted(on_disk - registered)
+    if missing:
+        raise RuntimeError(
+            "tools.analysis.passes: pass module(s) on disk but not "
+            f"registered in all_passes(): {', '.join(missing)} — an "
+            "unregistered pass silently shrinks the gate")

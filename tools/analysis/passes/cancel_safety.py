@@ -1,0 +1,261 @@
+"""cancel-safety: coroutine cancellation is an exit path, not an
+error — plumbing code must neither swallow it nor leak across it.
+
+On Python >= 3.8 ``asyncio.CancelledError`` is a ``BaseException``:
+``except Exception`` never sees it (the core CFG's cancel edges encode
+exactly that), but a bare ``except:``, ``except BaseException`` or an
+explicit ``except CancelledError`` that fails to re-raise eats the
+cancellation — under a drain or a kill the task just keeps going.
+Three rules over the plumbing scope, on the core's exception-edge
+:class:`~tools.analysis.core.CFG`:
+
+1. **Swallowed cancellation.** An ``except`` clause that catches
+   ``CancelledError`` (bare / ``BaseException`` / explicit / in a
+   tuple) without re-raising or returning is a finding when the try
+   sits inside a ``while`` or ``async for`` loop (the coroutine loops
+   on, uncancellable). Outside a loop the repo's cancel-and-await
+   teardown idiom — a try whose body is exactly one awaited
+   expression, ``try: await t / except ...: pass`` — is waived: the
+   coroutine is already on its way out and the swallow is the point.
+
+2. **Lock held across the cancel edge.** ``await x.acquire()`` whose
+   matching ``x.release()`` is not reached on every CFG exit path —
+   including the ``cancel`` edge out of each subsequent await — leaves
+   the lock held forever when cancellation lands mid-section. Use
+   ``async with x:`` (or release in a ``finally``).
+
+3. **Cleanup on the non-cancel edge only.** A try with no ``finally``
+   whose body awaits and whose ``except Exception``-or-narrower
+   handler performs cleanup (``.close()``/``.cancel()``/
+   ``.release()``/...) runs that cleanup on the error edge but not on
+   the cancellation edge — the handler never fires for
+   ``CancelledError``. Move the cleanup to a ``finally``.
+
+Waive deliberate sites with ``# klogs: ignore[cancel-safety]`` and a
+reason.
+"""
+
+import ast
+
+from tools.analysis.core import (
+    CFG,
+    Finding,
+    FuncInfo,
+    Pass,
+    Project,
+    SourceFile,
+    dotted,
+    own_nodes,
+)
+
+SCOPE = ("klogs_tpu/service", "klogs_tpu/runtime", "klogs_tpu/filters",
+         "klogs_tpu/sources", "klogs_tpu/cluster",
+         "klogs_tpu/resilience", "klogs_tpu/obs")
+
+# Handler types that catch CancelledError on Py3.10.
+_CANCEL_CATCHERS = {"CancelledError", "BaseException"}
+
+# Method names that look like teardown when they appear in an
+# exception handler (rule 3).
+_CLEANUP_ATTRS = {"close", "aclose", "cancel", "release", "shutdown",
+                  "stop", "end", "finish", "join", "terminate", "kill"}
+
+
+def _catches_cancel(h: ast.ExceptHandler) -> bool:
+    if h.type is None:
+        return True  # bare except
+    types = (h.type.elts if isinstance(h.type, ast.Tuple)
+             else [h.type])
+    return any(dotted(t).split(".")[-1] in _CANCEL_CATCHERS
+               for t in types)
+
+
+def _exception_or_narrower(h: ast.ExceptHandler) -> bool:
+    """A handler CancelledError will never enter (rule 3's shape)."""
+    return h.type is not None and not _catches_cancel(h)
+
+
+def _single_await_body(try_node: ast.Try) -> bool:
+    """``try: await t`` / ``try: res = await t`` — the cancel-and-await
+    teardown idiom."""
+    if len(try_node.body) != 1:
+        return False
+    stmt = try_node.body[0]
+    if isinstance(stmt, ast.Expr):
+        return isinstance(stmt.value, ast.Await)
+    if isinstance(stmt, ast.Assign):
+        return isinstance(stmt.value, ast.Await)
+    return False
+
+
+def _reraises(h: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, (ast.Raise, ast.Return))
+               for n in ast.walk(h))
+
+
+def _acquire_base(stmt: ast.stmt) -> "str | None":
+    """Dotted base of ``await <base>.acquire()`` statements."""
+    value: "ast.AST | None" = None
+    if isinstance(stmt, ast.Expr):
+        value = stmt.value
+    elif isinstance(stmt, ast.Assign):
+        value = stmt.value
+    if not isinstance(value, ast.Await):
+        return None
+    call = value.value
+    if (isinstance(call, ast.Call)
+            and isinstance(call.func, ast.Attribute)
+            and call.func.attr == "acquire"):
+        base = dotted(call.func.value)
+        return base or None
+    return None
+
+
+def _releases_base(stmt: ast.AST, base: str) -> bool:
+    for n in ast.walk(stmt):
+        if (isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "release"
+                and dotted(n.func.value) == base):
+            return True
+    return False
+
+
+class CancelSafetyPass(Pass):
+    rule = "cancel-safety"
+    doc = ("CancelledError is not swallowed in loops, locks are not "
+           "held across the cancel edge, cleanup is not confined to "
+           "the non-cancel edge")
+
+    def run(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for sf in project.files(*SCOPE):
+            for fn in sf.index.async_functions:
+                findings.extend(self._swallows(sf, fn))
+                findings.extend(self._held_locks(sf, fn))
+                findings.extend(self._one_sided_cleanup(sf, fn))
+        return findings
+
+    # -- rule 1: swallowed CancelledError -----------------------------
+
+    def _swallows(self, sf: SourceFile, fn: FuncInfo) -> list[Finding]:
+        findings: list[Finding] = []
+        for try_node, in_loop in self._tries(fn.node.body, False):
+            for h in try_node.handlers:
+                if not _catches_cancel(h) or _reraises(h):
+                    continue
+                if not in_loop and _single_await_body(try_node):
+                    continue  # cancel-and-await teardown idiom
+                what = ("bare except" if h.type is None
+                        else dotted(h.type) or "except")
+                where = ("inside a loop — the coroutine keeps looping "
+                         "through cancellation" if in_loop
+                         else "without re-raising")
+                findings.append(self.finding(
+                    sf.relpath, h.lineno,
+                    f"{fn.name}() swallows CancelledError "
+                    f"({what}) {where}: a drain/kill can no longer "
+                    "stop this task — re-raise after cleanup or "
+                    "narrow the handler to Exception"))
+        return findings
+
+    def _tries(self, stmts: "list[ast.stmt]", in_loop: bool,
+               ) -> "list[tuple[ast.Try, bool]]":
+        """(try, lexically-inside-while-or-async-for) pairs, nested
+        defs excluded. ``for`` over a finite collection terminates on
+        its own and is not counted as a loop here."""
+        out: "list[tuple[ast.Try, bool]]" = []
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            here = in_loop or isinstance(stmt, (ast.While, ast.AsyncFor))
+            if isinstance(stmt, ast.Try):
+                out.append((stmt, in_loop))
+                out += self._tries(stmt.body, here)
+                for h in stmt.handlers:
+                    out += self._tries(h.body, here)
+                out += self._tries(stmt.orelse, here)
+                out += self._tries(stmt.finalbody, here)
+                continue
+            for block in ("body", "orelse", "finalbody", "cases"):
+                sub = getattr(stmt, block, None)
+                if block == "cases" and sub:
+                    for case in sub:
+                        out += self._tries(case.body, here)
+                elif isinstance(sub, list):
+                    out += self._tries(sub, here)
+            for h in getattr(stmt, "handlers", []) or []:
+                out += self._tries(h.body, here)
+        return out
+
+    # -- rule 2: lock held across the cancel edge ---------------------
+
+    def _held_locks(self, sf: SourceFile, fn: FuncInfo) -> list[Finding]:
+        findings: list[Finding] = []
+        if fn.name in ("__aenter__", "acquire"):
+            # Context-manager protocol / delegation: the acquire is
+            # the point, release lives in __aexit__ (or the caller).
+            return findings
+        cfg: "CFG | None" = None
+        for stmt in own_nodes(fn.node):
+            if not isinstance(stmt, (ast.Expr, ast.Assign)):
+                continue
+            base = _acquire_base(stmt)
+            if base is None:
+                continue
+            if cfg is None:
+                cfg = sf.cfg(fn.node)
+            start = cfg.node_of(stmt)
+            if start is None:
+                continue
+            g = cfg
+            hit = cfg.path_to_exit(
+                start, lambda node: _releases_base(node.stmt, base))
+            if hit is None:
+                continue
+            src, kind = hit
+            findings.append(self.finding(
+                sf.relpath, stmt.lineno,
+                f"{fn.name}() awaits {base}.acquire() but the {kind} "
+                f"edge at line {g.nodes[src].line} exits without "
+                f"{base}.release(): cancellation mid-section leaves "
+                f"the lock held forever — use `async with {base}:` "
+                "or release in a finally"))
+        return findings
+
+    # -- rule 3: cleanup reachable only on the non-cancel edge --------
+
+    def _one_sided_cleanup(self, sf: SourceFile,
+                           fn: FuncInfo) -> list[Finding]:
+        findings: list[Finding] = []
+        for try_node, _ in self._tries(fn.node.body, False):
+            if try_node.finalbody:
+                continue
+            body_awaits = any(
+                isinstance(n, ast.Await)
+                for s in try_node.body for n in ast.walk(s))
+            if not body_awaits:
+                continue
+            for h in try_node.handlers:
+                if not _exception_or_narrower(h):
+                    continue
+                cleanup = next(
+                    (n for s in h.body for n in ast.walk(s)
+                     if isinstance(n, ast.Call)
+                     and isinstance(n.func, ast.Attribute)
+                     and n.func.attr in _CLEANUP_ATTRS
+                     # A real resource has a name/attr receiver;
+                     # b"".join(...) does not.
+                     and dotted(n.func.value)), None)
+                if cleanup is None:
+                    continue
+                target = dotted(cleanup.func)
+                findings.append(self.finding(
+                    sf.relpath, cleanup.lineno,
+                    f"{fn.name}() runs {target}() only in an except "
+                    "handler CancelledError never enters (the try "
+                    "body awaits, there is no finally): the "
+                    "cancellation edge skips this cleanup — move it "
+                    "to a finally"))
+        return findings

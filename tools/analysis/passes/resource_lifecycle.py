@@ -1,0 +1,302 @@
+"""resource-lifecycle: every acquired resource is released on every
+exit path — including the exception and cancellation edges.
+
+PR 18 built a subsystem (``klogs_tpu/sources/``) almost entirely out
+of leak-prone resources: rotation fds, producer threads, bounded
+readahead queues, socket connections. The suite's review-bug lineage
+(fd-leak-on-flush-error in PR 5, hedge-loser task leaks in PR 6) is
+exactly the class this pass encodes as an invariant, on top of the
+core's exception-edge :class:`~tools.analysis.core.CFG`.
+
+The declared ``RESOURCES`` table (the ``SHARED_STATE`` idiom from
+lock-discipline) maps acquire call shapes to their release methods.
+Two rules:
+
+1. **Local acquires.** ``name = <acquire>(...)`` must, on every CFG
+   path out of the function — the ordinary fall/return edges, every
+   ``raise`` edge, and the ``cancel`` edge out of each await — reach a
+   release (``name.close()``, ``await name`` for tasks, ``with name``)
+   or escape to an owner first (returned, yielded, stored on an
+   attribute, passed as a call argument, captured by a nested def, or
+   consulted by a guard — any load that is not a bare method-receiver
+   counts as a handoff). A path that reaches EXIT with the resource
+   live is a finding naming the acquire line and the escaping edge.
+   Bare-expression acquires are task-lifecycle's discard rule and are
+   not re-flagged here.
+
+2. **Stored acquires.** ``self.attr = <acquire>(...)`` escapes rule 1
+   into an ownership obligation: *some* method of the class must
+   release it — call a release method on ``self.attr``, await it,
+   ``with`` it, alias it, or pass it onward (a teardown registry, an
+   executor, ``asyncio.to_thread(self.attr.join, ...)``). A stored
+   resource no method ever releases is how PR 18's producer thread
+   survived ``close()``.
+
+Waive a deliberate leak with ``# klogs: ignore[resource-lifecycle]``
+and a reason.
+"""
+
+import ast
+
+from tools.analysis.core import (
+    CFG,
+    Finding,
+    FuncInfo,
+    Pass,
+    Project,
+    SourceFile,
+    dotted,
+    own_nodes,
+)
+
+SCOPE = ("klogs_tpu/sources", "klogs_tpu/runtime", "klogs_tpu/filters",
+         "klogs_tpu/service", "klogs_tpu/obs")
+
+
+class _Resource:
+    __slots__ = ("kind", "acquires", "releases", "release_funcs",
+                 "await_releases")
+
+    def __init__(self, kind: str, acquires: "tuple[str, ...]",
+                 releases: "tuple[str, ...]", *,
+                 release_funcs: "tuple[str, ...]" = (),
+                 await_releases: bool = False):
+        self.kind = kind
+        self.acquires = acquires       # dotted suffixes of acquire calls
+        self.releases = releases       # method names that release
+        self.release_funcs = release_funcs  # funcs taking it as an arg
+        self.await_releases = await_releases  # `await x` releases x
+
+
+# acquire→release pairs over the plumbing scope. Suffix-matched like
+# _SPAWN_SITES: "open" matches both `open(...)` and `gzip.open(...)`.
+RESOURCES: "tuple[_Resource, ...]" = (
+    _Resource("fd", ("open", "fdopen", "socket.socket"),
+              ("close", "detach"), release_funcs=("os.close",)),
+    _Resource("task", ("create_task", "ensure_future"),
+              ("cancel",), await_releases=True),
+    _Resource("thread", ("threading.Thread", "Thread"),
+              ("join",)),
+    _Resource("span", ("start_span",),
+              ("end", "finish")),
+    _Resource("executor", ("ThreadPoolExecutor", "ProcessPoolExecutor"),
+              ("shutdown",)),
+    _Resource("server", ("start_server", "start_unix_server"),
+              ("close",)),
+    _Resource("process", ("subprocess.Popen", "Popen"),
+              ("wait", "communicate", "terminate", "kill")),
+)
+
+
+def _acquire_of(value: "ast.AST | None") -> "_Resource | None":
+    """The RESOURCES entry a call expression acquires, unwrapping one
+    ``await``; None for anything that is not a tracked acquire."""
+    if isinstance(value, ast.Await):
+        value = value.value
+    if not isinstance(value, ast.Call):
+        return None
+    spelled = dotted(value.func)
+    if not spelled and isinstance(value.func, ast.Attribute):
+        spelled = value.func.attr  # loop().create_task(...) shapes
+    for res in RESOURCES:
+        for acq in res.acquires:
+            if spelled == acq or spelled.endswith("." + acq):
+                return res
+    return None
+
+
+def _node_exprs(stmt: ast.AST) -> "list[ast.AST | None]":
+    """The expressions a CFG node actually evaluates — compound
+    statements contribute only their header (their bodies are separate
+    nodes); a nested def contributes its whole body (a closure
+    capturing the resource is an escape)."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter, stmt.target]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        out: "list[ast.AST | None]" = []
+        for item in stmt.items:
+            out.append(item.context_expr)
+            out.append(item.optional_vars)
+        return out
+    if isinstance(stmt, ast.Match):
+        return [stmt.subject]
+    if isinstance(stmt, ast.ExceptHandler):
+        return [stmt.type]
+    return [stmt]
+
+
+def _settles(stmt: ast.AST, name: str, res: _Resource) -> bool:
+    """True when this node releases ``name`` per ``res`` or lets it
+    escape to an owner. A load of ``name`` that is merely the receiver
+    of a non-release method call (``t.start()``) is neither."""
+    receivers: "set[int]" = set()
+    for e in _node_exprs(stmt):
+        if e is None:
+            continue
+        for n in ast.walk(e):
+            if isinstance(n, ast.Call):
+                func = n.func
+                if (isinstance(func, ast.Attribute)
+                        and isinstance(func.value, ast.Name)
+                        and func.value.id == name):
+                    if func.attr in res.releases:
+                        return True
+                    receivers.add(id(func.value))
+                if dotted(func) in res.release_funcs and any(
+                        isinstance(a, ast.Name) and a.id == name
+                        for a in n.args):
+                    return True
+            elif (isinstance(n, ast.Await)
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id == name and res.await_releases):
+                return True
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            ctx = item.context_expr
+            if isinstance(ctx, ast.Name) and ctx.id == name:
+                return True  # `with name:` releases on block exit
+    for e in _node_exprs(stmt):
+        if e is None:
+            continue
+        for n in ast.walk(e):
+            if (isinstance(n, ast.Name) and n.id == name
+                    and isinstance(n.ctx, ast.Load)
+                    and id(n) not in receivers):
+                return True  # escape: returned/stored/passed/guarded
+    return False
+
+
+def _self_attr(node: ast.AST) -> "str | None":
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class ResourceLifecyclePass(Pass):
+    rule = "resource-lifecycle"
+    doc = ("acquired resources (fd/task/thread/span/executor/server) "
+           "are released on every CFG exit path incl. cancellation, "
+           "or escape to an owner")
+
+    def run(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for sf in project.files(*SCOPE):
+            findings.extend(self._check_file(sf))
+        return findings
+
+    def _check_file(self, sf: SourceFile) -> list[Finding]:
+        findings: list[Finding] = []
+        by_class: "dict[str, list[FuncInfo]]" = {}
+        for fn in sf.index.functions:
+            if fn.cls is not None:
+                by_class.setdefault(fn.cls, []).append(fn)
+            findings.extend(self._check_local(sf, fn))
+        for cls, fns in by_class.items():
+            findings.extend(self._check_stored(sf, cls, fns))
+        return findings
+
+    # -- rule 1: local acquires over the CFG --------------------------
+
+    def _check_local(self, sf: SourceFile, fn: FuncInfo) -> list[Finding]:
+        findings: list[Finding] = []
+        cfg: "CFG | None" = None
+        for stmt in own_nodes(fn.node):
+            if not (isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)):
+                continue
+            res = _acquire_of(stmt.value)
+            if res is None:
+                continue
+            name = stmt.targets[0].id
+            if cfg is None:
+                cfg = sf.cfg(fn.node)
+            start = cfg.node_of(stmt)
+            if start is None:
+                continue
+            g = cfg
+            hit = cfg.path_to_exit(
+                start, lambda node: _settles(node.stmt, name, res))
+            if hit is None:
+                continue
+            src, kind = hit
+            at = g.nodes[src].line
+            how = " or ".join(f".{r}()" for r in res.releases)
+            if res.await_releases:
+                how += " or await"
+            findings.append(self.finding(
+                sf.relpath, stmt.lineno,
+                f"{fn.name}() acquires {res.kind} {name!r} here but "
+                f"the {kind} edge at line {at} exits without {how}: "
+                "release on every path (try/finally, with) or hand "
+                "it to an owner"))
+        return findings
+
+    # -- rule 2: stored acquires need a releasing method --------------
+
+    def _check_stored(self, sf: SourceFile, cls: str,
+                      fns: "list[FuncInfo]") -> list[Finding]:
+        acquired: "dict[str, tuple[_Resource, int, str]]" = {}
+        for fn in fns:
+            for stmt in own_nodes(fn.node):
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                res = _acquire_of(stmt.value)
+                if res is None:
+                    continue
+                for t in stmt.targets:
+                    attr = _self_attr(t)
+                    if attr is not None and attr not in acquired:
+                        acquired[attr] = (res, stmt.lineno, fn.name)
+        if not acquired:
+            return []
+
+        released: "set[str]" = set()
+        for fn in fns:
+            for n in ast.walk(fn.node):
+                if isinstance(n, ast.Call):
+                    func = n.func
+                    if isinstance(func, ast.Attribute):
+                        attr = _self_attr(func.value)
+                        if (attr in acquired
+                                and func.attr in acquired[attr][0].releases):
+                            released.add(attr)  # self.x.close()
+                    for arg in list(n.args) + [kw.value for kw in n.keywords]:
+                        for sub in ast.walk(arg):
+                            attr = _self_attr(sub)
+                            if attr in acquired:
+                                released.add(attr)  # handed onward
+                elif isinstance(n, ast.Await):
+                    for sub in ast.walk(n.value):
+                        attr = _self_attr(sub)
+                        if (attr in acquired
+                                and acquired[attr][0].await_releases):
+                            released.add(attr)
+                elif isinstance(n, (ast.With, ast.AsyncWith)):
+                    for item in n.items:
+                        attr = _self_attr(item.context_expr)
+                        if attr in acquired:
+                            released.add(attr)
+                elif isinstance(n, ast.Assign):
+                    for sub in ast.walk(n.value):
+                        attr = _self_attr(sub)
+                        if attr in acquired:
+                            released.add(attr)  # aliased out
+
+        findings: list[Finding] = []
+        for attr, (res, line, in_fn) in sorted(acquired.items()):
+            if attr in released:
+                continue
+            how = "/".join(res.releases)
+            findings.append(self.finding(
+                sf.relpath, line,
+                f"{cls}.{in_fn} stores a {res.kind} in self.{attr} "
+                f"but no method of {cls} ever calls .{how}() on it"
+                + (", awaits it," if res.await_releases else "")
+                + " or hands it off — it outlives every teardown "
+                "path"))
+        return findings
