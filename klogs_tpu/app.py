@@ -299,7 +299,9 @@ def make_pipeline_for(opts: Options, registry=None):
                              ignore_case=opts.ignore_case,
                              exclude=opts.exclude, registry=registry,
                              on_filter_error=opts.on_filter_error,
-                             shard_mode=opts.shard_mode)
+                             shard_mode=opts.shard_mode,
+                             resolver=opts.resolver,
+                             kubeconfig=opts.kubeconfig or None)
     except _re.error as e:
         term.fatal("invalid --match/--exclude pattern %r: %s", e.pattern, e)
     except RegexSyntaxError as e:
@@ -531,6 +533,8 @@ async def _run_async_inner(
             PROFILER.bind_registry(obs_registry)
         prof_stop: asyncio.Event | None = None
         prof_task: asyncio.Task | None = None
+        tune_stop: asyncio.Event | None = None
+        tune_task: asyncio.Task | None = None
         # Resilience observability rides the same per-run registry:
         # fault firings, kube retry attempts (the backend exists before
         # the registry, hence the late bind), breaker state (bound in
@@ -555,6 +559,34 @@ async def _run_async_inner(
             if pipeline is not None:
                 await pipeline.start()  # remote: verify patterns up front
                 pipeline.inner_factory = inner_factory
+                # KLOGS_TUNE=auto: the adaptive operating-point
+                # controller (ops/tune.py) drives the coalescer/
+                # in-flight knobs from live /profile signals. Off by
+                # default — nothing is even constructed, so fixed-flag
+                # behavior stays byte-identical.
+                from klogs_tpu.ops.tune import maybe_controller
+
+                try:
+                    ctrl = maybe_controller(pipeline.service,
+                                            registry=obs_registry)
+                except ValueError as e:
+                    term.fatal("%s", e)
+                if ctrl is not None:
+                    if not PROFILER.enabled and not PROFILER.enable():
+                        term.warning(
+                            "KLOGS_TUNE=auto needs profiler signals but "
+                            "KLOGS_PROFILE_SAMPLE=0 disables them; the "
+                            "controller will hold the fixed flags")
+                    elif prof_task is None:
+                        # Tuning enabled the profiler itself: it still
+                        # needs the ticker for live samples.
+                        if obs_registry is not None:
+                            PROFILER.bind_registry(obs_registry)
+                        prof_stop = asyncio.Event()
+                        prof_task = asyncio.create_task(
+                            PROFILER.run_ticker(prof_stop))
+                    tune_stop = asyncio.Event()
+                    tune_task = asyncio.create_task(ctrl.run(tune_stop))
             runner = FanoutRunner(
                 backend, namespace, log_opts,
                 sink_factory=(pipeline.sink_factory if pipeline
@@ -745,6 +777,13 @@ async def _run_async_inner(
             # Close inside the loop even on error/Ctrl-C paths — an
             # unawaited grpc channel or in-flight batch task would be
             # destroyed pending at loop teardown.
+            if tune_task is not None:
+                if tune_stop is not None:
+                    tune_stop.set()
+                try:
+                    await tune_task
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
             if prof_task is not None:
                 # run_ticker's final tick completes the JSONL stream
                 # before the task returns.

@@ -46,6 +46,11 @@ New (north-star) flags, absent from the reference:
   --shard-mode      multi-endpoint --remote routing: round-robin
                     (rotate per batch) | hash (pin by pattern-set
                     fingerprint on a consistent-hash ring)
+  --resolver        live fleet membership for --remote: KIND:SPEC
+                    (static:HOST:PORT[,...] | file:/path | dns:HOST:PORT
+                    | kube:NAMESPACE/NAME[:PORT]); polled on
+                    KLOGS_RESOLVER_INTERVAL_S, joiners verified before
+                    their first batch (docs/RESILIENCE.md)
   --on-filter-error what to do when the filter service is unavailable
                     after retries: pass | drop | abort (default abort;
                     see docs/RESILIENCE.md)
@@ -102,6 +107,7 @@ class Options:
     backend: str = "cpu"
     remote: str | None = None
     shard_mode: str = "round-robin"
+    resolver: str | None = None
     on_filter_error: str = "abort"
     stats: bool = False
     metrics_port: int | None = None
@@ -231,6 +237,18 @@ def build_parser() -> argparse.ArgumentParser:
         "pattern-set fingerprint to one owner on a consistent-hash "
         "ring (hash; maximizes the owner's coalescer/compile-cache "
         "locality, keys move minimally when an endpoint dies)",
+    )
+    p.add_argument(
+        "--resolver",
+        default=None,
+        metavar="KIND:SPEC",
+        help="Live fleet membership for the filterd tier: "
+        "static:HOST:PORT[,...], file:/path (one endpoint per line, "
+        "re-read each poll), dns:HOST:PORT (re-resolve every "
+        "A/AAAA record), or kube:NAMESPACE/NAME[:PORT] (watch an "
+        "Endpoints object). Joining endpoints pass the pattern-set "
+        "handshake before their first batch; --remote (optional "
+        "with this flag) is only the initial seed",
     )
     p.add_argument(
         "--on-filter-error",
@@ -419,6 +437,7 @@ def parse_args(argv: list[str] | None = None) -> Options:
         backend=ns.backend,
         remote=ns.remote,
         shard_mode=ns.shard_mode,
+        resolver=ns.resolver,
         on_filter_error=ns.on_filter_error,
         stats=ns.stats,
         metrics_port=ns.metrics_port,
@@ -504,7 +523,18 @@ def main(argv: list[str] | None = None) -> int:
         if not opts.source.startswith("replay:"):
             term.warning("--replay-rate only applies to a replay "
                          "source; ignoring")
-    if opts.shard_mode != "round-robin" and (
+    if opts.resolver is not None:
+        from klogs_tpu.service.resolver import split_spec
+
+        try:
+            split_spec(opts.resolver)
+        except ValueError as e:
+            term.error("%s", e)
+            return 1
+        if not opts.match and not opts.exclude:
+            term.warning("--resolver without --match/--exclude builds "
+                         "no filter pipeline; ignoring")
+    if opts.shard_mode != "round-robin" and opts.resolver is None and (
             opts.remote is None or "," not in opts.remote):
         # One endpoint is below the routing layer entirely (the plain
         # client is used) — say so rather than silently dropping the

@@ -220,6 +220,37 @@ class KubeBackend(ClusterBackend):
             return []
         return [_pod_info(item, namespace) for item in data.get("items", [])]
 
+    async def endpoint_addresses(
+        self, namespace: str, name: str
+    ) -> "list[tuple[str, int | None]]":
+        """Ready (ip, port) pairs from the named Endpoints object — the
+        kube membership resolver's data source (service/resolver.py).
+        Rides _get_json, so it inherits the shared RetryPolicy, the
+        one-shot 401 token refresh, and the friendly ClusterError
+        boundary. The ``resolver.watch`` fault point fires one layer
+        up (resolver.py wraps every poll uniformly across kinds), so
+        chaos scripts hit this path without double-counting. A missing
+        Endpoints object resolves to an empty list (the service may
+        not exist YET during a rollout — membership policy, including
+        the refuse-to-empty guard, lives client-side in
+        shard.apply_membership). Ports: one advertised port per subset
+        is attached to its addresses; an ambiguous multi-port subset
+        yields None (the --resolver spec must pin a port)."""
+        data = await self._get_json(
+            f"/api/v1/namespaces/{namespace}/endpoints/{name}")
+        if data is None:
+            return []
+        out: "list[tuple[str, int | None]]" = []
+        for subset in data.get("subsets") or []:
+            ports = [p.get("port") for p in subset.get("ports") or []
+                     if isinstance(p.get("port"), int)]
+            port = ports[0] if len(ports) == 1 else None
+            for addr in subset.get("addresses") or []:
+                ip = addr.get("ip")
+                if ip:
+                    out.append((str(ip), port))
+        return out
+
     async def open_log_stream(
         self, namespace: str, pod: str, opts: LogOptions
     ) -> LogStream:

@@ -135,6 +135,54 @@ class AsyncFilterService:
         self._tasks: set[asyncio.Task] = set()
         self.batches_dispatched = 0  # for tests / stats
 
+    @property
+    def coalesce_lines(self) -> int:
+        return self._coalesce_lines
+
+    @property
+    def max_in_flight(self) -> int:
+        return self._max_in_flight
+
+    def apply_tuning(self, coalesce_lines: "int | None" = None,
+                     max_in_flight: "int | None" = None) -> None:
+        """Adopt a new operating point (ops/tune.py AdaptiveController).
+        Coalesce sizing applies from the next enqueue; in-flight depth
+        resizes the semaphore LIVE — an increase releases fresh permits
+        immediately, a decrease absorbs permits in the background as
+        in-flight batches retire (work already dispatched is never
+        cancelled). Values are trusted: the controller validates and
+        bounds them against the committed operating surface."""
+        if coalesce_lines is not None:
+            self._coalesce_lines = int(coalesce_lines)
+        if max_in_flight is None:
+            return
+        new = int(max_in_flight)
+        delta = new - self._max_in_flight
+        if delta == 0:
+            return
+        self._max_in_flight = new
+        sem = self._sem
+        if sem is None:
+            return  # not yet created: first dispatch builds it at `new`
+        if delta > 0:
+            for _ in range(delta):
+                sem.release()
+            return
+
+        async def _absorb(n: int = -delta) -> None:
+            # Permits always return as groups retire, so this settles
+            # once the pipeline drains to the new depth; aclose gathers
+            # it after the group tasks for the same reason. Acquire-
+            # and-HOLD is the point (capacity shrinks for good), and
+            # the semaphore dies with the service, so a cancelled
+            # absorb strands nothing.
+            for _ in range(n):
+                await sem.acquire()  # klogs: ignore[cancel-safety] — hold is intentional, sem dies with service
+
+        task = asyncio.get_running_loop().create_task(_absorb())
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
     def _in_flight_used(self) -> float:
         """Occupied in-flight dispatch slots (0 before first dispatch
         creates the semaphore)."""

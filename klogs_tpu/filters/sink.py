@@ -482,14 +482,16 @@ def make_pipeline(patterns: list[str], backend: str,
                   exclude: list[str] | None = None,
                   registry=None,
                   on_filter_error: str = "abort",
-                  shard_mode: str = "round-robin") -> FilterPipeline:
+                  shard_mode: str = "round-robin",
+                  resolver: str | None = None,
+                  kubeconfig: str | None = None) -> FilterPipeline:
     # ``registry`` (an obs.Registry) shares the stats backing store
     # with a /metrics sidecar or --stats-json dump; None keeps the
     # pipeline's numbers private (default, and what tests rely on).
     stats = FilterStats(registry=registry)
     service = None
     exclude = exclude or []
-    if remote is not None:
+    if remote is not None or resolver is not None:
         from klogs_tpu.service.client import RemoteFilterClient
         from klogs_tpu.service.shard import (
             DEFAULT_HEDGE_S,
@@ -512,10 +514,29 @@ def make_pipeline(patterns: list[str], backend: str,
         # docs/RESILIENCE.md). Zero/negative would DEADLINE_EXCEED
         # every attempt with an error that never names the env var.
         rpc_timeout_s = _env_positive_float("KLOGS_REMOTE_TIMEOUT_S", 30.0)
-        targets = parse_endpoints(remote)
+        # --resolver: live membership (service/resolver.py). --remote
+        # (when also given) is only the seed; the resolver's snapshots
+        # take over from the first poll. A resolver alone may start
+        # with an EMPTY seed — the first poll fills the fleet.
+        live_resolver = None
+        if resolver is not None:
+            from klogs_tpu.service.resolver import make_resolver
+
+            try:
+                live_resolver = make_resolver(resolver,
+                                              kubeconfig=kubeconfig)
+            except ValueError as e:
+                from klogs_tpu.service.client import ServiceConfigError
+
+                raise ServiceConfigError(str(e)) from None
+        targets = parse_endpoints(remote) if remote is not None else []
         from klogs_tpu.resilience import FAULTS
 
         stray = FAULTS.armed_targets() - set(targets)
+        if stray and live_resolver is not None:
+            # With live membership the fleet is open-ended: a targeted
+            # clause naming a future joiner is legitimate chaos.
+            stray = set()
         if stray:
             # A targeted chaos clause naming an endpoint outside the
             # fleet can never fire — one typoed digit and the chaos run
@@ -534,9 +555,11 @@ def make_pipeline(patterns: list[str], backend: str,
             auth_token_file=env_read("KLOGS_REMOTE_TOKEN_FILE"),
             rpc_timeout_s=rpc_timeout_s,
             registry=registry)
-        if len(targets) == 1:
+        if len(targets) == 1 and live_resolver is None:
             # Single endpoint: the plain client, byte-identical to the
-            # pre-shard behavior (no hedge tasks, no prober).
+            # pre-shard behavior (no hedge tasks, no prober). With a
+            # resolver even a single seed takes the sharded tier — the
+            # fleet can grow past it.
             service = RemoteFilterClient(targets[0], **common)
         else:
             # A fleet: the sharded tier (docs/RESILIENCE.md, "Sharded
@@ -551,6 +574,7 @@ def make_pipeline(patterns: list[str], backend: str,
                                             DEFAULT_HEDGE_S),
                 probe_interval_s=_env_positive_float(
                     "KLOGS_READYZ_INTERVAL_S", DEFAULT_PROBE_INTERVAL_S),
+                resolver=live_resolver,
                 **common)
         return FilterPipeline(
             log_filter=None,

@@ -359,6 +359,33 @@ SPECS: dict[str, dict] = {
         "gauge", "Endpoint readiness as last observed by the /readyz "
         "prober (1 ready, 0 draining or unreachable).",
         labels=("endpoint",), bounds={"endpoint": "config"}),
+    "klogs_shard_endpoint_weight": _m(
+        "gauge", "Effective routing weight (headroom-learned, "
+        "staleness-decayed toward 1.0) the weighted round-robin "
+        "actually uses for each endpoint right now.",
+        labels=("endpoint",), bounds={"endpoint": "config"}),
+    "klogs_fleet_membership_events_total": _m(
+        "counter", "Live-membership changes applied by the endpoint "
+        "resolver: add (endpoint joined, unverified), remove "
+        "(endpoint retired), error (poll failed or snapshot rejected "
+        "— fleet kept as-is).", labels=("action",),
+        bounds={"action": "enum"}),
+    "klogs_fleet_membership_size": _m(
+        "gauge", "Endpoints currently in the sharded client's fleet "
+        "(verified or not; quarantined endpoints still count until "
+        "the resolver removes them)."),
+
+    # -- adaptive tuning (ops/tune.py AdaptiveController) -------------
+    "klogs_tune_steps_total": _m(
+        "counter", "Operating-point adjustments the adaptive "
+        "controller applied, by parameter (coalesce_lines, "
+        "max_in_flight) and direction (up, down).",
+        labels=("param", "direction"),
+        bounds={"param": "enum", "direction": "enum"}),
+    "klogs_tune_value": _m(
+        "gauge", "Current value of each controller-managed parameter "
+        "(equals the fixed flag value while KLOGS_TUNE=off).",
+        labels=("param",), bounds={"param": "enum"}),
 
     # -- tenancy (multi-set registry, service/tenancy.py) -------------
     # The `set` label is a pattern-set fingerprint: bounded by the

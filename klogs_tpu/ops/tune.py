@@ -16,9 +16,11 @@ Hooked in two places:
 - bench.py / operators run ``tune_grouped`` explicitly (KLOGS_BENCH_TUNE=1).
 """
 
+import asyncio
 import json
 import os
 import time
+from typing import Any, Callable
 
 CANDIDATE_TILES = (1024, 2048, 4096, 8192)
 CANDIDATE_INTERLEAVE = (1, 2)
@@ -40,12 +42,13 @@ def _cache_path() -> str:
     return os.path.join(base, "klogs_tpu", "tune.json")
 
 
-def _key(dp, batch_shape, device_kind: str) -> str:
+def _key(dp: Any, batch_shape: Any, device_kind: str) -> str:
     G = dp.follow.shape[0]
     return f"{device_kind}|G{G}|S{dp.n_states}|C{dp.n_classes}|B{batch_shape[0]}x{batch_shape[1]}"
 
 
-def load_cached(dp, batch_shape, device_kind: str) -> dict | None:
+def load_cached(dp: Any, batch_shape: Any,
+                device_kind: str) -> "dict | None":
     try:
         with open(_cache_path()) as f:
             return json.load(f).get(_key(dp, batch_shape, device_kind))
@@ -53,7 +56,8 @@ def load_cached(dp, batch_shape, device_kind: str) -> dict | None:
         return None
 
 
-def _store(dp, batch_shape, device_kind: str, cfg: dict) -> None:
+def _store(dp: Any, batch_shape: Any, device_kind: str,
+           cfg: dict) -> None:
     path = _cache_path()
     os.makedirs(os.path.dirname(path), exist_ok=True)
     try:
@@ -66,10 +70,11 @@ def _store(dp, batch_shape, device_kind: str, cfg: dict) -> None:
         json.dump(all_cfg, f, indent=1)
 
 
-def tune_grouped(dp, live: int, acc: int, batch, lengths,
+def tune_grouped(dp: Any, live: int, acc: int, batch: Any, lengths: Any,
                  repeats: int = 3, n_flight: int = 6,
-                 runner=None, quiet: bool = False, cls=None,
-                 registry=None) -> dict:
+                 runner: "Callable[..., float] | None" = None,
+                 quiet: bool = False, cls: Any = None,
+                 registry: Any = None) -> dict:
     """Sweep the candidate grid on the live device; returns the winning
     {"tile_b", "interleave", "lines_per_s"} and caches it.
 
@@ -89,7 +94,8 @@ def tune_grouped(dp, live: int, acc: int, batch, lengths,
 
     B = batch.shape[0] if cls is None else cls.shape[0]
 
-    def default_runner(tile_b: int, interleave: int, **variant) -> float:
+    def default_runner(tile_b: int, interleave: int,
+                       **variant: Any) -> float:
         # Non-divisor tiles are fine: the kernel wrapper pads the batch
         # up to a tile multiple internally.
         if cls is not None:
@@ -242,3 +248,280 @@ def kernel_kwargs(on_hardware: bool) -> dict:
     """chain_selection()'s kwargs alone, for callers that manage their
     own variant sweep (bench tools)."""
     return chain_selection(on_hardware)[0]
+
+
+# -- adaptive operating point (collector-side controller) --------------
+#
+# The kernel autotuner above picks a KERNEL config offline; the
+# controller below adjusts the PIPELINE's operating point online —
+# coalescer group sizing and device in-flight depth — from the live
+# /profile signals (queue depth, in-flight occupancy, bottleneck).
+# It is deliberately conservative: bounded multiplicative steps,
+# consecutive-tick hysteresis with a cooldown after every move, and
+# hard floor/ceiling anchored to the committed OPERATING_POINT.json
+# surface. KLOGS_TUNE=off (the default) means the controller is never
+# constructed — fixed-flag behavior, byte-identical.
+
+DEFAULT_TUNE_INTERVAL_S = 5.0
+DEFAULT_TUNE_STEP = 0.5  # fractional step: up = x(1+step), down = /(1+step)
+_TUNE_UP_AFTER = 2    # consecutive pressure ticks before stepping up
+_TUNE_DOWN_AFTER = 4  # consecutive idle ticks before stepping down
+                      # (down > up: shedding capacity needs more proof)
+_TUNE_COOLDOWN = 2    # quiet ticks after ANY step — the pipeline must
+                      # show the new point's behavior before we judge it
+
+
+def tune_mode() -> str:
+    """KLOGS_TUNE: ``off`` (default; fixed flags, no controller built)
+    or ``auto``. Anything else fails loudly — a typoed mode silently
+    running fixed flags would be the worst kind of knob."""
+    from klogs_tpu.utils.env import read as env_read
+
+    raw = env_read("KLOGS_TUNE")
+    mode = (raw or "off").strip().lower()
+    if mode not in ("off", "auto"):
+        raise ValueError(
+            f"KLOGS_TUNE must be 'off' or 'auto', got {raw!r}")
+    return mode
+
+
+def operating_surface() -> "dict[str, tuple[int, int]]":
+    """Measured (min, max) per controller parameter from the committed
+    OPERATING_POINT.json batch x n_flight sweep — the hard envelope the
+    controller may roam. Empty dict when the file is absent (a deployed
+    package): bounds then collapse to the initial flag values, i.e. the
+    controller can hold but never move."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        os.pardir, "OPERATING_POINT.json")
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    batches: "list[int]" = []
+    flights: "list[int]" = []
+    try:
+        for entry in doc:
+            for run in entry.get("runs", []):
+                b, nf = run.get("batch"), run.get("n_flight")
+                if isinstance(b, int) and not isinstance(b, bool):
+                    batches.append(b)
+                if isinstance(nf, int) and not isinstance(nf, bool):
+                    flights.append(nf)
+    except (TypeError, AttributeError):
+        return {}
+    out: "dict[str, tuple[int, int]]" = {}
+    if batches:
+        out["coalesce_lines"] = (min(batches), max(batches))
+    if flights:
+        out["max_in_flight"] = (min(flights), max(flights))
+    return out
+
+
+class AdaptiveController:
+    """Close the loop between /profile and the pipeline's knobs.
+
+    Decision policy per tick (one ``step_once`` on the live profile
+    doc):
+
+    - *pressure* — in-flight slots saturated with callers queued, or
+      the coalescer backlog exceeding a full group: after
+      ``_TUNE_UP_AFTER`` consecutive pressure ticks, step the binding
+      parameter UP one bounded multiplicative step.
+    - *idle* — in-flight occupancy under a quarter of depth with an
+      empty coalescer: after ``_TUNE_DOWN_AFTER`` consecutive idle
+      ticks, step back DOWN toward the flag values (latency recovery).
+    - anything else resets both streaks; every applied step starts a
+      ``_TUNE_COOLDOWN``-tick quiet period. Together these are the
+      hysteresis: a signal oscillating tick-to-tick moves nothing.
+
+    Bounds per parameter are ``[min(initial, surface_min),
+    max(initial, surface_max)]`` from :func:`operating_surface` — the
+    controller can roam the measured envelope and can always return to
+    the operator's flags, but never invents an unmeasured regime.
+
+    ``service`` duck-types ``coalesce_lines`` / ``max_in_flight``
+    read properties and ``apply_tuning(coalesce_lines=, max_in_flight=)``
+    (filters/async_service.py). Mutated fields (streaks, cooldown,
+    current values) are only touched from ``run``'s single task —
+    loop-confined, no lock.
+    """
+
+    PARAMS = ("coalesce_lines", "max_in_flight")
+
+    def __init__(self, service: Any, *,
+                 registry: Any = None,
+                 profile_fn: "Callable[[], dict] | None" = None,
+                 interval_s: "float | None" = None,
+                 step: "float | None" = None,
+                 surface: "dict[str, tuple[int, int]] | None" = None
+                 ) -> None:
+        from klogs_tpu.utils.env import positive_float
+
+        self._service = service
+        if profile_fn is None:
+            from klogs_tpu.obs.profiler import PROFILER
+
+            profile_fn = PROFILER.profile_doc
+        self._profile_fn = profile_fn
+        self._interval_s = (interval_s if interval_s is not None
+                            else positive_float("KLOGS_TUNE_INTERVAL_S",
+                                                DEFAULT_TUNE_INTERVAL_S))
+        self._step = (step if step is not None
+                      else positive_float("KLOGS_TUNE_STEP",
+                                          DEFAULT_TUNE_STEP))
+        self.values: "dict[str, int]" = {
+            "coalesce_lines": int(service.coalesce_lines),
+            "max_in_flight": int(service.max_in_flight),
+        }
+        surf = operating_surface() if surface is None else surface
+        self.bounds: "dict[str, tuple[int, int]]" = {}
+        for param, initial in self.values.items():
+            lo, hi = surf.get(param, (initial, initial))
+            self.bounds[param] = (min(initial, lo), max(initial, hi))
+        self._press = 0
+        self._idle = 0
+        self._cooldown = 0
+        self.steps_applied = 0  # for tests / soak assertions
+        self._m_steps: Any = None
+        self._m_value: Any = None
+        if registry is not None:
+            self._m_steps = registry.family("klogs_tune_steps_total")
+            self._m_value = registry.family("klogs_tune_value")
+            for param, value in self.values.items():
+                self._m_value.labels(param=param).set(value)
+
+    async def _apply(self, param: str, new: int,
+                     direction: str) -> None:
+        self.values[param] = new
+        self._service.apply_tuning(**{param: new})
+        self.steps_applied += 1
+        self._press = 0
+        self._idle = 0
+        self._cooldown = _TUNE_COOLDOWN
+        if self._m_steps is not None:
+            self._m_steps.labels(param=param, direction=direction).inc()
+        if self._m_value is not None:
+            self._m_value.labels(param=param).set(new)
+        from klogs_tpu.ui import term
+
+        term.info("tune: %s %s -> %d (operating-point controller)",
+                  param, direction, new)
+
+    async def _step_up(self, param: str) -> bool:
+        cur = self.values[param]
+        hi = self.bounds[param][1]
+        if cur >= hi:
+            return False
+        new = min(hi, max(cur + 1, int(cur * (1.0 + self._step))))
+        await self._apply(param, new, "up")
+        return True
+
+    async def _step_down(self, param: str) -> bool:
+        cur = self.values[param]
+        lo = self.bounds[param][0]
+        if cur <= lo:
+            return False
+        new = max(lo, min(cur - 1, int(cur / (1.0 + self._step))))
+        await self._apply(param, new, "down")
+        return True
+
+    async def step_once(self, doc: dict
+                        ) -> "tuple[str, str] | None":
+        """One control decision from one profile snapshot. Returns the
+        (param, direction) applied, or None (held). A pure state
+        machine over the doc — directly testable without a pipeline —
+        kept async so every mutation stays event-loop-confined (the
+        lock-discipline contract for controller state)."""
+        if not doc.get("enabled"):
+            return None  # no signals, no opinion — hold the point
+        samples = doc.get("samples") or {}
+
+        def sample(name: str) -> float:
+            v = samples.get(name)
+            return float(v) if isinstance(v, (int, float)) else 0.0
+
+        depth = sample("coalescer.queue_depth")
+        pending = sample("coalescer.pending_lines")
+        used = sample("device.in_flight_used")
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return None
+        flight = self.values["max_in_flight"]
+        group = self.values["coalesce_lines"]
+        # Pressure: the dispatch pipe is the wall (all slots busy AND
+        # callers queued behind it), or groups overflow before the
+        # kick (a full group's worth pending — bigger groups amortize
+        # the per-dispatch fixed cost, the OPERATING_POINT.json fit).
+        press_flight = used >= flight - 0.5 and depth > 0
+        press_group = pending >= group
+        idle = (used <= max(1.0, 0.25 * flight)
+                and depth <= 0 and pending < 0.25 * group)
+        if press_flight or press_group:
+            self._press += 1
+            self._idle = 0
+        elif idle:
+            self._idle += 1
+            self._press = 0
+        else:
+            self._press = 0
+            self._idle = 0
+        if self._press >= _TUNE_UP_AFTER:
+            if press_flight and await self._step_up("max_in_flight"):
+                return ("max_in_flight", "up")
+            if press_group and await self._step_up("coalesce_lines"):
+                return ("coalesce_lines", "up")
+            self._press = 0  # pinned at the ceiling: stop counting
+            return None
+        if self._idle >= _TUNE_DOWN_AFTER:
+            # Unwind depth first (memory + queueing latency), group
+            # size second (per-batch latency).
+            if await self._step_down("max_in_flight"):
+                return ("max_in_flight", "down")
+            if await self._step_down("coalesce_lines"):
+                return ("coalesce_lines", "down")
+            self._idle = 0  # already at the floor
+            return None
+        return None
+
+    async def run(self, stop: "asyncio.Event") -> None:
+        """Tick loop (stop-aware poller idiom). The ``tune.step`` fault
+        point wraps each decision: an armed fault skips that tick and
+        MUST NOT kill the loop — a chaos script proves the pipeline
+        keeps flowing at the held operating point."""
+        from klogs_tpu.resilience import FAULTS
+        from klogs_tpu.ui import term
+
+        while not stop.is_set():
+            try:
+                await asyncio.wait_for(stop.wait(),
+                                       timeout=self._interval_s)
+            except asyncio.TimeoutError:
+                pass
+            if stop.is_set():
+                return
+            try:
+                if FAULTS.active:
+                    await FAULTS.fire("tune.step")
+                await self.step_once(self._profile_fn())
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001
+                # InjectedFault or a profile/apply surprise: hold the
+                # current point, keep the loop alive.
+                term.warning("tune: skipped a control tick (%s)", e)
+
+
+def maybe_controller(service: Any, registry: Any = None
+                     ) -> "AdaptiveController | None":
+    """The app-side gate: None when KLOGS_TUNE=off (default — nothing
+    is constructed, fixed-flag behavior byte-identical), None when the
+    pipeline's filter service has no tuning surface (CPU batch path,
+    remote tier), else a ready-to-run controller. Bad KLOGS_TUNE*
+    values raise ValueError for the caller's friendly-fatal path."""
+    if tune_mode() == "off":
+        return None
+    if getattr(service, "apply_tuning", None) is None:
+        return None
+    return AdaptiveController(service, registry=registry)

@@ -18,6 +18,14 @@ policies guard, so an armed fault exercises the REAL recovery path
                                   sites; surfaces as SourceError so
                                   the fanout reconnect/degrade path
                                   runs for real)
+- ``resolver.watch``            — one membership poll of the endpoint
+                                  resolver (service/resolver.py); a
+                                  fired fault exercises the
+                                  keep-current-fleet path
+- ``tune.step``                 — one adaptive-controller decision
+                                  (ops/tune.py AdaptiveController); a
+                                  fired fault must skip the tick, never
+                                  kill the control loop
 
 Arming: tests call ``FAULTS.arm(point, times=..., exc=..., delay_s=...)``
 with whatever exception type the site really raises; operators/CI use
@@ -47,7 +55,8 @@ from typing import Callable
 
 KNOWN_POINTS = frozenset({
     "rpc.match", "rpc.hello", "kube.list_pods", "kube.log_stream",
-    "sink.write", "source.open", "source.read",
+    "sink.write", "source.open", "source.read", "resolver.watch",
+    "tune.step",
 })
 
 

@@ -9,12 +9,35 @@ is the part the paper's single-endpoint pipeline could not have: a
 
 Mechanisms, in the order a batch meets them:
 
+- **Live membership** (``--resolver``): a pluggable resolver
+  (service/resolver.py: static list, watched file, DNS re-resolution,
+  Kubernetes Endpoints) is polled on the prober cadence
+  (``KLOGS_RESOLVER_INTERVAL_S``) and its snapshot diffed into the
+  fleet by ``apply_membership`` under a ring-generation guard: a
+  dispatch that observes the generation move mid-batch re-routes
+  against fresh membership instead of finishing a stale candidate
+  walk. Joiners enter UNVERIFIED — the same verify-before-rejoin
+  quarantine that guards restarts (Hello handshake; drifted set ⇒
+  permanent quarantine) must pass before a joiner sees a batch — and
+  an empty or failed resolution keeps the current fleet (discovery
+  hiccups must never drop healthy endpoints).
 - **Routing** (``--shard-mode``): ``round-robin`` rotates the fleet per
   batch; ``hash`` pins the pattern-set fingerprint to an owner on a
   consistent-hash ring (virtual nodes), so identical collectors
   converge on the same server — maximizing that server's coalescer and
   compile-cache locality — and an endpoint loss moves only the keys it
   owned.
+- **Capacity weighting** (round-robin mode): each endpoint's
+  Hello-advertised headroom becomes a routing weight (floor 0.05 — a
+  saturated server still gets a trickle and stays a failover
+  candidate), applied by deterministic smooth weighted round-robin so
+  a slow endpoint receives proportionally fewer batches. Weights decay
+  toward uniform as their advertisement ages
+  (``KLOGS_WEIGHT_DECAY_S``; 0 disables weighting): a silent prober
+  must not let a stale low weight starve a now-healthy node. Hash mode
+  stays pinned (locality IS its policy); breaker/readyz demotions
+  compose — weights order the healthy set, demoted endpoints stay
+  last-resort.
 - **Per-endpoint breakers**: each inner client carries its own
   ``CircuitBreaker`` (``rpc@host:port``). An open breaker demotes the
   endpoint to last-resort; its fast-fail (no wire traffic) is what
@@ -40,7 +63,10 @@ import asyncio
 import bisect
 import hashlib
 import time
-from typing import Any, Awaitable, Callable, Iterable, Sequence
+from typing import TYPE_CHECKING, Any, Awaitable, Callable, Iterable, Sequence
+
+if TYPE_CHECKING:
+    from klogs_tpu.service.resolver import Resolver
 
 from klogs_tpu.obs import trace
 from klogs_tpu.resilience import (
@@ -77,6 +103,15 @@ DEFAULT_CAPACITY_REFRESH_S = 5.0
 # removing one of a handful of endpoints re-homes its keys roughly
 # evenly across the survivors.
 _RING_VNODES = 64
+
+# Capacity-weighted routing: how long a Hello-advertised headroom
+# stays fully trusted before decaying linearly toward uniform
+# (KLOGS_WEIGHT_DECAY_S overrides; 0 disables weighting entirely).
+DEFAULT_WEIGHT_DECAY_S = 30.0
+# A saturated endpoint (headroom 0) keeps this floor weight: it must
+# stay a live failover candidate and receive the occasional batch so
+# its recovery is ever observed through the dispatch path itself.
+_WEIGHT_FLOOR = 0.05
 
 
 def parse_endpoints(spec: str) -> list[str]:
@@ -138,7 +173,8 @@ class _Endpoint:
     client)."""
 
     __slots__ = ("target", "client", "ready", "readyz", "verified",
-                 "quarantined", "cap_offered", "cap_admitted", "cap_next")
+                 "quarantined", "cap_offered", "cap_admitted", "cap_next",
+                 "weight", "cap_at", "wrr")
 
     def __init__(self, target: str, client: Any) -> None:
         self.target = target
@@ -153,6 +189,12 @@ class _Endpoint:
         self.cap_offered: "int | None" = None
         self.cap_admitted: "int | None" = None
         self.cap_next = 0.0
+        # Capacity-weighted routing state: the raw headroom-derived
+        # weight, when it was advertised (None = never — weight stays
+        # uniform), and the smooth-WRR accumulator.
+        self.weight = 1.0
+        self.cap_at: "float | None" = None
+        self.wrr = 0.0
         # verified False = the endpoint was unreachable during the
         # startup handshake: it must not receive traffic until a later
         # Hello proves its pattern set matches (the prober re-tries).
@@ -183,13 +225,17 @@ class ShardedFilterClient:
                  probe_timeout_s: float = DEFAULT_PROBE_TIMEOUT_S,
                  registry: Any = None,
                  client_factory: "Callable[[str], Any] | None" = None,
+                 resolver: "Resolver | None" = None,
                  **client_kwargs: Any) -> None:
         if shard_mode not in SHARD_MODES:
             raise ServiceConfigError(
                 f"unknown --shard-mode {shard_mode!r} "
                 f"(want {' | '.join(SHARD_MODES)})")
         target_list = list(targets)
-        if not target_list:
+        if not target_list and resolver is None:
+            # With a resolver, an empty seed list is legal: the first
+            # membership fill happens in verify_patterns, inside the
+            # running loop, from the resolver's own snapshot.
             raise ServiceConfigError("--remote endpoint list is empty")
         seen: set[str] = set()
         for t in target_list:
@@ -203,6 +249,7 @@ class ShardedFilterClient:
             def client_factory(target: str) -> Any:
                 return RemoteFilterClient(target, registry=registry,
                                           **client_kwargs)
+        self._client_factory = client_factory
         self._mode = shard_mode
         self._fingerprint = fingerprint
         # The collector's pattern-set invocation, remembered by
@@ -235,11 +282,41 @@ class ShardedFilterClient:
         self._m_cap_head: Any = None
         self._m_cap_off: Any = None
         self._m_cap_adm: Any = None
-        from klogs_tpu.utils.env import positive_float
+        self._m_weight: Any = None
+        self._m_member_events: Any = None
+        self._m_member_size: Any = None
+        from klogs_tpu.utils.env import nonneg_float, positive_float
 
         self._cap_refresh_s = positive_float(
             "KLOGS_FLEET_REFRESH_S", DEFAULT_CAPACITY_REFRESH_S,
             exc=ServiceConfigError)
+        # Validated at construction (startup), not first use inside the
+        # prober task — a malformed knob must fail naming itself, not
+        # silently kill background routing mid-run.
+        try:
+            self._weight_decay_s = nonneg_float(
+                "KLOGS_WEIGHT_DECAY_S", DEFAULT_WEIGHT_DECAY_S)
+        except ValueError as e:
+            raise ServiceConfigError(str(e)) from None
+        # Live membership (service/resolver.py): polled by the prober
+        # on its own cadence; 0.0 forces a poll on the first cycle.
+        self._resolver = resolver
+        self._resolver_next = 0.0
+        self._resolver_interval_s = 0.0
+        if resolver is not None:
+            from klogs_tpu.service.resolver import (
+                DEFAULT_RESOLVE_INTERVAL_S,
+            )
+
+            self._resolver_interval_s = positive_float(
+                "KLOGS_RESOLVER_INTERVAL_S", DEFAULT_RESOLVE_INTERVAL_S,
+                exc=ServiceConfigError)
+        # Bumped on every membership change; _dispatch snapshots it and
+        # re-routes when it moves mid-batch (the ring-generation guard).
+        self._ring_gen = 0
+        # Retired endpoints' channel-close tasks: strong refs so they
+        # cannot be GC'd mid-close, settled in aclose.
+        self._member_tasks: "set[asyncio.Task]" = set()
         if registry is not None:
             self._m_hedges = registry.family("klogs_shard_hedges_total")
             self._m_reroutes = registry.family("klogs_shard_reroutes_total")
@@ -251,6 +328,12 @@ class ShardedFilterClient:
                 "klogs_fleet_endpoint_offered_lines_total")
             self._m_cap_adm = registry.family(
                 "klogs_fleet_endpoint_admitted_lines_total")
+            self._m_weight = registry.family("klogs_shard_endpoint_weight")
+            self._m_member_events = registry.family(
+                "klogs_fleet_membership_events_total")
+            self._m_member_size = registry.family(
+                "klogs_fleet_membership_size")
+            self._m_member_size.set(len(self._endpoints))
             for ep in self._endpoints:
                 self._m_ready.labels(endpoint=ep.target).set(1)
 
@@ -284,11 +367,49 @@ class ShardedFilterClient:
 
     def _natural_order(self) -> "list[_Endpoint]":
         """Health-blind candidate order: the pure routing policy."""
+        if not self._endpoints:
+            # Legal mid-run with a resolver: the fleet can shrink to
+            # zero between polls (every dispatch then raises
+            # Unavailable until membership recovers).
+            return []
         if self._mode == "hash":
             return [self._endpoints[i] for i in self._hash_order]
         i = self._rr % len(self._endpoints)
         self._rr += 1
         return self._endpoints[i:] + self._endpoints[:i]
+
+    def _effective_weight(self, ep: _Endpoint, now: float) -> float:
+        """Headroom-learned weight decayed toward uniform 1.0 as the
+        last capacity sample ages: a silent prober (endpoint stopped
+        answering Hello, so ``cap_at`` froze) loses its learned bias
+        within ``KLOGS_WEIGHT_DECAY_S`` instead of starving — or
+        forever favoring — anyone."""
+        if self._weight_decay_s <= 0 or ep.cap_at is None:
+            return 1.0
+        fresh = max(0.0, 1.0 - (now - ep.cap_at) / self._weight_decay_s)
+        return 1.0 + fresh * (ep.weight - 1.0)
+
+    def _weighted_order(self,
+                        healthy: "list[_Endpoint]"
+                        ) -> "list[_Endpoint] | None":
+        """Smooth weighted round-robin over the healthy set (nginx
+        algorithm: deterministic, no starvation — every endpoint is
+        visited, just proportionally less often). Returns None when
+        weighting is disabled or the weights are effectively uniform,
+        so the caller keeps today's rotation byte-identically."""
+        if self._weight_decay_s <= 0:
+            return None
+        now = time.monotonic()
+        weights = [self._effective_weight(ep, now) for ep in healthy]
+        if max(weights) - min(weights) < 1e-6:
+            return None
+        total = 0.0
+        for ep, w in zip(healthy, weights):
+            ep.wrr += w
+            total += w
+        order = sorted(healthy, key=lambda ep: -ep.wrr)
+        order[0].wrr -= total
+        return order
 
     def _route_order(self) -> "list[_Endpoint]":
         """Candidate order for one batch: available endpoints first (in
@@ -327,6 +448,15 @@ class ShardedFilterClient:
             # the per-batch story the aggregate counter cannot tell.
             trace.TRACER.event("shard.reroute", endpoint=ep.target,
                                reason=reason)
+        # Capacity weighting reorders WITHIN the healthy set only, and
+        # only in round-robin mode (hash mode pins ownership — skipping
+        # the ring owner for capacity would churn key placement). It
+        # runs AFTER the reroute accounting above: weighting is policy,
+        # not a health event.
+        if self._mode == "round-robin" and len(healthy) > 1:
+            weighted = self._weighted_order(healthy)
+            if weighted is not None:
+                healthy = weighted
         return healthy + [ep for ep in natural if not avail[ep.target]]
 
     def _note_endpoint_down(self, ep: _Endpoint) -> None:
@@ -342,6 +472,123 @@ class ShardedFilterClient:
             if self._m_ready is not None:
                 self._m_ready.labels(endpoint=ep.target).set(0)
             self._ensure_prober()
+
+    # -- live membership ----------------------------------------------
+
+    def _member_event(self, action: str) -> None:
+        if self._m_member_events is not None:
+            self._m_member_events.labels(action=action).inc()
+
+    async def apply_membership(self, targets: "Iterable[str]"
+                               ) -> "tuple[list[str], list[str]]":
+        """Diff a resolver snapshot against live membership and apply
+        it: joiners enter the fleet UNVERIFIED (the prober's
+        verify-before-rejoin handshake gates their first batch, unless
+        no expected config is armed yet), leavers have their channels
+        retired in the background and their per-endpoint series
+        dropped. Any change bumps the ring generation so an in-flight
+        dispatch re-routes. Returns (added, removed) target lists."""
+        valid: "list[str]" = []
+        seen: "set[str]" = set()
+        for raw in targets:
+            t = raw.strip()
+            if not t or t in seen:
+                continue
+            try:
+                _validate_target(t)
+            except ServiceConfigError as e:
+                # One bad record must not poison the snapshot: keep
+                # the good entries, skip (and count) the bad one.
+                self._member_event("error")
+                term.warning("resolver returned a malformed endpoint "
+                             "%r (%s); skipping it", t, e)
+                continue
+            seen.add(t)
+            valid.append(t)
+        if not valid and self._endpoints:
+            # Refuse to drain the whole fleet on a (possibly bogus)
+            # empty snapshot — a half-deployed Endpoints object or a
+            # truncated file must not stop a flowing pipeline. Scale-
+            # to-zero on purpose is a restart-sized decision anyway.
+            self._member_event("error")
+            term.warning(
+                "resolver returned an EMPTY endpoint set; keeping the "
+                "current fleet of %d", len(self._endpoints))
+            return [], []
+        current = {ep.target for ep in self._endpoints}
+        added = [t for t in valid if t not in current]
+        removed = [t for t in current if t not in seen]
+        if not added and not removed:
+            return [], []
+        keep = [ep for ep in self._endpoints if ep.target in seen]
+        leavers = [ep for ep in self._endpoints if ep.target not in seen]
+        for t in added:
+            ep = _Endpoint(t, self._client_factory(t))
+            # Pre-handshake joins (resolver seeding before
+            # verify_patterns) are verified by the imminent handshake
+            # itself; post-handshake joiners wait for the prober.
+            ep.verified = self._expected is None
+            keep.append(ep)
+            self._member_event("add")
+            if self._m_ready is not None:
+                self._m_ready.labels(endpoint=ep.target).set(
+                    1 if ep.verified else 0)
+            term.info("filterd %s joined the fleet%s", t,
+                      "" if ep.verified
+                      else " (unverified until its pattern set checks)")
+        self._endpoints = keep
+        for ep in leavers:
+            self._member_event("remove")
+            await self._retire(ep)
+            term.info("filterd %s left the fleet", ep.target)
+        self._ring_gen += 1
+        if self._mode == "hash":
+            self._hash_order = self._ring_walk()
+        if self._m_member_size is not None:
+            self._m_member_size.set(len(self._endpoints))
+        self._ensure_prober()
+        return added, removed
+
+    async def _retire(self, ep: _Endpoint) -> None:
+        """Close a leaver's channel off the hot path and drop its
+        per-endpoint series (a scrape must not keep exporting a gauge
+        for an endpoint that no longer exists)."""
+        for fam in (self._m_ready, self._m_cap_head, self._m_cap_off,
+                    self._m_cap_adm, self._m_weight):
+            if fam is not None:
+                fam.remove(endpoint=ep.target)
+
+        async def _close(client: Any = ep.client) -> None:
+            try:
+                await client.aclose()
+            except Exception:  # noqa: BLE001
+                pass  # retirement teardown; the channel is gone either way
+
+        task = asyncio.get_running_loop().create_task(_close())
+        self._member_tasks.add(task)
+        task.add_done_callback(self._member_tasks.discard)
+
+    async def _resolve_step(self) -> None:
+        """One membership poll: ask the resolver for the current fleet
+        and apply the diff. Every failure mode keeps the current
+        membership — discovery is advisory, never load-bearing."""
+        self._resolver_next = (time.monotonic()
+                               + self._resolver_interval_s)
+        assert self._resolver is not None
+        try:
+            targets = await self._resolver.resolve()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001
+            # ResolverError, InjectedFault, or a resolver bug: all
+            # transient from membership's point of view.
+            self._member_event("error")
+            term.warning("endpoint resolver %s failed (%s); keeping the "
+                         "current fleet of %d",
+                         self._resolver.describe(), e,
+                         len(self._endpoints))
+            return
+        await self.apply_membership(targets)
 
     # -- dispatch -----------------------------------------------------
 
@@ -362,11 +609,23 @@ class ShardedFilterClient:
         with trace.TRACER.span("shard.dispatch", what=what,
                                mode=self._mode) as sp:
             queue = list(self._route_order())
+            gen = self._ring_gen
             tasks: "dict[asyncio.Task, _Endpoint]" = {}
             errors: "list[str]" = []
             pending: "set[asyncio.Task]" = set()
             try:
                 while queue or pending:
+                    if self._ring_gen != gen:
+                        # Membership changed mid-batch: the queued
+                        # candidates may include retired endpoints (or
+                        # miss fresh ones). Re-route from the current
+                        # ring, keeping attempts already in flight.
+                        gen = self._ring_gen
+                        attempted = {tasks[t].target for t in tasks}
+                        queue = [ep for ep in self._route_order()
+                                 if ep.target not in attempted]
+                        if not queue and not pending:
+                            break  # refresh drained the candidates
                     if not pending:
                         ep = queue.pop(0)
                         sp.add_event("shard.route", endpoint=ep.target)
@@ -469,6 +728,23 @@ class ShardedFilterClient:
         partial fleet must not block startup, surviving one is the
         point of this tier. All-down is a hard error. Hello responses
         also teach the prober where each endpoint's /readyz lives."""
+        if self._resolver is not None and not self._endpoints:
+            # Resolver-seeded fleet (no --remote list): the FIRST
+            # membership fill must succeed — there is nothing to keep
+            # flying on. Applied before _expected is armed, so these
+            # seeds are verified by the handshake below, exactly like
+            # a static list.
+            try:
+                targets = await self._resolver.resolve()
+            except Exception as e:  # noqa: BLE001
+                raise Unavailable(
+                    f"endpoint resolver {self._resolver.describe()} "
+                    f"failed at startup: {e}") from e
+            await self.apply_membership(targets)
+            if not self._endpoints:
+                raise Unavailable(
+                    f"endpoint resolver {self._resolver.describe()} "
+                    "returned no endpoints at startup")
         self._expected = (list(patterns), bool(ignore_case),
                           list(exclude or []))
         # Concurrent: each hello still gets its client's full retry
@@ -566,6 +842,17 @@ class ShardedFilterClient:
                 # closing below.
                 pass
             self._probe_task = None
+        if self._member_tasks:
+            # Retired-channel closes still in flight: settle them so no
+            # task outlives the client (task_lifecycle discipline).
+            await asyncio.gather(*list(self._member_tasks),
+                                 return_exceptions=True)
+            self._member_tasks.clear()
+        if self._resolver is not None:
+            try:
+                await self._resolver.aclose()
+            except Exception:  # noqa: BLE001
+                pass  # discovery teardown must not mask pipeline close
         await asyncio.gather(
             *[ep.client.aclose() for ep in self._endpoints],
             return_exceptions=True)
@@ -589,9 +876,15 @@ class ShardedFilterClient:
         dropped) restarts its contribution from the new total rather
         than poisoning the series with a negative increment."""
         ep.cap_next = time.monotonic() + self._cap_refresh_s
+        head = info.get("headroom")
+        if isinstance(head, (int, float)) and not isinstance(head, bool):
+            # Routing weight learns from every Hello (registry or not):
+            # clamp to [0,1], then floor — a saturated endpoint still
+            # gets a trickle (its Hello is how it advertises recovery).
+            ep.weight = max(_WEIGHT_FLOOR, min(1.0, max(0.0, float(head))))
+            ep.cap_at = time.monotonic()
         if self._m_cap_head is None:
             return
-        head = info.get("headroom")
         if isinstance(head, (int, float)) and not isinstance(head, bool):
             self._m_cap_head.labels(endpoint=ep.target).set(float(head))
         for key, attr, fam in (
@@ -663,7 +956,8 @@ class ShardedFilterClient:
         if (self._probe_task is None
                 and (any(ep.readyz for ep in self._endpoints)
                      or any(not ep.verified for ep in self._endpoints)
-                     or self._m_cap_head is not None)):
+                     or self._m_cap_head is not None
+                     or self._resolver is not None)):
             if self._probe_stop is None:
                 self._probe_stop = asyncio.Event()
             self._probe_task = asyncio.get_running_loop().create_task(
@@ -691,9 +985,27 @@ class ShardedFilterClient:
         stop = self._probe_stop
         assert stop is not None  # created by _ensure_prober
         while not stop.is_set():
-            for ep in self._endpoints:
+            if (self._resolver is not None
+                    and time.monotonic() >= self._resolver_next):
+                # Membership poll rides the prober cadence but keeps
+                # its own (usually longer) interval.
+                try:
+                    await self._resolve_step()
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001
+                    # _resolve_step already swallows resolver failures;
+                    # this guards apply_membership itself — a bug there
+                    # must not kill drain/late-verify for the fleet.
+                    term.warning("membership update failed: %s", e)
+            for ep in list(self._endpoints):
                 if stop.is_set() or ep.quarantined:
                     continue
+                if self._m_weight is not None:
+                    # Exported weight is the EFFECTIVE one (decay
+                    # applied) — what routing actually uses right now.
+                    self._m_weight.labels(endpoint=ep.target).set(
+                        self._effective_weight(ep, time.monotonic()))
                 try:
                     if not ep.verified:
                         await self._late_verify(ep)

@@ -182,12 +182,23 @@ def test_bench_fleet_contract(tmp_path):
     rec = json.loads(out.read_text())
     assert rec["unit"] == "lines/sec"
     assert rec["cpu_count"] >= 1
-    assert [r["endpoints"] for r in rec["rows"]] == [1, 2]
+    # One row per homogeneous fleet size, then the trailing
+    # heterogeneous row (full-rate + quarter-rate pair).
+    assert [r["endpoints"] for r in rec["rows"]] == [1, 2, 2]
+    assert [bool(r.get("heterogeneous")) for r in rec["rows"]] == \
+        [False, False, True]
+    het = rec["rows"][-1]
+    assert len(het["per_endpoint"]) == 2
+    for pe in het["per_endpoint"]:
+        for key in ("endpoint", "capacity_lps", "batches", "share"):
+            assert key in pe, key
+    assert abs(sum(pe["share"] for pe in het["per_endpoint"]) - 1.0) < 1e-6
     for row in rec["rows"]:
         for key in ("lps", "n_lines", "batch_lines", "senders",
                     "capacity_lps_per_endpoint", "stages", "bottleneck",
                     "headroom"):
             assert key in row, key
+        assert row["source"] == "archive"
         assert row["lps"] > 0
         assert len(row["headroom"]) == row["endpoints"]
         for h in row["headroom"]:

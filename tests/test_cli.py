@@ -121,3 +121,19 @@ def test_since_time_validation(capsys):
     assert main(["-a", "--cluster", "fake", "-s", "5m",
                  "--since-time", "2026-07-31T06:00:00Z"]) == 1
     assert "at most one of" in capsys.readouterr().out
+
+
+def test_resolver_flag_parsed_and_bad_spec_rejected(capsys):
+    from klogs_tpu.cli import parse_args
+
+    assert parse_args(["-a"]).resolver is None
+    assert parse_args(
+        ["-a", "--resolver", "kube:logging/filterd:50051"]
+    ).resolver == "kube:logging/filterd:50051"
+    # A malformed spec dies at the CLI boundary, naming itself, before
+    # any cluster work runs.
+    assert main(["-a", "--match", "x", "--cluster", "fake",
+                 "--resolver", "consul:nope"]) == 1
+    out = capsys.readouterr().out
+    assert "--resolver" in out
+    assert "Using Namespace" not in out

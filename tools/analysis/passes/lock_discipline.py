@@ -120,6 +120,27 @@ SHARED_STATE: dict = {
         # and stream close, both on the loop.
         "SocketSource": _decl("loop", None, "_conns"),
     },
+    "klogs_tpu/service/shard.py": {
+        # Live-membership state: the fleet list, ring generation and
+        # retirement tasks are mutated only by the (async) membership
+        # path — apply_membership/_retire/_resolve_step — and by
+        # aclose, all on the loop. No sync method may touch them.
+        "ShardedFilterClient": _decl("loop", None, "_endpoints",
+                                     "_ring_gen", "_hash_order",
+                                     "_member_tasks", "_resolver_next"),
+    },
+    "klogs_tpu/service/resolver.py": {
+        # The kube backend is created lazily on first poll and closed
+        # by aclose — both coroutines on the loop.
+        "KubeEndpointsResolver": _decl("loop", None, "_backend"),
+    },
+    "klogs_tpu/ops/tune.py": {
+        # Controller state machine: mutated by step_once/_apply, which
+        # only the async run() loop drives.
+        "AdaptiveController": _decl("loop", None, "values", "_press",
+                                    "_idle", "_cooldown",
+                                    "steps_applied"),
+    },
     "klogs_tpu/service/tenancy.py": {
         # The registry maps are mutated by async Register/evict
         # handlers on the loop but READ from sync banner/Hello paths

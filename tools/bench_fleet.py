@@ -20,6 +20,15 @@ it honestly bends where the collector's single-core wire work
 saturates — and the stage attribution in the row says so
 (rpc.client/shard.dispatch busy-seconds dominating device.fetch).
 
+The corpus reaches the senders the way a backfill run would: rotated
+into gzip archive members and ingested through the real ArchiveSource
+(producer thread, bounded readahead), then framed into wire batches —
+every row carries ``"source": "archive"``. A final HETEROGENEOUS row
+runs one full-rate device next to one at a quarter rate and records
+each endpoint's admitted batch share next to its advertised headroom:
+the acceptance signal that capacity-weighted routing steers load
+toward headroom instead of splitting 1/N.
+
 The ``overhead`` block is the acceptance measurement for the <2%
 profiler budget: the K=1024 BENCH_K bench path (IndexedFilter, host
 sweep, same corpus/builder as bench.py --k-axis) timed with the
@@ -36,9 +45,11 @@ KLOGS_BENCH_REPEATS, KLOGS_BENCH_FLEET_OUT.
 """
 
 import asyncio
+import gzip
 import json
 import os
 import sys
+import tempfile
 import threading
 import time
 
@@ -88,8 +99,68 @@ class SimulatedDeviceFilter(LogFilter):
         return np.zeros(n, dtype=bool)
 
 
-async def _drive_fleet(n_endpoints: int, n_lines: int, batch_lines: int,
-                       senders: int, cap_lps: float,
+def _write_corpus(tmpdir: str, n_lines: int, members: int = 4
+                  ) -> "list[str]":
+    """Rotate the synthetic corpus into gzip archive members — the
+    exact artifact shape ``--backfill`` ingests in production."""
+    lines = bench.make_lines(n_lines)
+    per = max(1, (len(lines) + members - 1) // members)
+    paths = []
+    for i in range(members):
+        chunk = lines[i * per:(i + 1) * per]
+        if not chunk:
+            break
+        path = os.path.join(tmpdir, f"pod.log.{i}.gz")
+        with gzip.open(path, "wb") as f:
+            f.writelines(chunk)
+        paths.append(path)
+    return paths
+
+
+async def _archive_batches(paths: "list[str]", batch_lines: int
+                           ) -> "list[tuple]":
+    """Ingest the rotated corpus through the real ArchiveSource
+    (producer thread, bounded readahead, gzip decode) and frame it
+    into wire batches — so the senders replay exactly what a backfill
+    run would have put on the wire."""
+    from klogs_tpu.cluster.types import LogOptions
+    from klogs_tpu.sources.archive import ArchiveSource
+
+    src = ArchiveSource(paths)
+    await src.start()
+    batches: "list[tuple]" = []
+    pend: "list[bytes]" = []
+
+    def flush(minimum: int) -> None:
+        nonlocal pend
+        while len(pend) >= max(1, minimum):
+            chunk, pend = pend[:batch_lines], pend[batch_lines:]
+            payload, offsets, _ = frame_lines(chunk)
+            batches.append((payload, offsets, len(chunk)))
+
+    try:
+        buf = b""
+        for ref in await src.discover():
+            stream = await src.open_stream(ref, LogOptions())
+            try:
+                async for slab in stream:
+                    buf += slab
+                    parts = buf.split(b"\n")
+                    buf = parts.pop()
+                    pend.extend(p for p in parts if p)
+                    flush(batch_lines)
+            finally:
+                await stream.close()
+        if buf:
+            pend.append(buf)
+        flush(1)  # tail partial batch
+    finally:
+        await src.close()
+    return batches
+
+
+async def _drive_fleet(caps: "list[float]", batches: "list[tuple]",
+                       batch_lines: int, senders: int,
                        patterns: "list[str]") -> dict:
     from klogs_tpu.obs import Registry, register_all
     from klogs_tpu.service.server import FilterServer
@@ -97,45 +168,60 @@ async def _drive_fleet(n_endpoints: int, n_lines: int, batch_lines: int,
 
     servers = []
     targets = []
-    for _ in range(n_endpoints):
+    for cap in caps:
         srv = FilterServer(patterns, backend="cpu", port=0)
         # Swap the compiled engine for the simulated device BEFORE
-        # start() so even the warmup batch rides the model.
+        # start() so even the warmup batch rides the model, and pin
+        # the capacity envelope so the Hello headroom advertisement
+        # reflects THIS endpoint's (possibly heterogeneous) device.
         srv._service._filter.close()
-        srv._service._filter = SimulatedDeviceFilter(cap_lps)
+        srv._service._filter = SimulatedDeviceFilter(cap)
+        srv.capacity._envelope = cap
+        srv.capacity._envelope_resolved = True
+        srv.capacity._envelope_from_ctor = True
         port = await srv.start()
         servers.append(srv)
         targets.append(f"127.0.0.1:{port}")
 
+    heterogeneous = len(set(caps)) > 1
     registry = Registry()
     register_all(registry)
     client = ShardedFilterClient(targets, shard_mode="round-robin",
                                  hedge_s=None, registry=registry)
-    lines = [ln.rstrip(b"\n") for ln in bench.make_lines(n_lines)]
-    batches = []
-    for i in range(0, len(lines), batch_lines):
-        payload, offsets, _ = frame_lines(lines[i:i + batch_lines])
-        batches.append((payload, offsets, len(lines[i:i + batch_lines])))
     try:
         await client.verify_patterns(patterns)
-        queue: "asyncio.Queue" = asyncio.Queue()
-        for b in batches:
-            queue.put_nowait(b)
 
-        async def sender() -> int:
-            done = 0
-            while True:
-                try:
-                    payload, offsets, n = queue.get_nowait()
-                except asyncio.QueueEmpty:
-                    return done
-                await client.match_framed(payload, offsets)
-                done += n
+        async def drive() -> "tuple[list[int], float]":
+            queue: "asyncio.Queue" = asyncio.Queue()
+            for b in batches:
+                queue.put_nowait(b)
 
+            async def sender() -> int:
+                done = 0
+                while True:
+                    try:
+                        payload, offsets, n = queue.get_nowait()
+                    except asyncio.QueueEmpty:
+                        return done
+                    await client.match_framed(payload, offsets)
+                    done += n
+
+            t0 = time.perf_counter()
+            counts = await asyncio.gather(
+                *[sender() for _ in range(senders)])
+            return counts, time.perf_counter() - t0
+
+        fam = registry.family("klogs_shard_batches_total")
+        won0 = [0.0] * len(targets)
+        if heterogeneous:
+            # Learn pass: age each endpoint's admitted-rate window and
+            # let the prober fold the diverging headroom advertisements
+            # into routing weights; the measured pass below then runs
+            # at the steady operating point. Shares are deltas.
+            await drive()
+            won0 = [fam.labels(endpoint=t).value for t in targets]
         before = PROFILER.tick() or {"stages": {}}
-        t0 = time.perf_counter()
-        counts = await asyncio.gather(*[sender() for _ in range(senders)])
-        dt = time.perf_counter() - t0
+        counts, dt = await drive()
         after = PROFILER.tick() or {"stages": {}}
         stages = {}
         for name, st in after["stages"].items():
@@ -151,17 +237,33 @@ async def _drive_fleet(n_endpoints: int, n_lines: int, batch_lines: int,
         headroom = []
         for srv in servers:
             headroom.append(srv.capacity.doc()["headroom"])
-        return {
-            "endpoints": n_endpoints,
+        row = {
+            "endpoints": len(caps),
+            "source": "archive",
             "n_lines": sum(counts),
             "batch_lines": batch_lines,
             "senders": senders,
-            "capacity_lps_per_endpoint": cap_lps,
+            "capacity_lps_per_endpoint": (list(caps) if heterogeneous
+                                          else caps[0]),
             "lps": round(sum(counts) / dt, 1),
             "stages": stages,
             "bottleneck": bottleneck,
             "headroom": headroom,
         }
+        if heterogeneous:
+            # The acceptance signal for capacity-weighted routing: the
+            # share of batches each endpoint won should track its
+            # advertised headroom, not 1/N.
+            won = [fam.labels(endpoint=t).value - w0
+                   for t, w0 in zip(targets, won0)]
+            total = sum(won) or 1.0
+            row["heterogeneous"] = True
+            row["per_endpoint"] = [
+                {"endpoint": t, "capacity_lps": c,
+                 "batches": int(n), "share": round(n / total, 4),
+                 "headroom": h}
+                for t, c, n, h in zip(targets, caps, won, headroom)]
+        return row
     finally:
         await client.aclose()
         for srv in servers:
@@ -228,22 +330,44 @@ def main() -> None:
                                   str(DEFAULT_OVERHEAD_LINES)))
     repeats = int(env_read("KLOGS_BENCH_REPEATS", "5"))
 
-    # The headroom advertisement needs an envelope; the simulated
-    # device's calibrated capacity IS the envelope here. (Writes are
-    # legal; only raw KLOGS_* reads must flow through utils/env.)
-    os.environ["KLOGS_FLEET_CAPACITY_LPS"] = str(cap_lps)
+    # The headroom advertisement needs an envelope; each server gets
+    # its own (possibly heterogeneous) device capacity pinned as the
+    # constructor envelope in _drive_fleet — the env override would
+    # flatten the heterogeneous row to one shared number. Refresh
+    # capacity at prober cadence so a bench-length run actually sees
+    # the advertisements diverge. (Writes are legal; only raw KLOGS_*
+    # reads must flow through utils/env.)
+    os.environ.pop("KLOGS_FLEET_CAPACITY_LPS", None)
+    os.environ["KLOGS_FLEET_REFRESH_S"] = "0.5"
     # Span stream fully on: the per-stage attribution is the point.
     trace.reset(1.0)
     PROFILER.reset()
     PROFILER.enable(1.0)
 
     rows = []
+    with tempfile.TemporaryDirectory(prefix="klogs-bench-fleet-") as tmp:
+        # Rotate the corpus to gzip archives ONCE and replay the same
+        # ArchiveSource-framed batches into every fleet size, so rows
+        # differ only in the fleet.
+        paths = _write_corpus(tmp, n_lines)
+        batches = asyncio.run(_archive_batches(paths, batch_lines))
     for n in endpoints:
-        row = asyncio.run(_drive_fleet(n, n_lines, batch_lines, senders,
-                                       cap_lps, bench.PATTERNS))
+        row = asyncio.run(_drive_fleet([cap_lps] * n, batches,
+                                       batch_lines, senders,
+                                       bench.PATTERNS))
         rows.append(row)
         print(f"bench_fleet: {n} endpoint(s) -> {row['lps']:,.0f} l/s "
               f"bottleneck={row['bottleneck']}", file=sys.stderr)
+    # The heterogeneous fleet: one full-rate device plus one at a
+    # quarter rate. Capacity-weighted routing should steer admitted
+    # share toward headroom, not split it 1/N.
+    het = asyncio.run(_drive_fleet([cap_lps, cap_lps / 4.0], batches,
+                                   batch_lines, senders,
+                                   bench.PATTERNS))
+    rows.append(het)
+    shares = ", ".join(f"{pe['share']:.2f}" for pe in het["per_endpoint"])
+    print(f"bench_fleet: heterogeneous [1x, 0.25x] -> "
+          f"{het['lps']:,.0f} l/s shares=[{shares}]", file=sys.stderr)
     PROFILER.reset()
     trace.reset(None)
 
